@@ -1,0 +1,24 @@
+"""dasmtl — TPU-native multi-task learning framework for Distributed Acoustic Sensing.
+
+A ground-up JAX/Flax/Optax/Orbax rebuild of the capabilities of the
+``sunmin123456/MTL-DAS.PyTorch`` reference (single-GPU PyTorch):
+
+- ``dasmtl.models``   — Flax (NHWC) implementations of the two-level MTL network
+  (reference ``model/modelA_MTL.py``), the single-task baselines
+  (``model/modelB_singleTask.py``) and the InceptionV3 32-way multi-classifier
+  (``model/modelC_multiClassifier.py``), all re-derived for TPU (MXU-friendly
+  layouts, static shapes, XLA-fusable control flow).
+- ``dasmtl.data``     — .mat dataset discovery, reference-parity train/val splits,
+  RAM/disk sources and a shardable, padded, static-shape batch pipeline.
+- ``dasmtl.train``    — jitted train/eval steps, coupled-L2 Adam (torch parity),
+  stepped LR schedule, metrics, Orbax checkpoint/resume, trainer engines.
+- ``dasmtl.parallel`` — device mesh (dp × sp), NamedSharding specs, GSPMD
+  data/spatial-parallel step compilation (ICI collectives inserted by XLA).
+- ``dasmtl.ops``      — Pallas TPU kernels (fused sigmoid-gate) with portable
+  fallbacks.
+- ``dasmtl.utils``    — run dirs, logger tee, plotting, profiling.
+"""
+
+__version__ = "0.1.0"
+
+from dasmtl.config import Config  # noqa: F401

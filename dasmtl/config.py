@@ -1,0 +1,189 @@
+"""Single dataclass configuration for the whole framework.
+
+The reference scatters its configuration between duplicated argparse blocks
+(`train.py:6-28`, `test.py:6-28` in /root/reference) and hard-coded constants in
+`utils.main_process` (Adam lr=1e-3 / weight_decay=1e-5 at utils.py:133-134, LR
+decay /1.5 every 5 epochs at utils.py:230-247, checkpoint accuracy gates at
+utils.py:329/716, validation cadence at utils.py:245).  Here every knob is an
+explicit field with the reference's value as its default, and the `--GPU_device`
+bool-trap flag (train.py:10 — `type=bool` makes any string truthy) is replaced
+by a proper `--device={tpu,cpu,auto}` choice.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from typing import Optional
+
+MODEL_TYPES = ("MTL", "single_event", "single_distance", "multi_classifier")
+
+# Tasks of the two-level MTL problem (reference modelA_MTL.py:68-69).
+TASKS = ("distance", "event")
+NUM_DISTANCE_CLASSES = 16
+NUM_EVENT_CLASSES = 2
+NUM_MIXED_CLASSES = NUM_DISTANCE_CLASSES * NUM_EVENT_CLASSES
+# Input sample geometry: 100 fiber channels x 250 time samples
+# (reference utils.py:128, dataset_preparation.py:247-248).
+INPUT_HEIGHT = 100
+INPUT_WIDTH = 250
+
+
+@dataclasses.dataclass
+class Config:
+    """Every hyperparameter of a run; defaults reproduce the reference."""
+
+    # ---- model selection (reference utils.py:85-98) ----
+    model: str = "MTL"
+
+    # ---- training schedule (reference utils.py:133-139, 230-247) ----
+    batch_size: int = 32
+    epoch_num: int = 40
+    lr: float = 1e-3
+    weight_decay: float = 1e-5
+    lr_decay_factor: float = 1.5
+    lr_decay_every: int = 5
+    # The MTL/single-task trainers decay at epoch 0 too (utils.py:245-247);
+    # the multi-classifier trainer skips epoch 0 (utils.py:622-625).
+    lr_decay_at_epoch0: bool = True
+    val_every: int = 5
+    # Checkpoint accuracy gate: 0.98 for MTL/single-task (utils.py:329),
+    # 0.95 for the multi-classifier (utils.py:716). `None` = auto by model.
+    ckpt_acc_gate: Optional[float] = None
+    # Unconditional periodic checkpointing (new capability — the reference can
+    # lose an entire run if the gate is never crossed, SURVEY.md §5).
+    ckpt_every_epochs: int = 5
+    ckpt_max_keep: int = 3
+
+    # ---- dataset / splits (reference dataset_preparation.py:118-239) ----
+    random_state: int = 1
+    fold_index: Optional[int] = None
+    test_rate: float = 0.17647
+    dataset_ram: bool = True
+    trainval_set_striking: str = "./dataset/striking_train"
+    trainval_set_excavating: str = "./dataset/excavating_train"
+    test_set_striking: str = "./dataset/striking_test"
+    test_set_excavating: str = "./dataset/excavating_test"
+    mat_key: str = "data"
+    # Opt-in SNR-targeted Gaussian noise for robustness evals
+    # (reference dataset_preparation.py:83-105; disabled there at :244-245).
+    noise_snr_db: Optional[float] = None
+
+    # ---- device / parallelism (new: TPU-native layers, SURVEY.md §2.4) ----
+    device: str = "auto"  # tpu | cpu | auto
+    dp: int = -1  # data-parallel mesh size; -1 = all visible devices
+    sp: int = 1  # spatial-parallel mesh size over the fiber-channel axis
+    compute_dtype: str = "float32"  # float32 | bfloat16 (params stay f32)
+    # BatchNorm under GSPMD jit uses *global* batch statistics (XLA inserts the
+    # cross-device reductions) — i.e. sync-BN. With per-device batch == the
+    # reference's batch 32 this differs from the reference's per-replica stats;
+    # documented design choice (SURVEY.md §7 step 5).
+
+    # ---- run outputs (reference utils.py:100-116) ----
+    output_savedir: str = "./runs"
+    model_path: Optional[str] = None  # checkpoint to restore
+    resume: bool = False  # resume full TrainState from latest in run dir
+
+    # ---- misc ----
+    seed: int = 1
+    log_every_steps: int = 100  # metric-line cadence (reference utils.py:376)
+    use_pallas: bool = False  # fused sigmoid-gate Pallas kernel on TPU
+    debug_nans: bool = False
+    profile_dir: Optional[str] = None  # jax.profiler trace output
+
+    def __post_init__(self) -> None:
+        if self.model not in MODEL_TYPES:
+            raise ValueError(
+                f"unknown model {self.model!r}; expected one of {MODEL_TYPES}"
+            )
+        if self.device not in ("tpu", "cpu", "auto"):
+            raise ValueError(f"unknown device {self.device!r}")
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
+
+    @property
+    def acc_gate(self) -> float:
+        if self.ckpt_acc_gate is not None:
+            return self.ckpt_acc_gate
+        return 0.95 if self.model == "multi_classifier" else 0.98
+
+    @property
+    def num_classes(self) -> tuple:
+        """Logical class counts for each output head of the selected model."""
+        return {
+            "MTL": (NUM_DISTANCE_CLASSES, NUM_EVENT_CLASSES),
+            "single_distance": (NUM_DISTANCE_CLASSES,),
+            "single_event": (NUM_EVENT_CLASSES,),
+            "multi_classifier": (NUM_MIXED_CLASSES,),
+        }[self.model]
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Config":
+        return cls(**json.loads(text))
+
+
+def _add_shared_args(p: argparse.ArgumentParser) -> None:
+    """Flag surface preserving the reference CLI (train.py:7-26) plus the
+    hyperparameters the reference hard-codes, with clean boolean handling."""
+    d = Config()
+    p.add_argument("--model", type=str, default=d.model,
+                   help=f"model type: {', '.join(MODEL_TYPES)}")
+    p.add_argument("--device", type=str, default=d.device,
+                   choices=["tpu", "cpu", "auto"],
+                   help="accelerator (replaces the reference --GPU_device)")
+    p.add_argument("--batch_size", type=int, default=d.batch_size)
+    p.add_argument("--epoch_num", type=int, default=d.epoch_num)
+    p.add_argument("--lr", type=float, default=d.lr)
+    p.add_argument("--weight_decay", type=float, default=d.weight_decay)
+    p.add_argument("--lr_decay_factor", type=float, default=d.lr_decay_factor)
+    p.add_argument("--lr_decay_every", type=int, default=d.lr_decay_every)
+    p.add_argument("--val_every", type=int, default=d.val_every)
+    p.add_argument("--random_state", type=int, default=d.random_state)
+    p.add_argument("--fold_index", type=int, default=None,
+                   help="5-fold CV fold; omit for the holdout split")
+    p.add_argument("--test_rate", type=float, default=d.test_rate)
+    p.add_argument("--output_savedir", type=str, default=d.output_savedir)
+    p.add_argument("--model_path", type=str, default=None,
+                   help="checkpoint directory to restore weights from")
+    p.add_argument("--dataset_ram", action=argparse.BooleanOptionalAction,
+                   default=d.dataset_ram,
+                   help="preload all .mat files into host RAM")
+    p.add_argument("--trainVal_set_striking", dest="trainval_set_striking",
+                   type=str, default=d.trainval_set_striking)
+    p.add_argument("--trainVal_set_excavating", dest="trainval_set_excavating",
+                   type=str, default=d.trainval_set_excavating)
+    p.add_argument("--test_set_striking", type=str, default=d.test_set_striking)
+    p.add_argument("--test_set_excavating", type=str,
+                   default=d.test_set_excavating)
+    p.add_argument("--dp", type=int, default=d.dp,
+                   help="data-parallel devices (-1 = all)")
+    p.add_argument("--sp", type=int, default=d.sp,
+                   help="spatial-parallel devices over the fiber axis")
+    p.add_argument("--compute_dtype", type=str, default=d.compute_dtype,
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--seed", type=int, default=d.seed)
+    p.add_argument("--noise_snr_db", type=float, default=None,
+                   help="opt-in Gaussian noise SNR (dB) for robustness evals")
+    p.add_argument("--use_pallas", action=argparse.BooleanOptionalAction,
+                   default=d.use_pallas)
+    p.add_argument("--resume", action=argparse.BooleanOptionalAction,
+                   default=d.resume)
+    p.add_argument("--profile_dir", type=str, default=None)
+
+
+def parse_train_args(argv=None) -> Config:
+    p = argparse.ArgumentParser(description="dasmtl model training (TPU-native)")
+    _add_shared_args(p)
+    ns = p.parse_args(argv)
+    return Config(**vars(ns))
+
+
+def parse_test_args(argv=None) -> Config:
+    p = argparse.ArgumentParser(description="dasmtl model evaluation (TPU-native)")
+    _add_shared_args(p)
+    ns = p.parse_args(argv)
+    return Config(**vars(ns))

@@ -1,0 +1,5 @@
+from dasmtl.data.collector import DataCollector  # noqa: F401
+from dasmtl.data.splits import DatasetSplits, build_splits  # noqa: F401
+from dasmtl.data.sources import ArraySource, DiskSource, RamSource  # noqa: F401
+from dasmtl.data.pipeline import BatchIterator, eval_batches  # noqa: F401
+from dasmtl.data.synthetic import make_synthetic_dataset  # noqa: F401

@@ -1,0 +1,68 @@
+"""Dataset directory discovery.
+
+Equivalent of the reference `DataCollector` (dataset_preparation.py:17-80):
+one dataset directory per event class, containing one subdirectory per distance
+category named like ``"<k>m"`` (``0m`` … ``15m``), each holding MATLAB ``.mat``
+files whose array of interest lives under a known key (``'data'``).
+
+Behavioral parity notes:
+- Categories are sorted by the first integer in the directory name
+  (reference dataset_preparation.py:45).
+- File lists come from ``os.listdir`` order, like the reference
+  (dataset_preparation.py:49) — the downstream split engine's RNG is what
+  fixes determinism, so we additionally sort file names for cross-filesystem
+  stability (documented difference: ``os.listdir`` order is filesystem-
+  dependent, so the reference's exact splits are only reproducible on the
+  machine that produced them; sorting makes ours portable).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Sequence
+
+from dasmtl.data import matio
+
+
+class DataCollector:
+    """Walks one event-class dataset directory and caches per-category paths."""
+
+    def __init__(self, dir_path: str, key_list: Sequence[str] = ("data",),
+                 sort_files: bool = True):
+        self.dir_path = dir_path
+        self.key_list = list(key_list)
+        self.sort_files = sort_files
+        self.files_by_category: Dict[str, List[str]] = {}
+        for category in self.get_all_categories():
+            self.files_by_category[category] = (
+                self.get_file_list_by_category(category))
+
+    def get_all_categories(self) -> List[str]:
+        """Subdirectory names sorted by the integer embedded in each name."""
+        names = [n for n in os.listdir(self.dir_path)
+                 if os.path.isdir(os.path.join(self.dir_path, n))]
+        return sorted(names, key=lambda n: int(re.findall(r"\d+", n)[0]))
+
+    def get_file_list_by_category(self, category: str) -> List[str]:
+        cat_dir = os.path.join(self.dir_path, category)
+        names = os.listdir(cat_dir)
+        if self.sort_files:
+            names = sorted(names)
+        return [os.path.join(cat_dir, n) for n in names]
+
+    def get_one_mat(self, file_path: str):
+        return matio.load_mat(file_path, self.key_list)
+
+    def get_mat_by_category_index(self, category: str, index: int):
+        return self.get_one_mat(self.files_by_category[category][index])
+
+
+def distance_label_from_category(category: str) -> int:
+    """``"7m" -> 7``; reference uses ``int(category1[:-1])``
+    (dataset_preparation.py:143) which breaks on names like ``"7meters"`` —
+    we parse the leading integer instead."""
+    m = re.match(r"\s*(\d+)", category)
+    if m is None:
+        raise ValueError(f"category name {category!r} has no leading integer")
+    return int(m.group(1))

@@ -1,0 +1,86 @@
+"""Static-shape batch pipeline.
+
+The reference iterates a single-process ``DataLoader`` (batch 32, shuffle on,
+``num_workers=0``, utils.py:152-156) and tolerates a ragged final batch.  Under
+``jit`` a ragged batch means a recompile, so every batch here has exactly
+``batch_size`` rows: the final partial batch is zero-padded and carries a
+``weight`` vector (1 real / 0 padding) that the loss and metrics honor.  This
+also keeps the leading axis divisible for ``NamedSharding`` over the
+data-parallel mesh axis.
+
+A batch is a dict of numpy arrays:
+  ``x``        [B, H, W, 1] float32  (NHWC)
+  ``distance`` [B] int32             radial-distance bin, 0..15
+  ``event``    [B] int32             0 striking / 1 excavating
+  ``weight``   [B] float32           1.0 real example, 0.0 padding
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterator
+
+import numpy as np
+
+from dasmtl.data.sources import _SourceBase
+
+Batch = Dict[str, np.ndarray]
+
+
+def _make_batch(source: _SourceBase, idx: np.ndarray, batch_size: int) -> Batch:
+    n_real = idx.shape[0]
+    x = source.gather(idx)
+    distance = source.distance[idx]
+    event = source.event[idx]
+    weight = np.ones((n_real,), np.float32)
+    if n_real < batch_size:
+        pad = batch_size - n_real
+        x = np.concatenate(
+            [x, np.zeros((pad,) + x.shape[1:], x.dtype)], axis=0)
+        distance = np.concatenate([distance, np.zeros((pad,), np.int32)])
+        event = np.concatenate([event, np.zeros((pad,), np.int32)])
+        weight = np.concatenate([weight, np.zeros((pad,), np.float32)])
+    return {"x": x, "distance": distance, "event": event, "weight": weight}
+
+
+class BatchIterator:
+    """Shuffled, epoch-addressable train batches with static shapes.
+
+    Shuffling is derived from ``(seed, epoch)`` so any epoch's order is
+    reproducible independently — the hook that makes exact mid-training resume
+    possible (the reference cannot resume at all, SURVEY.md §3.5).
+    """
+
+    def __init__(self, source: _SourceBase, batch_size: int, *,
+                 seed: int = 0, shuffle: bool = True, drop_last: bool = False):
+        self.source = source
+        self.batch_size = batch_size
+        self.seed = seed
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+
+    def steps_per_epoch(self) -> int:
+        n = len(self.source)
+        if self.drop_last:
+            return n // self.batch_size
+        return math.ceil(n / self.batch_size)
+
+    def epoch(self, epoch_idx: int) -> Iterator[Batch]:
+        n = len(self.source)
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(
+                np.random.SeedSequence([self.seed, epoch_idx]))
+            order = rng.permutation(n)
+        stop = (n // self.batch_size) * self.batch_size if self.drop_last else n
+        for start in range(0, stop, self.batch_size):
+            idx = order[start:start + self.batch_size]
+            yield _make_batch(self.source, idx, self.batch_size)
+
+
+def eval_batches(source: _SourceBase, batch_size: int) -> Iterator[Batch]:
+    """Deterministic-order padded batches covering every example once."""
+    n = len(source)
+    for start in range(0, n, batch_size):
+        idx = np.arange(start, min(start + batch_size, n))
+        yield _make_batch(source, idx, batch_size)

@@ -1,0 +1,91 @@
+"""Example sources: RAM-preloaded, lazy-disk, and in-memory arrays.
+
+TPU-shaped replacements for the reference ``Datasetram`` (eager preload,
+dataset_preparation.py:252-297) and ``DatasetDisk`` (lazy ``loadmat`` per item,
+dataset_preparation.py:300-344).  Instead of per-item ``__getitem__`` +
+DataLoader collation, a source exposes vectorized ``gather(indices)`` returning
+a ready NHWC batch — the batcher in :mod:`dasmtl.data.pipeline` handles
+shuffling, padding and sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from dasmtl.data import matio
+from dasmtl.data.splits import Example
+from dasmtl.data.transforms import add_gaussian_snr, to_sample
+
+
+class _SourceBase:
+    distance: np.ndarray  # [N] int32
+    event: np.ndarray  # [N] int32
+
+    def __len__(self) -> int:
+        return self.distance.shape[0]
+
+    def gather(self, indices: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+
+def _load_one(path: str, key: str, noise_snr_db: Optional[float],
+              rng: Optional[np.random.Generator]) -> np.ndarray:
+    mat = matio.load_mat(path, (key,))
+    if noise_snr_db is not None:
+        mat = add_gaussian_snr(mat, noise_snr_db, rng)
+    return to_sample(mat)
+
+
+class RamSource(_SourceBase):
+    """Eagerly loads every example into one contiguous [N, H, W, 1] array."""
+
+    def __init__(self, examples: Sequence[Example], key: str = "data",
+                 noise_snr_db: Optional[float] = None,
+                 noise_seed: int = 0, show_progress: bool = False):
+        self.examples = list(examples)
+        rng = np.random.default_rng(noise_seed)
+        it = self.examples
+        if show_progress:
+            from tqdm import tqdm
+            it = tqdm(it, desc="preloading .mat files")
+        mats = [_load_one(ex.path, key, noise_snr_db, rng) for ex in it]
+        self.x = np.stack(mats) if mats else np.zeros((0, 0, 0, 1), np.float32)
+        self.distance = np.array([ex.distance for ex in self.examples], np.int32)
+        self.event = np.array([ex.event for ex in self.examples], np.int32)
+
+    def gather(self, indices: np.ndarray) -> np.ndarray:
+        return self.x[indices]
+
+
+class DiskSource(_SourceBase):
+    """Loads .mat files lazily at gather time."""
+
+    def __init__(self, examples: Sequence[Example], key: str = "data",
+                 noise_snr_db: Optional[float] = None, noise_seed: int = 0):
+        self.examples = list(examples)
+        self.key = key
+        self.noise_snr_db = noise_snr_db
+        self._rng = np.random.default_rng(noise_seed)
+        self.distance = np.array([ex.distance for ex in self.examples], np.int32)
+        self.event = np.array([ex.event for ex in self.examples], np.int32)
+
+    def gather(self, indices: np.ndarray) -> np.ndarray:
+        return np.stack([
+            _load_one(self.examples[i].path, self.key, self.noise_snr_db,
+                      self._rng)
+            for i in np.asarray(indices)])
+
+
+class ArraySource(_SourceBase):
+    """Wraps already-materialized arrays (tests, synthetic data)."""
+
+    def __init__(self, x: np.ndarray, distance: np.ndarray, event: np.ndarray):
+        assert x.shape[0] == distance.shape[0] == event.shape[0]
+        self.x = np.asarray(x, np.float32)
+        self.distance = np.asarray(distance, np.int32)
+        self.event = np.asarray(event, np.int32)
+
+    def gather(self, indices: np.ndarray) -> np.ndarray:
+        return self.x[indices]

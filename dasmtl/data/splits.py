@@ -1,0 +1,105 @@
+"""Train/val split engine with reference parity.
+
+Reproduces the semantics of ``Dataset_mat_MTL.__init__``
+(reference dataset_preparation.py:118-239):
+
+- per-category, per-event-class holdout split via sklearn
+  ``train_test_split(test_size=0.17647, random_state)`` (≈ 3/17;
+  dataset_preparation.py:152-155), the *same* ``random_state`` reused for every
+  category and both event classes;
+- or 5-fold ``KFold(shuffle=True, random_state)`` when ``fold_index`` is given
+  (dataset_preparation.py:157-166);
+- ``is_test=True`` puts every file in both the train and val lists with no
+  split (dataset_preparation.py:139-147);
+- labels are ``(distance_bin, event_id)`` with event 0 = striking,
+  1 = excavating (dataset_preparation.py:143,183);
+- ``multi_categories`` collapses the pair to ``distance + 16 * event``
+  (dataset_preparation.py:216-224) — here that mapping lives in
+  :func:`mixed_label` and is applied by the pipeline, not baked into the split.
+
+sklearn is kept as a split-only dependency on purpose: matching its shuffle
+permutation bit-for-bit is the cheap, faithful route to reference-identical
+file partitions (SURVEY.md §7 hard parts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from sklearn.model_selection import KFold, train_test_split
+
+from dasmtl.data.collector import DataCollector, distance_label_from_category
+
+EVENT_STRIKING = 0
+EVENT_EXCAVATING = 1
+
+
+@dataclasses.dataclass
+class Example:
+    path: str
+    distance: int
+    event: int
+
+
+@dataclasses.dataclass
+class DatasetSplits:
+    train: List[Example]
+    val: List[Example]
+
+
+def _split_one_category(files: Sequence[str], *, test_rate: float,
+                        random_state: int, fold_index: Optional[int],
+                        ) -> Tuple[List[str], List[str]]:
+    files = list(files)
+    if fold_index is None:
+        return train_test_split(files, test_size=test_rate,
+                                random_state=random_state)
+    kf = KFold(n_splits=5, shuffle=True, random_state=random_state)
+    folds = list(kf.split(files))
+    train_idx, val_idx = folds[fold_index]
+    return ([files[i] for i in train_idx], [files[i] for i in val_idx])
+
+
+def build_splits(striking_dir: str, excavating_dir: str, *,
+                 test_rate: float = 0.17647, random_state: int = 1,
+                 fold_index: Optional[int] = None, is_test: bool = False,
+                 mat_keys: Sequence[str] = ("data",)) -> DatasetSplits:
+    """Discover both event-class trees and produce the train/val file lists."""
+    train: List[Example] = []
+    val: List[Example] = []
+    for event_id, dir_path in ((EVENT_STRIKING, striking_dir),
+                               (EVENT_EXCAVATING, excavating_dir)):
+        collector = DataCollector(dir_path, mat_keys)
+        for category in collector.get_all_categories():
+            files = collector.files_by_category[category]
+            distance = distance_label_from_category(category)
+            if is_test:
+                examples = [Example(f, distance, event_id) for f in files]
+                train.extend(examples)
+                val.extend(examples)
+                continue
+            tr, va = _split_one_category(
+                files, test_rate=test_rate, random_state=random_state,
+                fold_index=fold_index)
+            train.extend(Example(f, distance, event_id) for f in tr)
+            val.extend(Example(f, distance, event_id) for f in va)
+    return DatasetSplits(train=train, val=val)
+
+
+def mixed_label(distance: int, event: int, num_distance: int = 16) -> int:
+    """The 32-way collapsed label of the multi-classifier path
+    (reference dataset_preparation.py:220)."""
+    return distance + num_distance * event
+
+
+def export_manifest_csv(examples: Sequence[Example], path: str) -> None:
+    """Name/label manifest, equivalent of ``get_name_label_csv``
+    (reference dataset_preparation.py:275-297)."""
+    import csv
+
+    with open(path, "w", newline="", encoding="utf-8") as f:
+        w = csv.writer(f)
+        w.writerow(["mat name", "distance label", "event label"])
+        for ex in examples:
+            w.writerow([ex.path, ex.distance, ex.event])

@@ -1,0 +1,48 @@
+"""Per-sample transforms.
+
+- :func:`to_sample` — the NHWC equivalent of the reference ``data_process``
+  (dataset_preparation.py:242-249): the raw (100, 250) matrix becomes a
+  float32 ``(100, 250, 1)`` array (channel-LAST, the TPU-native layout, vs the
+  reference's channel-first ``(1, 100, 250)``).  Like the reference, no
+  normalization and no train-time augmentation.
+- :func:`add_gaussian_snr` — SNR-targeted Gaussian noise for robustness
+  evaluations, behavior-equivalent to ``add_gaussian``
+  (dataset_preparation.py:83-105) but vectorized over the whole matrix and
+  taking an explicit RNG (the reference reseeds ``np.random.seed(1)`` on every
+  call, making the "noise" deterministic and identical across samples — a
+  defect we do not copy; pass a fixed ``rng`` for reproducibility instead).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def to_sample(mat: np.ndarray) -> np.ndarray:
+    mat = np.asarray(mat)
+    if mat.ndim != 2:
+        raise ValueError(f"expected a 2-D time-space matrix, got {mat.shape}")
+    return mat.astype(np.float32)[:, :, np.newaxis]
+
+
+def add_gaussian_snr(signal: np.ndarray, snr_db: float = 8.0,
+                     rng: Optional[np.random.Generator] = None) -> np.ndarray:
+    """Add zero-mean Gaussian noise scaled so the result has ``snr_db`` SNR
+    relative to the (mean-removed) signal power, per fiber row like the
+    reference applies it (row-wise call, dataset_preparation.py:244-245)."""
+    rng = rng if rng is not None else np.random.default_rng(0)
+    signal = np.asarray(signal, dtype=np.float64)
+    out = np.empty_like(signal)
+    for i in range(signal.shape[0]):
+        row = signal[i]
+        noise = rng.standard_normal(row.shape)
+        noise = noise - noise.mean()
+        signal_power = np.linalg.norm(row - row.mean()) ** 2 / row.size
+        noise_variance = signal_power / np.power(10.0, snr_db / 10.0)
+        std = noise.std()
+        if std > 0 and noise_variance > 0:
+            noise = (np.sqrt(noise_variance) / std) * noise
+        out[i] = row + noise
+    return out

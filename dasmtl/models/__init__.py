@@ -1,0 +1,2 @@
+from dasmtl.models.two_level import (MTLNet, SingleTaskNet,  # noqa: F401
+                                     TwoLevelNet)

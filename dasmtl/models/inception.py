@@ -1,0 +1,239 @@
+"""InceptionV3 32-way multi-classifier (model C), from scratch in Flax/NHWC.
+
+The reference (model/modelC_multiClassifier.py:28-172) re-assembles
+torchvision's InceptionV3 with a 1-channel stem (``Conv2d_1a_3x3 =
+conv_block(1, 32, ...)``, :63) and ``num_classes=32`` (:35), importing the
+InceptionA..E/Aux blocks from torchvision (:7).  torchvision does not exist in
+a JAX stack, so every block is reimplemented here natively (SURVEY.md §7
+step 3): BasicConv (conv, BN eps=1e-3, ReLU), the A-E mixed blocks with the
+stock branch widths, the aux head, truncated-normal(0.1) weight init matching
+the reference's init loop (:88-100), global average pool, dropout(0.5) and the
+final dense layer.
+
+Channel plan (stock InceptionV3): stem 1->32->32->64 /pool/ 80->192 /pool/,
+Mixed_5b/5c/5d (A: 256/288/288), Mixed_6a (B: 768), Mixed_6b..6e (C: 768),
+Mixed_7a (D: 1280), Mixed_7b/7c (E: 2048), fc 2048->num_classes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+
+_TRUNC_INIT = nn.initializers.truncated_normal(stddev=0.1, lower=-2.0,
+                                               upper=2.0)
+
+
+class BasicConv(nn.Module):
+    """Conv (no bias) + BatchNorm(eps=1e-3) + ReLU
+    (reference modelC_multiClassifier.py:10-25)."""
+
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = ((0, 0), (0, 0))
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=False,
+                    kernel_init=_TRUNC_INIT, dtype=self.dtype,
+                    name="conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=jnp.float32, name="bn")(x)
+        return nn.relu(x)
+
+
+def _avg_pool_3x3_same(x: jax.Array) -> jax.Array:
+    """3x3 stride-1 average pool, pad 1, count_include_pad=True (torch
+    semantics of ``F.avg_pool2d(x, 3, 1, 1)`` inside the mixed blocks)."""
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)),
+                       count_include_pad=True)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        d = self.dtype
+        b1 = BasicConv(64, (1, 1), dtype=d, name="branch1x1")(x, train)
+        b5 = BasicConv(48, (1, 1), dtype=d, name="branch5x5_1")(x, train)
+        b5 = BasicConv(64, (5, 5), padding=((2, 2), (2, 2)), dtype=d,
+                       name="branch5x5_2")(b5, train)
+        b3 = BasicConv(64, (1, 1), dtype=d, name="branch3x3dbl_1")(x, train)
+        b3 = BasicConv(96, (3, 3), padding=((1, 1), (1, 1)), dtype=d,
+                       name="branch3x3dbl_2")(b3, train)
+        b3 = BasicConv(96, (3, 3), padding=((1, 1), (1, 1)), dtype=d,
+                       name="branch3x3dbl_3")(b3, train)
+        bp = _avg_pool_3x3_same(x)
+        bp = BasicConv(self.pool_features, (1, 1), dtype=d,
+                       name="branch_pool")(bp, train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        d = self.dtype
+        b3 = BasicConv(384, (3, 3), strides=(2, 2), dtype=d,
+                       name="branch3x3")(x, train)
+        bd = BasicConv(64, (1, 1), dtype=d, name="branch3x3dbl_1")(x, train)
+        bd = BasicConv(96, (3, 3), padding=((1, 1), (1, 1)), dtype=d,
+                       name="branch3x3dbl_2")(bd, train)
+        bd = BasicConv(96, (3, 3), strides=(2, 2), dtype=d,
+                       name="branch3x3dbl_3")(bd, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels_7x7: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        d = self.dtype
+        c7 = self.channels_7x7
+        p17 = ((0, 0), (3, 3))  # (1,7) kernel
+        p71 = ((3, 3), (0, 0))  # (7,1) kernel
+        b1 = BasicConv(192, (1, 1), dtype=d, name="branch1x1")(x, train)
+        b7 = BasicConv(c7, (1, 1), dtype=d, name="branch7x7_1")(x, train)
+        b7 = BasicConv(c7, (1, 7), padding=p17, dtype=d,
+                       name="branch7x7_2")(b7, train)
+        b7 = BasicConv(192, (7, 1), padding=p71, dtype=d,
+                       name="branch7x7_3")(b7, train)
+        bd = BasicConv(c7, (1, 1), dtype=d, name="branch7x7dbl_1")(x, train)
+        bd = BasicConv(c7, (7, 1), padding=p71, dtype=d,
+                       name="branch7x7dbl_2")(bd, train)
+        bd = BasicConv(c7, (1, 7), padding=p17, dtype=d,
+                       name="branch7x7dbl_3")(bd, train)
+        bd = BasicConv(c7, (7, 1), padding=p71, dtype=d,
+                       name="branch7x7dbl_4")(bd, train)
+        bd = BasicConv(192, (1, 7), padding=p17, dtype=d,
+                       name="branch7x7dbl_5")(bd, train)
+        bp = _avg_pool_3x3_same(x)
+        bp = BasicConv(192, (1, 1), dtype=d, name="branch_pool")(bp, train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        d = self.dtype
+        b3 = BasicConv(192, (1, 1), dtype=d, name="branch3x3_1")(x, train)
+        b3 = BasicConv(320, (3, 3), strides=(2, 2), dtype=d,
+                       name="branch3x3_2")(b3, train)
+        b7 = BasicConv(192, (1, 1), dtype=d, name="branch7x7x3_1")(x, train)
+        b7 = BasicConv(192, (1, 7), padding=((0, 0), (3, 3)), dtype=d,
+                       name="branch7x7x3_2")(b7, train)
+        b7 = BasicConv(192, (7, 1), padding=((3, 3), (0, 0)), dtype=d,
+                       name="branch7x7x3_3")(b7, train)
+        b7 = BasicConv(192, (3, 3), strides=(2, 2), dtype=d,
+                       name="branch7x7x3_4")(b7, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        d = self.dtype
+        b1 = BasicConv(320, (1, 1), dtype=d, name="branch1x1")(x, train)
+        b3 = BasicConv(384, (1, 1), dtype=d, name="branch3x3_1")(x, train)
+        b3 = jnp.concatenate([
+            BasicConv(384, (1, 3), padding=((0, 0), (1, 1)), dtype=d,
+                      name="branch3x3_2a")(b3, train),
+            BasicConv(384, (3, 1), padding=((1, 1), (0, 0)), dtype=d,
+                      name="branch3x3_2b")(b3, train),
+        ], axis=-1)
+        bd = BasicConv(448, (1, 1), dtype=d, name="branch3x3dbl_1")(x, train)
+        bd = BasicConv(384, (3, 3), padding=((1, 1), (1, 1)), dtype=d,
+                       name="branch3x3dbl_2")(bd, train)
+        bd = jnp.concatenate([
+            BasicConv(384, (1, 3), padding=((0, 0), (1, 1)), dtype=d,
+                      name="branch3x3dbl_3a")(bd, train),
+            BasicConv(384, (3, 1), padding=((1, 1), (0, 0)), dtype=d,
+                      name="branch3x3dbl_3b")(bd, train),
+        ], axis=-1)
+        bp = _avg_pool_3x3_same(x)
+        bp = BasicConv(192, (1, 1), dtype=d, name="branch_pool")(bp, train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionAux(nn.Module):
+    """Auxiliary head; only usable when the Mixed_6e map is >= 5x5 (with the
+    100x250 DAS input it is not — kept for architectural completeness, off by
+    default like the reference's ``aux_logits=False``)."""
+
+    num_classes: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3))
+        x = BasicConv(128, (1, 1), dtype=self.dtype, name="conv0")(x, train)
+        x = BasicConv(768, (5, 5), dtype=self.dtype, name="conv1")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes,
+                        kernel_init=nn.initializers.truncated_normal(
+                            stddev=0.001, lower=-2.0, upper=2.0),
+                        name="fc")(x)
+
+
+class InceptionV3Classifier(nn.Module):
+    """The 32-way single-level baseline (reference model C)."""
+
+    num_classes: int = 32
+    aux_logits: bool = False
+    dropout_rate: float = 0.5
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False):
+        d = self.dtype
+        x = x.astype(d)
+        x = BasicConv(32, (3, 3), strides=(2, 2), dtype=d,
+                      name="Conv2d_1a_3x3")(x, train)
+        x = BasicConv(32, (3, 3), dtype=d, name="Conv2d_2a_3x3")(x, train)
+        x = BasicConv(64, (3, 3), padding=((1, 1), (1, 1)), dtype=d,
+                      name="Conv2d_2b_3x3")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = BasicConv(80, (1, 1), dtype=d, name="Conv2d_3b_1x1")(x, train)
+        x = BasicConv(192, (3, 3), dtype=d, name="Conv2d_4a_3x3")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = InceptionA(32, dtype=d, name="Mixed_5b")(x, train)
+        x = InceptionA(64, dtype=d, name="Mixed_5c")(x, train)
+        x = InceptionA(64, dtype=d, name="Mixed_5d")(x, train)
+        x = InceptionB(dtype=d, name="Mixed_6a")(x, train)
+        x = InceptionC(128, dtype=d, name="Mixed_6b")(x, train)
+        x = InceptionC(160, dtype=d, name="Mixed_6c")(x, train)
+        x = InceptionC(160, dtype=d, name="Mixed_6d")(x, train)
+        x = InceptionC(192, dtype=d, name="Mixed_6e")(x, train)
+        aux = None
+        if self.aux_logits and train:
+            aux = InceptionAux(self.num_classes, dtype=d,
+                               name="AuxLogits")(x, train)
+        x = InceptionD(dtype=d, name="Mixed_7a")(x, train)
+        x = InceptionE(dtype=d, name="Mixed_7b")(x, train)
+        x = InceptionE(dtype=d, name="Mixed_7c")(x, train)
+        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)  # GAP
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        logits = nn.Dense(self.num_classes, kernel_init=_TRUNC_INIT,
+                          name="fc")(x)
+        if aux is not None:
+            return (logits, aux)
+        return (logits,)

@@ -1,0 +1,135 @@
+"""Shared Flax building blocks (NHWC, TPU-native layout).
+
+Re-derivations of the reference's torch building blocks:
+- :class:`ConvBN` / :class:`ResBlock`  — reference ``ResBlock``
+  (model/modelA_MTL.py:7-32; duplicated model/modelB_singleTask.py:7-32).
+- :class:`AttentionGate` — the attention-mask generator ``att_generator``
+  (model/modelA_MTL.py:42-50).  It returns the *pre-sigmoid* mask logits so the
+  sigmoid∘multiply gate can be fused (XLA fusion, or the Pallas kernel in
+  :mod:`dasmtl.ops.gating`).
+- :func:`max_pool_ceil` — ``nn.MaxPool2d(kernel_size=2, stride=2,
+  ceil_mode=True)`` (model/modelA_MTL.py:116).  For kernel 2 / stride 2,
+  'SAME' padding with a -inf pad value is exactly torch's ceil mode.
+- :func:`group_mean_head` — the FC-free classifier head: global average pool
+  then ``AvgPool1d(k=C/num_classes)`` over the channel vector
+  (model/modelA_MTL.py:119-125, 165-169), i.e. a reshape + mean in JAX.
+
+Parity notes: torch BatchNorm2d(momentum=0.1, eps=1e-5) corresponds to Flax
+``BatchNorm(momentum=0.9, epsilon=1e-5)`` (Flax momentum is the running-stat
+decay).  Convs inside ``att_generator`` carry biases (torch default); all other
+convs are bias-free like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+class ConvBN(nn.Module):
+    """Conv2D (no bias unless asked) followed by BatchNorm."""
+
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = ((0, 0), (0, 0))
+    use_bias: bool = False
+    bn_eps: float = 1e-5
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        x = nn.Conv(self.features, self.kernel, strides=self.strides,
+                    padding=self.padding, use_bias=self.use_bias,
+                    dtype=self.dtype, name="conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=self.bn_eps, dtype=jnp.float32,
+                         name="bn")(x)
+        return x
+
+
+class ResBlock(nn.Module):
+    """Basic residual block: Conv3x3(s)-BN-ReLU-Conv3x3-BN, 1x1 projection
+    shortcut when the stride or channel count changes, post-add ReLU."""
+
+    features: int
+    stride: int = 1
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        s = (self.stride, self.stride)
+        y = ConvBN(self.features, (3, 3), s, ((1, 1), (1, 1)),
+                   dtype=self.dtype, name="conv_bn1")(x, train)
+        y = nn.relu(y)
+        y = ConvBN(self.features, (3, 3), (1, 1), ((1, 1), (1, 1)),
+                   dtype=self.dtype, name="conv_bn2")(y, train)
+        shortcut = x
+        if self.stride != 1 or x.shape[-1] != self.features:
+            shortcut = ConvBN(self.features, (1, 1), s, ((0, 0), (0, 0)),
+                              dtype=self.dtype, name="shortcut")(x, train)
+        return nn.relu(y + shortcut)
+
+
+class AttentionGate(nn.Module):
+    """Attention-mask generator; returns pre-sigmoid mask logits.
+
+    Conv1x1(bias) -> BN -> ReLU -> Conv3x3(bias, pad 1) -> BN.  The reference
+    appends Sigmoid here (model/modelA_MTL.py:50); we defer it to the fused
+    gate application.
+    """
+
+    mid_features: int
+    out_features: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        x = ConvBN(self.mid_features, (1, 1), (1, 1), ((0, 0), (0, 0)),
+                   use_bias=True, dtype=self.dtype, name="reduce")(x, train)
+        x = nn.relu(x)
+        x = ConvBN(self.out_features, (3, 3), (1, 1), ((1, 1), (1, 1)),
+                   use_bias=True, dtype=self.dtype, name="expand")(x, train)
+        return x
+
+
+class OutputLayer(nn.Module):
+    """Per-stage task-branch encoder: Conv3x3 -> BN -> ReLU
+    (model/modelA_MTL.py:101-113)."""
+
+    features: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        x = ConvBN(self.features, (3, 3), (1, 1), ((1, 1), (1, 1)),
+                   dtype=self.dtype, name="conv_bn")(x, train)
+        return nn.relu(x)
+
+
+def max_pool_ceil(x: jax.Array) -> jax.Array:
+    """2x2/2 max pool with torch ``ceil_mode=True`` semantics."""
+    return nn.max_pool(x, (2, 2), strides=(2, 2), padding="SAME")
+
+
+def group_mean_head(x: jax.Array, num_classes: int) -> jax.Array:
+    """GAP over (H, W) then mean over contiguous channel groups -> logits."""
+    g = jnp.mean(x, axis=(1, 2))  # [B, C]
+    b, c = g.shape
+    if c % num_classes != 0:
+        raise ValueError(f"channels {c} not divisible by classes {num_classes}")
+    return jnp.mean(g.reshape(b, num_classes, c // num_classes), axis=-1)
+
+
+def backbone_channels(first_ch: int, res_num: int) -> Sequence[int]:
+    """Reference channel schedule (model/modelA_MTL.py:64-66):
+    ``[16, 16, 32, 64, 128]`` for first_ch=16, res_num=8."""
+    ch = [first_ch, first_ch]
+    for i in range(res_num // 2 - 1):
+        ch.append(first_ch * (2 ** (i + 1)))
+    return ch

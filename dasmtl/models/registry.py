@@ -1,0 +1,105 @@
+"""Model registry: one spec per reference model family.
+
+Replaces the reference's if/elif construction chain (utils.py:85-98) and the
+three near-duplicate trainer engines it dispatches to (utils.py:158-178) with
+declarative specs: how to build the module, which loss to apply, which task
+heads to report, and how to decode device outputs into per-task predictions
+(the multi-classifier decodes its 32-way argmax back into (distance, event)
+via ``mixed % 16`` / ``mixed // 16``, the reference's ``hash_list`` mapping at
+utils.py:600).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Tuple
+
+import jax.numpy as jnp
+
+from dasmtl.config import (NUM_DISTANCE_CLASSES, NUM_EVENT_CLASSES,
+                           NUM_MIXED_CLASSES, Config)
+from dasmtl.models.inception import InceptionV3Classifier
+from dasmtl.models.two_level import MTLNet, SingleTaskNet
+from dasmtl.train import losses
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    build: Callable  # Config -> nn.Module
+    loss_fn: Callable  # (outputs, batch) -> (loss, parts)
+    # Task heads reported during validation: (task_name, num_classes).
+    report_tasks: Tuple[Tuple[str, int], ...]
+    decode: Callable  # outputs -> {task: predicted labels [B]}
+    uses_dropout: bool = False
+
+
+def _dtype(cfg: Config):
+    return jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+
+
+def _decode_mtl(outputs) -> Dict[str, jnp.ndarray]:
+    return {"distance": jnp.argmax(outputs[0], axis=-1),
+            "event": jnp.argmax(outputs[1], axis=-1)}
+
+
+def _decode_single(task: str):
+    def decode(outputs):
+        return {task: jnp.argmax(outputs[0], axis=-1)}
+    return decode
+
+
+def _decode_mixed(outputs) -> Dict[str, jnp.ndarray]:
+    mixed = jnp.argmax(outputs[0], axis=-1)
+    return {"mixed": mixed,
+            "distance": mixed % NUM_DISTANCE_CLASSES,
+            "event": mixed // NUM_DISTANCE_CLASSES}
+
+
+_REGISTRY = {
+    "MTL": ModelSpec(
+        name="MTL",
+        build=lambda cfg: MTLNet(dtype=_dtype(cfg),
+                                 use_pallas=cfg.use_pallas),
+        loss_fn=losses.mtl_loss,
+        report_tasks=(("distance", NUM_DISTANCE_CLASSES),
+                      ("event", NUM_EVENT_CLASSES)),
+        decode=_decode_mtl,
+    ),
+    "single_distance": ModelSpec(
+        name="single_distance",
+        build=lambda cfg: SingleTaskNet("distance", dtype=_dtype(cfg),
+                                        use_pallas=cfg.use_pallas),
+        loss_fn=lambda outputs, batch: losses.single_task_loss(
+            outputs, batch, "distance"),
+        report_tasks=(("distance", NUM_DISTANCE_CLASSES),),
+        decode=_decode_single("distance"),
+    ),
+    "single_event": ModelSpec(
+        name="single_event",
+        build=lambda cfg: SingleTaskNet("event", dtype=_dtype(cfg),
+                                        use_pallas=cfg.use_pallas),
+        loss_fn=lambda outputs, batch: losses.single_task_loss(
+            outputs, batch, "event"),
+        report_tasks=(("event", NUM_EVENT_CLASSES),),
+        decode=_decode_single("event"),
+    ),
+    "multi_classifier": ModelSpec(
+        name="multi_classifier",
+        build=lambda cfg: InceptionV3Classifier(num_classes=NUM_MIXED_CLASSES,
+                                                dtype=_dtype(cfg)),
+        loss_fn=losses.multi_classifier_loss,
+        report_tasks=(("mixed", NUM_MIXED_CLASSES),
+                      ("distance", NUM_DISTANCE_CLASSES),
+                      ("event", NUM_EVENT_CLASSES)),
+        decode=_decode_mixed,
+        uses_dropout=True,
+    ),
+}
+
+
+def get_model_spec(name: str) -> ModelSpec:
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown model {name!r}; "
+                         f"registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]

@@ -1,0 +1,98 @@
+"""The two-level multi-task network and its single-task variant.
+
+Flax/NHWC re-derivation of the paper's model A (reference
+model/modelA_MTL.py:53-174) and model B (model/modelB_singleTask.py:53-178),
+which share one architecture parameterized by the task tuple:
+
+- **Shared backbone**: Conv7x7 stride 3 pad 2 + BN + ReLU, then 8 ResBlocks
+  with channels [16,16,32,32,64,64,128,128] and strides [1,1,2,1,2,1,2,1]
+  (modelA_MTL.py:73-87).  For a (100, 250) input the feature maps run
+  33x83 -> 17x42 -> 9x21 -> 5x11 (SURVEY.md §3.3).
+- **Task branches** (one per task): 4 cascaded attention stages.  Stage k
+  builds a sigmoid mask from ``concat(shared[2k-2], prev_out)`` (stage 1: just
+  ``shared[0]``), gates ``shared[2k-1]`` with it, and (stages 1-3) passes the
+  result through a Conv3x3-BN-ReLU encoder + ceil-mode 2x2 max pool
+  (modelA_MTL.py:91-116, 142-163).
+- **Heads**: global average pool then a channel-group mean — 128 channels
+  grouped into 16 (distance) or 2 (event) logits with *no* FC layer
+  (modelA_MTL.py:119-125, 165-169) — then log-softmax.
+
+The whole forward is a single XLA computation; both task branches are traced
+in one graph, so XLA overlaps them freely on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dasmtl.models.layers import (AttentionGate, ConvBN, OutputLayer, ResBlock,
+                                  backbone_channels, group_mean_head,
+                                  max_pool_ceil)
+from dasmtl.ops.gating import gate_apply
+
+TASK_NUM_CLASSES = {"distance": 16, "event": 2}
+
+
+class TwoLevelNet(nn.Module):
+    """Shared backbone + per-task cascaded attention branches."""
+
+    tasks: Tuple[str, ...] = ("distance", "event")
+    res_num: int = 8
+    first_ch: int = 16
+    dtype: Any = jnp.float32
+    use_pallas: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False):
+        ch = backbone_channels(self.first_ch, self.res_num)  # [16,16,32,64,128]
+        block_ch = [ch[1], ch[1], ch[2], ch[2], ch[3], ch[3], ch[4], ch[4]]
+        strides = [1, 1, 2, 1, 2, 1, 2, 1]
+
+        x = x.astype(self.dtype)
+        x = ConvBN(ch[0], (7, 7), (3, 3), ((2, 2), (2, 2)),
+                   dtype=self.dtype, name="conv1")(x, train)
+        x = nn.relu(x)
+
+        shared = []
+        for i, (c, s) in enumerate(zip(block_ch, strides)):
+            x = ResBlock(c, s, dtype=self.dtype, name=f"resblock{i + 1}")(
+                x, train)
+            shared.append(x)
+
+        preds = []
+        for task in self.tasks:
+            a = None
+            for k in range(1, 5):
+                skip = shared[2 * k - 2]
+                inp = skip if a is None else jnp.concatenate([skip, a], axis=-1)
+                mask_logits = AttentionGate(
+                    ch[k] // 2, ch[k], dtype=self.dtype,
+                    name=f"{task}_att{k}")(inp, train)
+                a = gate_apply(mask_logits, shared[2 * k - 1],
+                               use_pallas=self.use_pallas)
+                if k < 4:
+                    a = OutputLayer(ch[k + 1], dtype=self.dtype,
+                                    name=f"{task}_out{k}")(a, train)
+                    a = max_pool_ceil(a)
+            logits = group_mean_head(a.astype(jnp.float32),
+                                     TASK_NUM_CLASSES[task])
+            preds.append(nn.log_softmax(logits, axis=-1))
+        return tuple(preds)
+
+
+def MTLNet(dtype: Any = jnp.float32, use_pallas: bool = False) -> TwoLevelNet:
+    """Model A: both tasks (reference model/modelA_MTL.py:53)."""
+    return TwoLevelNet(tasks=("distance", "event"), dtype=dtype,
+                       use_pallas=use_pallas)
+
+
+def SingleTaskNet(task: str, dtype: Any = jnp.float32,
+                  use_pallas: bool = False) -> TwoLevelNet:
+    """Model B: one task branch (reference model/modelB_singleTask.py:53)."""
+    if task not in TASK_NUM_CLASSES:
+        raise ValueError(f"unknown task {task!r}")
+    return TwoLevelNet(tasks=(task,), dtype=dtype, use_pallas=use_pallas)

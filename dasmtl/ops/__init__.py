@@ -1,0 +1,1 @@
+from dasmtl.ops.gating import gate_apply  # noqa: F401

@@ -1,0 +1,3 @@
+from dasmtl.parallel.mesh import (MeshPlan, batch_sharding,  # noqa: F401
+                                  create_mesh, replicated_sharding,
+                                  shard_batch)

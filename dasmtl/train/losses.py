@@ -1,0 +1,50 @@
+"""Losses.
+
+The reference trains with ``nn.NLLLoss`` on log-softmax outputs for the
+MTL/single-task models (utils.py:136-137; mean reduction) and
+``nn.CrossEntropyLoss`` on raw logits for the multi-classifier
+(utils.py:138-139) — numerically the same quantity.  The MTL loss is the
+plain unweighted sum of the two task NLLs (utils.py:361-367).
+
+All losses here take a per-example ``weight`` vector (1 real / 0 padding) and
+normalize by the real-example count, so padded static-shape batches produce
+identical values to ragged batches.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def weighted_nll(log_probs: jax.Array, labels: jax.Array,
+                 weight: jax.Array) -> jax.Array:
+    """Mean negative log-likelihood over real (weight>0) examples.
+
+    ``log_probs`` [B, C] must already be log-softmax outputs (the models emit
+    log-probabilities, like the reference's forward at modelA_MTL.py:171-172).
+    """
+    picked = jnp.take_along_axis(log_probs, labels[:, None], axis=1)[:, 0]
+    denom = jnp.maximum(weight.sum(), 1.0)
+    return -(picked * weight).sum() / denom
+
+
+def mtl_loss(outputs, batch):
+    """Sum of per-task NLLs (utils.py:361-367). Returns (loss, per-task)."""
+    l_d = weighted_nll(outputs[0], batch["distance"], batch["weight"])
+    l_e = weighted_nll(outputs[1], batch["event"], batch["weight"])
+    return l_d + l_e, {"distance": l_d, "event": l_e}
+
+
+def single_task_loss(outputs, batch, task: str):
+    l = weighted_nll(outputs[0], batch[task], batch["weight"])
+    return l, {task: l}
+
+
+def multi_classifier_loss(outputs, batch):
+    """Cross-entropy on the 32-way mixed label distance + 16*event."""
+    mixed = batch["distance"] + 16 * batch["event"]
+    logits = outputs[0]
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    l = weighted_nll(log_probs, mixed, batch["weight"])
+    return l, {"mixed": l}

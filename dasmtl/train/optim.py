@@ -1,0 +1,45 @@
+"""Optimizer and LR schedule with torch-parity semantics.
+
+The reference uses ``torch.optim.Adam(lr=1e-3, weight_decay=1e-5)`` for every
+model (utils.py:133-134).  Torch Adam's ``weight_decay`` is *coupled L2*: the
+decay term ``wd * theta`` is added to the gradient **before** the Adam moment
+updates.  That is ``optax.add_decayed_weights`` placed *before*
+``optax.scale_by_adam`` — and explicitly **not** ``optax.adamw`` (decoupled),
+which would silently change the optimization trajectory (SURVEY.md §7).
+
+The learning rate is stepped: divided by ``factor`` (1.5) every
+``every`` (5) epochs, *including* epoch 0 for the MTL/single-task trainers
+(utils.py:230-233, 245-247 — so the first effective LR is 1e-3/1.5) and
+*excluding* epoch 0 for the multi-classifier trainer (utils.py:622-625).
+The LR enters the jitted step as a traced scalar, so changing it never
+recompiles.
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def coupled_adam(weight_decay: float = 1e-5, b1: float = 0.9,
+                 b2: float = 0.999, eps: float = 1e-8,
+                 ) -> optax.GradientTransformation:
+    """Adam with torch-style coupled L2; produces a *descent direction*
+    (already negated); the caller scales by the current LR."""
+    return optax.chain(
+        optax.add_decayed_weights(weight_decay),
+        optax.scale_by_adam(b1=b1, b2=b2, eps=eps),
+        optax.scale(-1.0),
+    )
+
+
+def stepped_lr(epoch: int, *, base_lr: float = 1e-3, factor: float = 1.5,
+               every: int = 5, decay_at_epoch0: bool = True) -> float:
+    """LR in effect during ``epoch`` under the reference's decay rule.
+
+    MTL/single-task (decay_at_epoch0=True): decays fire at epochs 0, 5, 10...
+    so epoch e has lr = base / factor**(e//every + 1).
+    Multi-classifier (decay_at_epoch0=False): decays fire at 5, 10, ... so
+    epoch e has lr = base / factor**(e//every).
+    """
+    steps = epoch // every + (1 if decay_at_epoch0 else 0)
+    return base_lr / (factor ** steps)

@@ -1,0 +1,49 @@
+"""Full training state — the unit of checkpointing and resume.
+
+The reference persists model weights only (``torch.save(model.state_dict())``,
+utils.py:329-334): no optimizer moments, no epoch counter, no RNG — true resume
+is impossible there (SURVEY.md §3.5).  ``TrainState`` carries everything needed
+to continue a run bit-for-bit: params, BatchNorm running stats, Adam moments,
+the step/epoch counters and the data-shuffle seed all travel through Orbax.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class TrainState(flax.struct.PyTreeNode):
+    step: jax.Array
+    epoch: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    rng: jax.Array  # base PRNG key; per-step keys are folded in from `step`
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+
+    @classmethod
+    def create(cls, *, apply_fn, params, batch_stats, tx,
+               rng=None) -> "TrainState":
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return cls(step=jnp.zeros((), jnp.int32),
+                   epoch=jnp.zeros((), jnp.int32),
+                   params=params, batch_stats=batch_stats,
+                   opt_state=tx.init(params), rng=rng,
+                   apply_fn=apply_fn, tx=tx)
+
+    def apply_updates(self, grads, lr) -> "TrainState":
+        """One optimizer step; ``lr`` is a traced scalar (no recompiles when
+        the schedule changes it between epochs)."""
+        updates, new_opt_state = self.tx.update(grads, self.opt_state,
+                                                self.params)
+        updates = jax.tree.map(lambda u: lr * u, updates)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(step=self.step + 1, params=new_params,
+                            opt_state=new_opt_state)

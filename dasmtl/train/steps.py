@@ -1,0 +1,109 @@
+"""Jitted train / eval steps.
+
+The reference's inner loop — forward, summed NLL, ``zero_grad/backward/step``
+(utils.py:346-374) plus the per-batch host metric reads — becomes ONE compiled
+XLA computation per step here: forward + loss + backward + coupled-Adam update
++ BatchNorm stat update + prediction decode, traced once and reused for the
+whole run.  Metric values cross back to the host as a handful of scalars
+(the reference syncs whole tensors with ``.cpu()`` every step,
+utils.py:377-380).
+
+Under a ``Mesh`` the same jitted functions run data/spatial-parallel: batches
+arrive sharded (``dasmtl.parallel.shard_batch``), parameters replicated, and
+XLA inserts the gradient all-reduce and BatchNorm cross-device reductions over
+ICI.  Note the BatchNorm consequence: statistics are computed over the *global*
+batch (sync-BN) — with per-device batch equal to the reference's 32 this
+differs from per-replica stats; documented design choice (SURVEY.md §7 step 5).
+
+The learning rate is a traced argument, so the stepped schedule never triggers
+a recompile.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dasmtl.models.registry import ModelSpec
+from dasmtl.train.state import TrainState
+
+Batch = Dict[str, jax.Array]
+
+
+def _weighted_correct(preds: jax.Array, labels: jax.Array,
+                      weight: jax.Array) -> jax.Array:
+    return ((preds == labels).astype(jnp.float32) * weight).sum()
+
+
+def _batch_labels(batch: Batch) -> Dict[str, jax.Array]:
+    labels = {"distance": batch["distance"], "event": batch["event"]}
+    labels["mixed"] = batch["distance"] + 16 * batch["event"]
+    return labels
+
+
+def make_train_step(spec: ModelSpec):
+    """Returns ``train_step(state, batch, lr) -> (state, metrics)``.
+
+    Metrics are *sums* (weighted correct counts, weighted loss sums, example
+    counts) so the host can window/normalize them exactly (the reference's
+    running 100-batch windows, utils.py:376-398)."""
+
+    def train_step(state: TrainState, batch: Batch,
+                   lr: jax.Array) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        step_rng = jax.random.fold_in(state.rng, state.step)
+
+        def loss_fn(params):
+            variables = {"params": params, "batch_stats": state.batch_stats}
+            rngs = {"dropout": step_rng} if spec.uses_dropout else None
+            outputs, mutated = state.apply_fn(
+                variables, batch["x"], train=True, mutable=["batch_stats"],
+                rngs=rngs)
+            loss, parts = spec.loss_fn(outputs, batch)
+            return loss, (parts, mutated["batch_stats"], outputs)
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        (loss, (parts, new_batch_stats, outputs)), grads = grad_fn(state.params)
+        new_state = state.apply_updates(grads, lr).replace(
+            batch_stats=new_batch_stats)
+
+        preds = spec.decode(outputs)
+        labels = _batch_labels(batch)
+        weight = batch["weight"]
+        n = weight.sum()
+        metrics = {"loss": loss, "count": n}
+        for task in preds:
+            metrics[f"correct_{task}"] = _weighted_correct(
+                preds[task], labels[task], weight)
+        for k, v in parts.items():
+            metrics[f"loss_{k}"] = v
+        return new_state, metrics
+
+    return jax.jit(train_step, donate_argnums=(0,))
+
+
+def make_eval_step(spec: ModelSpec):
+    """Returns ``eval_step(state, batch) -> out`` with per-example predictions
+    (for host-side confusion matrices) and weighted loss sums."""
+
+    def eval_step(state: TrainState, batch: Batch) -> Dict[str, Any]:
+        variables = {"params": state.params,
+                     "batch_stats": state.batch_stats}
+        outputs = state.apply_fn(variables, batch["x"], train=False)
+        loss, parts = spec.loss_fn(outputs, batch)
+        preds = spec.decode(outputs)
+        weight = batch["weight"]
+        n = weight.sum()
+        return {
+            "preds": preds,
+            "weight": weight,
+            "count": n,
+            # Convert mean losses back to weighted sums for exact host-side
+            # aggregation across ragged final batches.
+            "loss_sum": loss * n,
+            **{f"loss_sum_{k}": v * n for k, v in parts.items()},
+        }
+
+    return jax.jit(eval_step)

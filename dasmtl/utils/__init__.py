@@ -1,0 +1,2 @@
+from dasmtl.utils.logger import Logger  # noqa: F401
+from dasmtl.utils.rundir import make_run_dir  # noqa: F401

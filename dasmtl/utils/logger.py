@@ -1,0 +1,49 @@
+"""Stdout tee logger.
+
+Clean equivalent of the reference `Logger` (utils.py:23-48), which replaces
+`sys.stdout` with a buffering tee and appends the whole buffer to
+"console output.log" on `save()`.  This version writes through to the log file
+immediately (no loss on crash — the reference loses the buffer if the process
+dies before `log1.save()` at utils.py:223) and restores stdout on close.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional, TextIO
+
+
+class Logger:
+    """Tee every write to both the original stream and a log file."""
+
+    def __init__(self, path: str, stream: Optional[TextIO] = None):
+        self.path = path
+        self.stream = stream if stream is not None else sys.stdout
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._file = open(path, "a", encoding="utf-8")
+
+    def write(self, message: str) -> None:
+        self.stream.write(message)
+        self._file.write(message)
+
+    def flush(self) -> None:
+        self.stream.flush()
+        self._file.flush()
+
+    def isatty(self) -> bool:
+        return False
+
+    def close(self) -> None:
+        self._file.close()
+
+    # -- context manager installing the tee as sys.stdout -------------------
+    def __enter__(self) -> "Logger":
+        self._saved = sys.stdout
+        sys.stdout = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        sys.stdout = self._saved
+        self.flush()
+        self.close()

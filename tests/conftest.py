@@ -1,0 +1,56 @@
+"""Test harness: force an 8-device virtual CPU platform before JAX loads.
+
+``--xla_force_host_platform_device_count=8`` is the standard JAX fake-
+multi-device mechanism — 8 CPU devices emulate the v4-8 topology so the
+mesh/sharding layer is exercised without TPU hardware (SURVEY.md §4).
+
+NOTE (this container): every interpreter registers the `axon` TPU-tunnel PJRT
+plugin via sitecustomize, and concurrent Python processes can block on the
+exclusive TPU claim.  For fastest, contention-free test runs invoke:
+
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -x -q
+
+(the empty PALLAS_AXON_POOL_IPS skips plugin registration entirely; the
+JAX_PLATFORMS=cpu below still guarantees tests execute on the virtual CPU
+mesh either way).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def synthetic_tree(tmp_path_factory):
+    """A small on-disk synthetic .mat dataset tree (2 classes x 16 bins)."""
+    from dasmtl.data.synthetic import make_synthetic_dataset
+
+    root = tmp_path_factory.mktemp("dasdata")
+    striking, excavating = make_synthetic_dataset(
+        str(root), files_per_category=6, num_categories=16, shape=(100, 250),
+        seed=0)
+    return {"root": str(root), "striking": striking, "excavating": excavating}
+
+
+@pytest.fixture(scope="session")
+def tiny_arrays():
+    """Small in-memory synthetic arrays with a reduced input (52, 64)."""
+    from dasmtl.data.synthetic import synthetic_arrays
+
+    x, d, e = synthetic_arrays(n_per_class=2, num_categories=16,
+                               shape=(52, 64), seed=0)
+    return x, d, e
+
+
+def assert_all_finite(tree):
+    import jax
+
+    for leaf in jax.tree.leaves(tree):
+        assert np.all(np.isfinite(np.asarray(leaf)))

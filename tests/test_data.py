@@ -1,0 +1,150 @@
+"""Data layer tests: discovery, split parity/determinism, pipeline shapes."""
+
+import numpy as np
+import pytest
+
+from dasmtl.data.collector import DataCollector, distance_label_from_category
+from dasmtl.data.pipeline import BatchIterator, eval_batches
+from dasmtl.data.splits import build_splits, mixed_label
+from dasmtl.data.sources import DiskSource, RamSource
+from dasmtl.data.transforms import add_gaussian_snr, to_sample
+
+
+def test_collector_sorts_categories_numerically(synthetic_tree):
+    c = DataCollector(synthetic_tree["striking"])
+    cats = c.get_all_categories()
+    assert cats == [f"{k}m" for k in range(16)]  # 0m,1m,...,15m — not lexical
+    assert len(c.files_by_category["0m"]) == 6
+
+
+def test_distance_label_parsing():
+    assert distance_label_from_category("7m") == 7
+    assert distance_label_from_category("15m") == 15
+    with pytest.raises(ValueError):
+        distance_label_from_category("far")
+
+
+def test_split_sizes_and_determinism(synthetic_tree):
+    kw = dict(test_rate=0.17647, random_state=1)
+    s1 = build_splits(synthetic_tree["striking"], synthetic_tree["excavating"],
+                      **kw)
+    s2 = build_splits(synthetic_tree["striking"], synthetic_tree["excavating"],
+                      **kw)
+    # Determinism: identical file partitions for identical random_state.
+    assert [e.path for e in s1.train] == [e.path for e in s2.train]
+    assert [e.path for e in s1.val] == [e.path for e in s2.val]
+    # 6 files/category at test_rate 0.17647 -> ceil(1.06)=2 val + 4 train per
+    # category (sklearn ceil semantics), 32 categories overall.
+    assert len(s1.val) == 32 * 2
+    assert len(s1.train) == 32 * 4
+    # No leakage.
+    assert not (set(e.path for e in s1.train)
+                & set(e.path for e in s1.val))
+    # Different seed -> different partition.
+    s3 = build_splits(synthetic_tree["striking"], synthetic_tree["excavating"],
+                      test_rate=0.17647, random_state=2)
+    assert [e.path for e in s3.val] != [e.path for e in s1.val]
+
+
+def test_split_matches_sklearn_directly(synthetic_tree):
+    """Parity: per-category partition == calling sklearn the reference way
+    (dataset_preparation.py:152-155)."""
+    from sklearn.model_selection import train_test_split
+
+    c = DataCollector(synthetic_tree["striking"])
+    files = c.files_by_category["3m"]
+    tr_ref, va_ref = train_test_split(list(files), test_size=0.17647,
+                                      random_state=1)
+    s = build_splits(synthetic_tree["striking"], synthetic_tree["excavating"],
+                     test_rate=0.17647, random_state=1)
+    tr = [e.path for e in s.train if e.distance == 3 and e.event == 0]
+    va = [e.path for e in s.val if e.distance == 3 and e.event == 0]
+    assert tr == tr_ref and va == va_ref
+
+
+def test_kfold_splits_cover_everything(synthetic_tree):
+    all_val = []
+    for fold in range(5):
+        s = build_splits(synthetic_tree["striking"],
+                         synthetic_tree["excavating"], random_state=1,
+                         fold_index=fold)
+        assert not (set(e.path for e in s.train)
+                    & set(e.path for e in s.val))
+        all_val.extend(e.path for e in s.val)
+    # The five folds' val sets partition the whole dataset.
+    assert len(all_val) == len(set(all_val)) == 2 * 16 * 6
+
+
+def test_is_test_mode_no_split(synthetic_tree):
+    s = build_splits(synthetic_tree["striking"], synthetic_tree["excavating"],
+                     is_test=True)
+    assert len(s.train) == len(s.val) == 2 * 16 * 6
+
+
+def test_mixed_label():
+    # distance + 16 * event (dataset_preparation.py:220).
+    assert mixed_label(3, 0) == 3
+    assert mixed_label(3, 1) == 19
+    assert mixed_label(15, 1) == 31
+
+
+def test_sources_agree(synthetic_tree):
+    s = build_splits(synthetic_tree["striking"], synthetic_tree["excavating"],
+                     random_state=1)
+    ram = RamSource(s.val)
+    disk = DiskSource(s.val)
+    idx = np.array([0, 5, 17])
+    np.testing.assert_allclose(ram.gather(idx), disk.gather(idx))
+    assert ram.x.shape == (64, 100, 250, 1)
+    assert ram.x.dtype == np.float32
+    np.testing.assert_array_equal(ram.distance, disk.distance)
+
+
+def test_batch_iterator_padding_and_determinism(tiny_arrays):
+    from dasmtl.data.sources import ArraySource
+
+    x, d, e = tiny_arrays  # 64 examples
+    src = ArraySource(x, d, e)
+    it = BatchIterator(src, batch_size=10, seed=7)
+    assert it.steps_per_epoch() == 7
+    batches = list(it.epoch(0))
+    assert len(batches) == 7
+    for b in batches[:-1]:
+        assert b["x"].shape == (10, 52, 64, 1)
+        assert b["weight"].sum() == 10
+    last = batches[-1]
+    assert last["x"].shape == (10, 52, 64, 1)  # static shape via padding
+    assert last["weight"].sum() == 4
+    # Epoch order is reproducible and epoch-dependent.
+    again = list(it.epoch(0))
+    np.testing.assert_array_equal(batches[0]["distance"],
+                                  again[0]["distance"])
+    other = list(it.epoch(1))
+    assert not np.array_equal(batches[0]["distance"], other[0]["distance"])
+    # Every example appears exactly once per epoch.
+    seen = np.concatenate([b["x"][b["weight"] > 0].sum(axis=(1, 2, 3))
+                           for b in batches])
+    assert seen.shape[0] == 64
+
+
+def test_eval_batches_cover_all(tiny_arrays):
+    from dasmtl.data.sources import ArraySource
+
+    x, d, e = tiny_arrays
+    src = ArraySource(x, d, e)
+    bs = list(eval_batches(src, batch_size=48))
+    assert len(bs) == 2
+    assert bs[1]["weight"].sum() == 16
+    got = np.concatenate([b["distance"][b["weight"] > 0] for b in bs])
+    np.testing.assert_array_equal(got, d)
+
+
+def test_to_sample_and_noise():
+    mat = np.arange(12.0).reshape(3, 4)
+    s = to_sample(mat)
+    assert s.shape == (3, 4, 1) and s.dtype == np.float32
+    rng = np.random.default_rng(0)
+    noisy = add_gaussian_snr(np.random.default_rng(1).normal(size=(8, 500)),
+                             snr_db=8.0, rng=rng)
+    assert noisy.shape == (8, 500)
+    assert np.isfinite(noisy).all()

@@ -1,0 +1,119 @@
+"""Model golden tests: parameter counts, output shapes, feature geometry."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dasmtl.models import MTLNet, SingleTaskNet
+from dasmtl.models.layers import backbone_channels, group_mean_head, \
+    max_pool_ceil
+
+
+def _init(model, shape=(2, 100, 250, 1)):
+    return model.init(jax.random.PRNGKey(0), jnp.zeros(shape), train=False)
+
+
+def _param_count(variables):
+    return sum(int(np.prod(p.shape))
+               for p in jax.tree.leaves(variables["params"]))
+
+
+def test_mtl_param_count_golden():
+    # Reference MTL_Net has 1,136,224 trainable parameters (measured by
+    # instantiating model/modelA_MTL.py:53; BASELINE.md).
+    v = _init(MTLNet())
+    assert _param_count(v) == 1_136_224
+
+
+@pytest.mark.parametrize("task", ["distance", "event"])
+def test_single_task_param_count_golden(task):
+    # Reference Single_Task_Net: 918,376 for either task (BASELINE.md).
+    v = _init(SingleTaskNet(task))
+    assert _param_count(v) == 918_376
+
+
+def test_mtl_output_shapes_and_logprobs():
+    m = MTLNet()
+    v = _init(m)
+    out_d, out_e = m.apply(v, jnp.ones((3, 100, 250, 1)), train=False)
+    assert out_d.shape == (3, 16) and out_e.shape == (3, 2)
+    # log_softmax outputs: rows exp-sum to 1.
+    np.testing.assert_allclose(np.exp(out_d).sum(-1), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(np.exp(out_e).sum(-1), 1.0, rtol=1e-5)
+
+
+@pytest.mark.parametrize("task,ncls", [("distance", 16), ("event", 2)])
+def test_single_task_output_shape(task, ncls):
+    m = SingleTaskNet(task)
+    v = _init(m)
+    (out,) = m.apply(v, jnp.ones((2, 100, 250, 1)), train=False)
+    assert out.shape == (2, ncls)
+
+
+def test_backbone_channel_schedule():
+    assert list(backbone_channels(16, 8)) == [16, 16, 32, 64, 128]
+
+
+def test_backbone_geometry():
+    """Feature-map sizes for (100, 250): conv1 -> 33x83, stride-2 blocks ->
+    17x42 -> 9x21 -> 5x11 (SURVEY.md §3.3, verified against the reference)."""
+    m = MTLNet()
+    v = _init(m)
+    _, intermediates = m.apply(
+        v, jnp.ones((1, 100, 250, 1)), train=False,
+        capture_intermediates=lambda mdl, name: "resblock" in mdl.name
+        if mdl.name else False)
+    # Instead of relying on intermediates plumbing, verify the arithmetic that
+    # the modules implement:
+    def conv_out(n, k, s, p):
+        return (n + 2 * p - k) // s + 1
+    h, w = 100, 250
+    h, w = conv_out(h, 7, 3, 2), conv_out(w, 7, 3, 2)
+    assert (h, w) == (33, 83)
+    for _ in range(3):  # three stride-2 resblocks
+        h, w = conv_out(h, 3, 2, 1), conv_out(w, 3, 2, 1)
+    assert (h, w) == (5, 11)
+
+
+def test_max_pool_ceil_matches_torch_ceil_mode():
+    # Odd spatial dims: torch ceil_mode keeps the ragged last window.
+    x = jnp.arange(1 * 5 * 7 * 1, dtype=jnp.float32).reshape(1, 5, 7, 1)
+    y = max_pool_ceil(x)
+    assert y.shape == (1, 3, 4, 1)
+    import torch
+    xt = torch.arange(5 * 7, dtype=torch.float32).reshape(1, 1, 5, 7)
+    yt = torch.nn.functional.max_pool2d(xt, 2, 2, ceil_mode=True)
+    np.testing.assert_allclose(np.asarray(y)[0, :, :, 0], yt[0, 0].numpy())
+
+
+def test_group_mean_head_matches_torch_avgpool1d():
+    import torch
+    g = np.random.default_rng(0).normal(size=(3, 4, 4, 128)).astype(np.float32)
+    logits = group_mean_head(jnp.asarray(g), 16)
+    gt = torch.from_numpy(g).permute(0, 3, 1, 2)  # NCHW
+    pooled = torch.nn.AdaptiveAvgPool2d((1, 1))(gt).squeeze(-1).squeeze(-1)
+    ref = torch.nn.AvgPool1d(8, 8)(pooled.unsqueeze(1)).squeeze(1)
+    np.testing.assert_allclose(np.asarray(logits), ref.numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_variable_input_size_supported():
+    # Fully-convolutional + GAP head: smaller windows also work (used by the
+    # fast tests; long-window scaling is an input-pipeline concern).
+    m = MTLNet()
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 52, 64, 1)), train=False)
+    out_d, out_e = m.apply(v, jnp.ones((2, 52, 64, 1)), train=False)
+    assert out_d.shape == (2, 16) and out_e.shape == (2, 2)
+
+
+def test_batchnorm_updates_in_train_mode():
+    m = MTLNet()
+    v = _init(m, (4, 52, 64, 1))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 52, 64, 1)),
+                    jnp.float32)
+    outs, mutated = m.apply(v, x, train=True, mutable=["batch_stats"])
+    before = jax.tree.leaves(v["batch_stats"])
+    after = jax.tree.leaves(mutated["batch_stats"])
+    changed = any(not np.allclose(b, a) for b, a in zip(before, after))
+    assert changed
