@@ -1,0 +1,83 @@
+"""Benchmark harness: flagship MTL train-step throughput.
+
+Measures end-to-end jitted training throughput (forward + summed NLL +
+backward + coupled-Adam update + BatchNorm stats, i.e. the reference's whole
+inner loop utils.py:346-374 as one XLA computation) in samples/second on the
+available accelerator, and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline`` compares against ``published.mtl_train_samples_per_s`` in
+BASELINE.json (the first recorded TPU measurement of this framework); 1.0
+until a baseline is recorded.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+BATCH = 256  # large batch keeps the MXU fed; reference trains at 32 (train.py:11)
+WARMUP_STEPS = 3
+MEASURE_STEPS = 20
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from dasmtl.config import Config
+    from dasmtl.main import build_state
+    from dasmtl.models.registry import get_model_spec
+    from dasmtl.train.steps import make_train_step
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = Config(model="MTL", batch_size=BATCH,
+                 compute_dtype="bfloat16" if on_tpu else "float32")
+    spec = get_model_spec(cfg.model)
+    state = build_state(cfg, spec)
+    train_step = make_train_step(spec)
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "x": rng.normal(size=(BATCH, 100, 250, 1)).astype(np.float32),
+        "distance": rng.integers(0, 16, size=(BATCH,)).astype(np.int32),
+        "event": rng.integers(0, 2, size=(BATCH,)).astype(np.int32),
+        "weight": np.ones((BATCH,), np.float32),
+    }
+    batch = jax.device_put(batch)
+    lr = np.float32(1e-3)
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = train_step(state, batch, lr)
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, metrics = train_step(state, batch, lr)
+    jax.block_until_ready(state.params)
+    elapsed = time.perf_counter() - t0
+
+    samples_per_s = BATCH * MEASURE_STEPS / elapsed
+
+    baseline = None
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BASELINE.json")) as f:
+            baseline = json.load(f).get("published", {}).get(
+                "mtl_train_samples_per_s")
+    except (OSError, json.JSONDecodeError):
+        pass
+    vs = samples_per_s / baseline if baseline else 1.0
+
+    print(json.dumps({
+        "metric": "mtl_train_samples_per_s",
+        "value": round(samples_per_s, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,14 @@ TASKS = ("distance", "event")
 NUM_DISTANCE_CLASSES = 16
 NUM_EVENT_CLASSES = 2
 NUM_MIXED_CLASSES = NUM_DISTANCE_CLASSES * NUM_EVENT_CLASSES
+
+
+def mixed_label(distance, event):
+    """The 32-way collapsed label ``distance + 16 * event`` of the
+    multi-classifier path (reference dataset_preparation.py:220).  Works on
+    scalars and (jax/numpy) arrays; the single source of the encoding — the
+    decode lives in models/registry.py."""
+    return distance + NUM_DISTANCE_CLASSES * event
 # Input sample geometry: 100 fiber channels x 250 time samples
 # (reference utils.py:128, dataset_preparation.py:247-248).
 INPUT_HEIGHT = 100
@@ -46,7 +54,8 @@ class Config:
     lr_decay_every: int = 5
     # The MTL/single-task trainers decay at epoch 0 too (utils.py:245-247);
     # the multi-classifier trainer skips epoch 0 (utils.py:622-625).
-    lr_decay_at_epoch0: bool = True
+    # `None` = resolve by model (the reference behavior).
+    lr_decay_at_epoch0: Optional[bool] = None
     val_every: int = 5
     # Checkpoint accuracy gate: 0.98 for MTL/single-task (utils.py:329),
     # 0.95 for the multi-classifier (utils.py:716). `None` = auto by model.
@@ -101,6 +110,12 @@ class Config:
             raise ValueError(f"unknown device {self.device!r}")
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
+
+    @property
+    def decay_at_epoch0(self) -> bool:
+        if self.lr_decay_at_epoch0 is not None:
+            return self.lr_decay_at_epoch0
+        return self.model != "multi_classifier"
 
     @property
     def acc_gate(self) -> float:

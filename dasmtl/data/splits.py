@@ -87,10 +87,7 @@ def build_splits(striking_dir: str, excavating_dir: str, *,
     return DatasetSplits(train=train, val=val)
 
 
-def mixed_label(distance: int, event: int, num_distance: int = 16) -> int:
-    """The 32-way collapsed label of the multi-classifier path
-    (reference dataset_preparation.py:220)."""
-    return distance + num_distance * event
+from dasmtl.config import mixed_label  # noqa: F401  (canonical encoding)
 
 
 def export_manifest_csv(examples: Sequence[Example], path: str) -> None:

@@ -1,0 +1,170 @@
+"""Run orchestration — the equivalent of the reference ``utils.main_process``.
+
+The reference's orchestrator (utils.py:78-223) selects a model from an if/elif
+chain, creates a timestamped run dir, installs a stdout tee, hard-codes the
+optimizer/criterion, builds datasets/loaders, and dispatches to one of three
+trainer engines.  Here the same responsibilities are explicit and typed:
+
+    Config -> (model spec, mesh plan, data sources, TrainState) -> Trainer
+
+All device placement is declarative: a ``Mesh`` with ``dp`` (batch) and ``sp``
+(fiber/spatial) axes; parameters replicated; XLA inserts gradient all-reduces
+and BatchNorm cross-device reductions over ICI during the jitted step.  The
+reference's ``model.cuda()`` + per-batch ``.cuda()`` (utils.py:124-125,
+350-353) have no analogue — arrays are placed by sharding annotations.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dasmtl.config import INPUT_HEIGHT, INPUT_WIDTH, Config
+from dasmtl.data.pipeline import BatchIterator
+from dasmtl.data.sources import DiskSource, RamSource, _SourceBase
+from dasmtl.data.splits import build_splits
+from dasmtl.models.registry import ModelSpec, get_model_spec
+from dasmtl.parallel.mesh import (MeshPlan, create_mesh, replicated_sharding)
+from dasmtl.train.checkpoint import restore_latest_in, restore_weights
+from dasmtl.train.loop import Trainer, ValidationResult
+from dasmtl.train.optim import coupled_adam
+from dasmtl.train.state import TrainState
+from dasmtl.utils.logger import Logger
+from dasmtl.utils.plots import plot_metric_lines, render_confusion_matrices
+from dasmtl.utils.rundir import make_run_dir
+
+
+def build_state(cfg: Config, spec: ModelSpec,
+                input_hw: Tuple[int, int] = (INPUT_HEIGHT, INPUT_WIDTH),
+                ) -> TrainState:
+    """Initialize model variables and the full TrainState."""
+    model = spec.build(cfg)
+    rng = jax.random.PRNGKey(cfg.seed)
+    init_rng, state_rng = jax.random.split(rng)
+    dummy = jnp.zeros((1, input_hw[0], input_hw[1], 1), jnp.float32)
+    variables = model.init({"params": init_rng, "dropout": init_rng}, dummy,
+                           train=False)
+    tx = coupled_adam(weight_decay=cfg.weight_decay)
+    return TrainState.create(
+        apply_fn=model.apply, params=variables["params"],
+        batch_stats=variables.get("batch_stats", {}), tx=tx, rng=state_rng)
+
+
+def make_mesh_plan(cfg: Config) -> Optional[MeshPlan]:
+    """A mesh when parallelism is requested or >1 device is visible; ``None``
+    keeps the single-device fast path (no device_put per batch)."""
+    n = len(jax.devices())
+    if cfg.sp == 1 and (cfg.dp == 1 or (cfg.dp == -1 and n == 1)):
+        return None
+    plan = create_mesh(cfg.dp, cfg.sp)
+    if (INPUT_HEIGHT % plan.sp) != 0:
+        raise ValueError(f"sp={plan.sp} must divide the fiber-channel axis "
+                         f"({INPUT_HEIGHT})")
+    return plan
+
+
+def replicate_state(state: TrainState, plan: Optional[MeshPlan]) -> TrainState:
+    if plan is None:
+        return state
+    return jax.device_put(state, replicated_sharding(plan))
+
+
+def build_sources(cfg: Config, is_test: bool,
+                  ) -> Tuple[_SourceBase, _SourceBase]:
+    """(train_source, val_source) per the reference's split semantics
+    (dataset_preparation.py:118-239; in test mode every file of the *test*
+    tree lands in the val list, :139-147)."""
+    if is_test:
+        striking, excavating = cfg.test_set_striking, cfg.test_set_excavating
+    else:
+        striking = cfg.trainval_set_striking
+        excavating = cfg.trainval_set_excavating
+    splits = build_splits(striking, excavating, test_rate=cfg.test_rate,
+                          random_state=cfg.random_state,
+                          fold_index=cfg.fold_index, is_test=is_test,
+                          mat_keys=(cfg.mat_key,))
+    src_cls = RamSource if cfg.dataset_ram else DiskSource
+    kwargs = dict(key=cfg.mat_key, noise_snr_db=cfg.noise_snr_db,
+                  noise_seed=cfg.seed)
+    if cfg.dataset_ram:
+        kwargs["show_progress"] = True
+    train_source = src_cls(splits.train, **kwargs)
+    val_source = src_cls(splits.val, **kwargs)
+    return train_source, val_source
+
+
+def main_process(cfg: Config, is_test: bool = False,
+                 ) -> ValidationResult:
+    """End-to-end run (train or eval), returning the final validation result."""
+    if cfg.debug_nans:
+        jax.config.update("jax_debug_nans", True)
+
+    run_dir = make_run_dir(cfg.output_savedir, cfg.model,  is_test)
+    with Logger(os.path.join(run_dir, "console_output.log")):
+        print(f"devices: {[str(d) for d in jax.devices()]}")
+        with open(os.path.join(run_dir, "config.json"), "w") as f:
+            f.write(cfg.to_json())
+
+        spec = get_model_spec(cfg.model)
+        plan = make_mesh_plan(cfg)
+        if plan is not None:
+            print(f"mesh: dp={plan.dp} sp={plan.sp} "
+                  f"({plan.n_devices} devices)")
+        state = build_state(cfg, spec)
+        n_params = sum(int(np.prod(p.shape))
+                       for p in jax.tree.leaves(state.params))
+        print(f"model={cfg.model} params={n_params:,}")
+        if is_test and not cfg.model_path:
+            # The reference eval entry always restores a .pth first
+            # (test.py:16,33); evaluating random init silently would produce
+            # misleading artifacts.
+            raise ValueError("test mode requires --model_path "
+                             "(a checkpoint directory to evaluate)")
+        if cfg.model_path:
+            state = restore_weights(state, cfg.model_path)
+            print(f"restored weights from {cfg.model_path}")
+        state = replicate_state(state, plan)
+
+        train_source, val_source = build_sources(cfg, is_test)
+        print(f"examples: train={len(train_source)} val={len(val_source)}")
+        global_batch = cfg.batch_size * (plan.dp if plan else 1)
+        train_iter = BatchIterator(train_source, global_batch, seed=cfg.seed)
+
+        trainer = Trainer(cfg, spec, state, train_iter, val_source, run_dir,
+                          mesh_plan=plan)
+        if cfg.resume and not is_test:
+            # Full-state resume from the newest checkpoint of any previous run
+            # under the same savedir (params, Adam moments, epoch, RNG —
+            # impossible in the reference, SURVEY.md §3.5).
+            resumed = restore_latest_in(trainer.state, cfg.output_savedir,
+                                        model=cfg.model)
+            if resumed is not None:
+                trainer.state = replicate_state(resumed, plan)
+                print(f"resumed at epoch "
+                      f"{int(jax.device_get(trainer.state.epoch))} from "
+                      f"{cfg.output_savedir}")
+            else:
+                print(f"--resume: no checkpoint under {cfg.output_savedir}; "
+                      "starting fresh")
+
+        if cfg.profile_dir:
+            jax.profiler.start_trace(cfg.profile_dir)
+        try:
+            if is_test:
+                result = trainer.test()
+            else:
+                results = trainer.fit()
+                result = results[-1]
+        finally:
+            if cfg.profile_dir:
+                jax.profiler.stop_trace()
+
+        # Post-run artifact rendering (reference utils.py:180-221).
+        plot_metric_lines(trainer.metrics_dir)
+        render_confusion_matrices(trainer.metrics_dir)
+        print(f"run dir: {run_dir}")
+        return result

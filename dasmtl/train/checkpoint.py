@@ -1,0 +1,180 @@
+"""Orbax checkpointing: periodic full-state saves plus an accuracy-gated best.
+
+The reference saves ``model.state_dict()`` only, and only when the validation
+distance accuracy crosses a gate (0.98, or 0.95 for the multi-classifier) —
+``torch.save`` at utils.py:329-334/716-721 — so a run that never crosses the
+gate writes nothing and no run can truly resume (no optimizer state, no epoch,
+no RNG; SURVEY.md §3.5).  Here every save is the **full** :class:`TrainState`
+pytree (params, BatchNorm stats, Adam moments, step/epoch counters, PRNG key):
+
+- ``ckpts/step_<n>`` — unconditional periodic saves with a keep-last-k policy,
+  so any crash resumes from the latest;
+- ``ckpts/best`` — the reference's accuracy-gated artifact, overwritten
+  whenever the gated metric improves.
+
+Orbax writes are atomic (tmp dir + rename), so a crash mid-save never corrupts
+the latest checkpoint.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from dasmtl.train.state import TrainState
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def state_payload(state: TrainState) -> Dict[str, Any]:
+    """The checkpointable subset of a TrainState (drops apply_fn/tx, which are
+    code, not data — they are re-supplied by the model registry on restore)."""
+    return {
+        "step": state.step,
+        "epoch": state.epoch,
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+        "rng": state.rng,
+    }
+
+
+def _with_payload(state: TrainState, payload: Dict[str, Any]) -> TrainState:
+    return state.replace(**payload)
+
+
+class CheckpointManager:
+    """Periodic + best checkpoints under ``<run_dir>/ckpts``."""
+
+    def __init__(self, run_dir: str, *, max_keep: int = 3):
+        self.root = os.path.abspath(os.path.join(run_dir, "ckpts"))
+        os.makedirs(self.root, exist_ok=True)
+        self.max_keep = max_keep
+        self._ckptr = ocp.StandardCheckpointer()
+        # Best-so-far survives a restart into the same run dir.
+        self._best_metric = best_metric_on_disk(run_dir)
+
+    # -- periodic ------------------------------------------------------------
+    def save(self, state: TrainState) -> str:
+        step = int(jax.device_get(state.step))
+        path = os.path.join(self.root, f"step_{step}")
+        self._ckptr.save(path, state_payload(state), force=True)
+        self._ckptr.wait_until_finished()
+        self._prune()
+        return path
+
+    def _steps(self):
+        if not os.path.isdir(self.root):
+            return []
+        out = []
+        for name in os.listdir(self.root):
+            m = _STEP_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def _prune(self) -> None:
+        import shutil
+
+        steps = self._steps()
+        for step in steps[:-self.max_keep] if self.max_keep > 0 else []:
+            shutil.rmtree(os.path.join(self.root, f"step_{step}"),
+                          ignore_errors=True)
+
+    def latest_path(self) -> Optional[str]:
+        steps = self._steps()
+        return (os.path.join(self.root, f"step_{steps[-1]}")
+                if steps else None)
+
+    # -- best (accuracy-gated, reference utils.py:329-334) -------------------
+    def save_best(self, state: TrainState, metric: float) -> Optional[str]:
+        if self._best_metric is not None and metric <= self._best_metric:
+            return None
+        self._best_metric = metric
+        path = os.path.join(self.root, "best")
+        self._ckptr.save(path, state_payload(state), force=True)
+        self._ckptr.wait_until_finished()
+        with open(os.path.join(self.root, "best_metric.txt"), "w") as f:
+            f.write(f"{metric:.6f}\n")
+        return path
+
+    # -- restore -------------------------------------------------------------
+    def restore(self, state: TrainState, path: Optional[str] = None,
+                ) -> TrainState:
+        """Restore into the (freshly initialized) ``state`` template; shapes
+        and dtypes must match, like the reference's ``strict=True`` load
+        (utils.py:122-123)."""
+        if path is None:
+            path = self.latest_path()
+        if path is None:
+            raise FileNotFoundError(f"no checkpoint under {self.root}")
+        template = jax.device_get(state_payload(state))
+        payload = self._ckptr.restore(os.path.abspath(path), template)
+        return _with_payload(state, payload)
+
+
+def restore_weights(state: TrainState, path: str) -> TrainState:
+    """Weights-only restore for ``--model_path`` — reference parity with
+    ``load_state_dict(..., strict=True)`` (utils.py:122-123): params and
+    BatchNorm stats only, so fine-tuning starts at epoch 0 with fresh
+    optimizer moments.  Full-state resume is :meth:`CheckpointManager.restore`
+    / :func:`restore_latest_in` (``--resume``)."""
+    ckptr = ocp.StandardCheckpointer()
+    template = jax.device_get(state_payload(state))
+    payload = ckptr.restore(os.path.abspath(path), template)
+    return state.replace(params=payload["params"],
+                         batch_stats=payload["batch_stats"])
+
+
+def find_latest_checkpoint(savedir: str,
+                           model: Optional[str] = None) -> Optional[str]:
+    """The newest ``step_<n>`` checkpoint across every run dir under
+    ``savedir`` — the ``--resume`` discovery path.  "Newest" is by checkpoint
+    mtime (not run-dir name, which sorts wrongly across year boundaries).
+    When ``model`` is given, only run dirs of that model family are
+    considered (run dirs are named ``... model_type=<model> ...``) so a
+    multi-classifier resume never tries to load MTL weights."""
+    if not os.path.isdir(savedir):
+        return None
+    best: Optional[str] = None
+    best_mtime = -1.0
+    for run_name in os.listdir(savedir):
+        if model is not None and f"model_type={model} " not in run_name + " ":
+            continue
+        ckpt_root = os.path.join(savedir, run_name, "ckpts")
+        if not os.path.isdir(ckpt_root):
+            continue
+        steps = [int(m.group(1)) for m in
+                 (_STEP_RE.match(n) for n in os.listdir(ckpt_root)) if m]
+        if not steps:
+            continue
+        path = os.path.join(ckpt_root, f"step_{max(steps)}")
+        mtime = os.path.getmtime(path)
+        if mtime > best_mtime:
+            best, best_mtime = path, mtime
+    return best
+
+
+def restore_latest_in(state: TrainState, savedir: str,
+                      model: Optional[str] = None) -> Optional[TrainState]:
+    """Full-state resume from the newest checkpoint under ``savedir``;
+    ``None`` when there is nothing to resume from."""
+    path = find_latest_checkpoint(savedir, model=model)
+    if path is None:
+        return None
+    ckptr = ocp.StandardCheckpointer()
+    template = jax.device_get(state_payload(state))
+    payload = ckptr.restore(os.path.abspath(path), template)
+    return _with_payload(state, payload)
+
+
+def best_metric_on_disk(run_dir: str) -> Optional[float]:
+    path = os.path.join(run_dir, "ckpts", "best_metric.txt")
+    if not os.path.exists(path):
+        return None
+    return float(np.loadtxt(path))

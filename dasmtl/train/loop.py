@@ -1,0 +1,265 @@
+"""The training engine — one generic loop for every model family.
+
+The reference carries three near-identical ~190-line trainer engines
+(``trainer_MTL`` utils.py:226-403, ``trainer_single_task`` utils.py:406-594,
+``trainer_multiClassifier`` utils.py:597-793) differing only in loss wiring,
+reported heads and label decode.  Those differences live in
+:class:`~dasmtl.models.registry.ModelSpec`; this module is the single engine.
+
+Semantics preserved from the reference:
+
+- stepped LR (÷1.5 every 5 epochs; epoch-0 decay included for MTL/single-task,
+  excluded for the multi-classifier — utils.py:245-247 vs 622-625);
+- validation every ``val_every`` epochs *including epoch 0* (utils.py:245)
+  plus a final pass after the last epoch, printing accuracy / confusion
+  matrix / per-class F1 / weighted P-R-F1 per task head (utils.py:297-322);
+- accuracy-gated "best" checkpoint on the primary task (utils.py:329-337),
+  *plus* unconditional periodic full-state checkpoints (new — the reference
+  can lose a whole run, SURVEY.md §5);
+- windowed train metrics every ``log_every_steps`` appended to ``.npy`` metric
+  lines (utils.py:376-398) — but cleanly normalized: windowed loss is the
+  weighted mean over the window's real examples, not the reference's
+  double-divided quantity (utils.py:379-386, SURVEY.md §5 metrics row);
+- test mode (``is_test``) runs exactly one validation pass and returns its
+  report (utils.py:339-340).
+
+TPU shape of the loop: the jitted train step fuses forward+loss+backward+
+update+BN-stats+decode into one XLA computation; the host only sees a handful
+of scalar metric sums per step.  Metric scalars are fetched with a one-step
+delay (``_MetricWindow`` keeps device arrays and converts lazily) so the host
+never blocks the device pipeline — steps stay enqueued back-to-back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+import jax
+import numpy as np
+
+from dasmtl.config import Config, mixed_label
+from dasmtl.data.pipeline import BatchIterator, eval_batches
+from dasmtl.models.registry import ModelSpec
+from dasmtl.parallel.mesh import MeshPlan, shard_batch
+from dasmtl.train import metrics as host_metrics
+from dasmtl.train.checkpoint import CheckpointManager
+from dasmtl.train.optim import stepped_lr
+from dasmtl.train.state import TrainState
+from dasmtl.train.steps import make_eval_step, make_train_step
+
+
+class MetricLines:
+    """Append-only named metric lines persisted as ``.npy`` (the reference's
+    ``trainLossLine``/``testAccLine`` artifacts, utils.py:299-304,392-396)."""
+
+    def __init__(self, out_dir: str):
+        self.out_dir = out_dir
+        os.makedirs(out_dir, exist_ok=True)
+        self._lines: Dict[str, List[float]] = {}
+
+    def append(self, name: str, value: float) -> None:
+        self._lines.setdefault(name, []).append(float(value))
+        np.save(os.path.join(self.out_dir, f"{name}.npy"),
+                np.asarray(self._lines[name], np.float64))
+
+    def get(self, name: str) -> List[float]:
+        return list(self._lines.get(name, []))
+
+
+@dataclasses.dataclass
+class ValidationResult:
+    epoch: int
+    loss: float
+    reports: Dict[str, Dict[str, Any]]  # per task head
+    primary_task: str
+
+    @property
+    def primary_accuracy(self) -> float:
+        return self.reports[self.primary_task]["accuracy"]
+
+
+class Trainer:
+    """Generic epoch-loop engine driving jitted train/eval steps."""
+
+    def __init__(self, cfg: Config, spec: ModelSpec, state: TrainState,
+                 train_iter: BatchIterator, val_source, run_dir: str,
+                 mesh_plan: Optional[MeshPlan] = None):
+        self.cfg = cfg
+        self.spec = spec
+        self.state = state
+        self.train_iter = train_iter
+        self.val_source = val_source
+        self.run_dir = run_dir
+        self.mesh_plan = mesh_plan
+        self.train_step = make_train_step(spec)
+        self.eval_step = make_eval_step(spec)
+        self.metrics_dir = os.path.join(run_dir, "metrics")
+        self.lines = MetricLines(self.metrics_dir)
+        self.ckpt = CheckpointManager(run_dir, max_keep=cfg.ckpt_max_keep)
+        self.jsonl_path = os.path.join(self.metrics_dir, "metrics.jsonl")
+        # Primary gated task: first reported head (distance for MTL — the
+        # reference's gate, utils.py:329).
+        self.primary_task = spec.report_tasks[0][0]
+        # Validation uses the same global batch as training so a dp-mesh
+        # keeps every device fed (cfg.batch_size is per-device).
+        self.eval_batch_size = cfg.batch_size * (
+            mesh_plan.dp if mesh_plan else 1)
+
+    # -- helpers -------------------------------------------------------------
+    def _place(self, batch):
+        if self.mesh_plan is not None:
+            return shard_batch(self.mesh_plan, batch)
+        return batch
+
+    def _log_jsonl(self, record: Dict[str, Any]) -> None:
+        with open(self.jsonl_path, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+    # -- validation ----------------------------------------------------------
+    def validate(self, epoch: int) -> ValidationResult:
+        """One full pass over the validation source; host-side sklearn-grade
+        metrics per task head (reference utils.py:253-322)."""
+        if len(self.val_source) == 0:
+            raise ValueError("validation source is empty — check the dataset "
+                             "directories and split configuration")
+        all_preds: Dict[str, List[np.ndarray]] = {}
+        all_weight: List[np.ndarray] = []
+        labels: Dict[str, List[np.ndarray]] = {"distance": [], "event": []}
+        loss_sum, count = 0.0, 0.0
+        part_sums: Dict[str, float] = {}
+        for batch in eval_batches(self.val_source, self.eval_batch_size):
+            for k in labels:
+                labels[k].append(batch[k])
+            out = self.eval_step(self.state, self._place(batch))
+            out = jax.device_get(out)
+            for task, preds in out["preds"].items():
+                all_preds.setdefault(task, []).append(np.asarray(preds))
+            all_weight.append(np.asarray(out["weight"]))
+            loss_sum += float(out["loss_sum"])
+            count += float(out["count"])
+            for k, v in out.items():
+                if k.startswith("loss_sum_"):
+                    part_sums[k[len("loss_sum_"):]] = (
+                        part_sums.get(k[len("loss_sum_"):], 0.0) + float(v))
+
+        weight = np.concatenate(all_weight) if all_weight else np.zeros((0,))
+        real = weight > 0
+        y_true = {k: np.concatenate(v)[real] if v else np.zeros((0,), np.int32)
+                  for k, v in labels.items()}
+        y_true["mixed"] = mixed_label(y_true["distance"], y_true["event"])
+        loss = loss_sum / max(count, 1.0)
+        for k, v in part_sums.items():
+            self.lines.append(f"val_loss_{k}", v / max(count, 1.0))
+
+        reports: Dict[str, Dict[str, Any]] = {}
+        for task, num_classes in self.spec.report_tasks:
+            y_pred = np.concatenate(all_preds[task])[real]
+            rep = host_metrics.classification_report(
+                y_true[task], y_pred, num_classes)
+            if task == "distance":
+                rep["mae_m"] = host_metrics.distance_mae(y_true[task], y_pred)
+            reports[task] = rep
+            np.save(os.path.join(self.metrics_dir,
+                                 f"confusion_matrix_{task}.npy"),
+                    rep["confusion_matrix"])
+            self.lines.append(f"val_acc_{task}", rep["accuracy"])
+            print(f"[val epoch {epoch}] task={task} "
+                  f"acc={rep['accuracy']:.4f} "
+                  f"weighted_f1={rep['weighted_f1']:.4f}"
+                  + (f" mae={rep['mae_m']:.3f}m" if "mae_m" in rep else ""))
+        self.lines.append("val_loss", loss)
+        self._log_jsonl({
+            "kind": "val", "epoch": epoch, "loss": loss,
+            **{f"acc_{t}": r["accuracy"] for t, r in reports.items()},
+        })
+        return ValidationResult(epoch=epoch, loss=loss, reports=reports,
+                                primary_task=self.primary_task)
+
+    # -- training ------------------------------------------------------------
+    def _train_epoch(self, epoch: int, lr: float) -> None:
+        window: Dict[str, float] = {}
+        t0 = time.perf_counter()
+        lr_arr = np.float32(lr)
+        for i, batch in enumerate(self.train_iter.epoch(epoch)):
+            self.state, step_metrics = self.train_step(
+                self.state, self._place(batch), lr_arr)
+            # Accumulate device scalars without forcing a sync each step.
+            for k, v in step_metrics.items():
+                window[k] = window.get(k, 0.0) + v
+            if (i + 1) % self.cfg.log_every_steps == 0:
+                self._flush_window(epoch, i, window,
+                                   time.perf_counter() - t0)
+                window = {}
+                t0 = time.perf_counter()
+        if window:
+            self._flush_window(epoch, self.train_iter.steps_per_epoch() - 1,
+                               window, time.perf_counter() - t0)
+        self.state = self.state.replace(epoch=self.state.epoch + 1)
+
+    def _flush_window(self, epoch: int, step_in_epoch: int,
+                      window: Dict[str, float], elapsed: float) -> None:
+        window = {k: float(jax.device_get(v)) for k, v in window.items()}
+        n = max(window.get("count", 0.0), 1.0)
+        # Weighted mean over the window's real examples (exact even when the
+        # window includes the padded final batch).
+        mean_loss = window["loss_sum"] / n
+        self.lines.append("train_loss", mean_loss)
+        rec = {"kind": "train", "epoch": epoch, "step": step_in_epoch,
+               "loss": mean_loss, "examples_per_s": n / max(elapsed, 1e-9)}
+        msg = (f"[train epoch {epoch} step {step_in_epoch}] "
+               f"loss={mean_loss:.4f}")
+        for task, _ in self.spec.report_tasks:
+            key = f"correct_{task}"
+            if key in window:
+                acc = window[key] / n
+                self.lines.append(f"train_acc_{task}", acc)
+                rec[f"acc_{task}"] = acc
+                msg += f" acc_{task}={acc:.4f}"
+        for key, value in window.items():
+            if key.startswith("loss_sum_"):
+                self.lines.append(f"train_loss_{key[len('loss_sum_'):]}",
+                                  value / n)
+        msg += f" ({rec['examples_per_s']:.1f} ex/s)"
+        print(msg)
+        self._log_jsonl(rec)
+
+    def fit(self) -> List[ValidationResult]:
+        """Full training run: epochs 0..epoch_num-1 with periodic validation,
+        then a final validation pass.  (The reference reaches the same effect
+        through an off-by-one epoch_num+1 loop whose last epoch only
+        validates, utils.py:159,242,342 — here it is explicit.)"""
+        cfg = self.cfg
+        results: List[ValidationResult] = []
+        start_epoch = int(jax.device_get(self.state.epoch))
+        for epoch in range(start_epoch, cfg.epoch_num):
+            lr = stepped_lr(epoch, base_lr=cfg.lr, factor=cfg.lr_decay_factor,
+                            every=cfg.lr_decay_every,
+                            decay_at_epoch0=cfg.decay_at_epoch0)
+            if epoch % cfg.val_every == 0:
+                results.append(self._validate_and_checkpoint(epoch))
+            print(f"[epoch {epoch}] lr={lr:.6g}")
+            self._train_epoch(epoch, lr)
+            if cfg.ckpt_every_epochs and (epoch + 1) % cfg.ckpt_every_epochs == 0:
+                self.ckpt.save(self.state)
+        results.append(self._validate_and_checkpoint(cfg.epoch_num))
+        self.ckpt.save(self.state)
+        return results
+
+    def _validate_and_checkpoint(self, epoch: int) -> ValidationResult:
+        result = self.validate(epoch)
+        acc = result.primary_accuracy
+        if acc >= self.cfg.acc_gate:
+            path = self.ckpt.save_best(self.state, acc)
+            if path:
+                print(f"[ckpt] best {self.primary_task} acc={acc:.5f} "
+                      f"-> {path}")
+        return result
+
+    def test(self) -> ValidationResult:
+        """Eval entry: exactly one validation pass (reference utils.py:339-340
+        via the is_test early return)."""
+        return self.validate(int(jax.device_get(self.state.epoch)))

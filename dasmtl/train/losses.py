@@ -43,7 +43,9 @@ def single_task_loss(outputs, batch, task: str):
 
 def multi_classifier_loss(outputs, batch):
     """Cross-entropy on the 32-way mixed label distance + 16*event."""
-    mixed = batch["distance"] + 16 * batch["event"]
+    from dasmtl.config import mixed_label
+
+    mixed = mixed_label(batch["distance"], batch["event"])
     logits = outputs[0]
     log_probs = jax.nn.log_softmax(logits, axis=-1)
     l = weighted_nll(log_probs, mixed, batch["weight"])

@@ -27,6 +27,7 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from dasmtl.config import mixed_label
 from dasmtl.models.registry import ModelSpec
 from dasmtl.train.state import TrainState
 
@@ -40,7 +41,7 @@ def _weighted_correct(preds: jax.Array, labels: jax.Array,
 
 def _batch_labels(batch: Batch) -> Dict[str, jax.Array]:
     labels = {"distance": batch["distance"], "event": batch["event"]}
-    labels["mixed"] = batch["distance"] + 16 * batch["event"]
+    labels["mixed"] = mixed_label(batch["distance"], batch["event"])
     return labels
 
 
@@ -73,12 +74,14 @@ def make_train_step(spec: ModelSpec):
         labels = _batch_labels(batch)
         weight = batch["weight"]
         n = weight.sum()
-        metrics = {"loss": loss, "count": n}
+        # spec.loss_fn returns weighted means; convert to weighted sums
+        # (* n) so ragged final batches aggregate exactly on the host.
+        metrics = {"loss_sum": loss * n, "count": n}
         for task in preds:
             metrics[f"correct_{task}"] = _weighted_correct(
                 preds[task], labels[task], weight)
         for k, v in parts.items():
-            metrics[f"loss_{k}"] = v
+            metrics[f"loss_sum_{k}"] = v * n
         return new_state, metrics
 
     return jax.jit(train_step, donate_argnums=(0,))

@@ -21,7 +21,8 @@ class Logger:
         self.path = path
         self.stream = stream if stream is not None else sys.stdout
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        self._file = open(path, "a", encoding="utf-8")
+        # Line-buffered so the log is complete even if the process dies.
+        self._file = open(path, "a", encoding="utf-8", buffering=1)
 
     def write(self, message: str) -> None:
         self.stream.write(message)

@@ -1,0 +1,109 @@
+"""Post-run rendering of metric curves and confusion matrices.
+
+The reference re-loads its ``.npy`` metric lines after training and renders
+per-task matplotlib PNGs (utils.py:180-204), and in test mode renders every
+``confusion matrix*.npy`` as a seaborn heatmap SVG with the class names
+``['0m'..'15m']`` / ``['Striking', 'Excavating']`` (utils.py:51-75, 207-221).
+Same artifacts here, rendered with matplotlib only (Agg backend — safe on
+headless TPU hosts).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+
+DISTANCE_CLASS_NAMES = tuple(f"{k}m" for k in range(16))
+EVENT_CLASS_NAMES = ("Striking", "Excavating")
+
+
+def class_names_for(num_classes: int) -> Sequence[str]:
+    """The reference distinguishes tasks by matrix size (utils.py:212-218)."""
+    if num_classes == 2:
+        return EVENT_CLASS_NAMES
+    if num_classes == 16:
+        return DISTANCE_CLASS_NAMES
+    return tuple(str(i) for i in range(num_classes))
+
+
+def plot_curve(values: np.ndarray, title: str, ylabel: str,
+               out_path: str, xlabel: str = "step") -> None:
+    fig, ax = plt.subplots(figsize=(6, 4))
+    ax.plot(np.asarray(values))
+    ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+
+
+def plot_metric_lines(metrics_dir: str, out_dir: Optional[str] = None) -> list:
+    """Render every ``*.npy`` metric line in ``metrics_dir`` to a PNG —
+    the equivalent of the reference's post-run loop (utils.py:180-204)."""
+    out_dir = out_dir or metrics_dir
+    written = []
+    for name in sorted(os.listdir(metrics_dir)):
+        if not name.endswith(".npy") or "confusion" in name:
+            continue
+        values = np.load(os.path.join(metrics_dir, name))
+        if values.ndim != 1 or values.size == 0:
+            continue
+        stem = name[:-4]
+        out_path = os.path.join(out_dir, f"{stem}.png")
+        plot_curve(values, stem.replace("_", " "), stem.split("_")[-1],
+                   out_path)
+        written.append(out_path)
+    return written
+
+
+def draw_confusion_matrix(cm: np.ndarray, out_path: str,
+                          class_names: Optional[Sequence[str]] = None,
+                          title: str = "confusion matrix") -> None:
+    """Heatmap with counts annotated per cell, saved as SVG (reference
+    utils.py:51-75 uses seaborn; plain matplotlib is equivalent)."""
+    cm = np.asarray(cm)
+    n = cm.shape[0]
+    names = list(class_names or class_names_for(n))
+    fig, ax = plt.subplots(figsize=(max(4, 0.5 * n + 2),) * 2)
+    im = ax.imshow(cm, cmap="Blues")
+    fig.colorbar(im, ax=ax, fraction=0.046)
+    ax.set_xticks(range(n), names, rotation=45, ha="right")
+    ax.set_yticks(range(n), names)
+    ax.set_xlabel("Predicted label")
+    ax.set_ylabel("True label")
+    ax.set_title(title)
+    thresh = cm.max() / 2 if cm.size else 0
+    for i in range(n):
+        for j in range(n):
+            ax.text(j, i, str(int(cm[i, j])), ha="center", va="center",
+                    fontsize=7,
+                    color="white" if cm[i, j] > thresh else "black")
+    fig.tight_layout()
+    fig.savefig(out_path)
+    plt.close(fig)
+
+
+def render_confusion_matrices(metrics_dir: str,
+                              out_dir: Optional[str] = None) -> list:
+    """Render every saved ``confusion_matrix_*.npy`` to SVG (reference test
+    mode, utils.py:207-221)."""
+    out_dir = out_dir or metrics_dir
+    written = []
+    for name in sorted(os.listdir(metrics_dir)):
+        if not (name.startswith("confusion_matrix") and name.endswith(".npy")):
+            continue
+        cm = np.load(os.path.join(metrics_dir, name))
+        stem = name[:-4]
+        out_path = os.path.join(out_dir, f"{stem}.svg")
+        draw_confusion_matrix(cm, out_path, title=stem.replace("_", " "))
+        written.append(out_path)
+    return written

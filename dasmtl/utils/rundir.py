@@ -13,7 +13,10 @@ import os
 
 
 def make_run_dir(savedir: str, model_type: str, is_test: bool) -> str:
-    ts = datetime.datetime.now().strftime("%m-%d-%H_%M_%S")
+    # Year included (unlike the reference's %m-%d prefix, utils.py:100-101):
+    # year-less names sort wrongly across New Year, which would break any
+    # name-ordered tooling over the savedir.
+    ts = datetime.datetime.now().strftime("%Y-%m-%d-%H_%M_%S")
     name = f"{ts} model_type={model_type} is_test={is_test}"
     path = os.path.join(savedir, name)
     os.makedirs(path, exist_ok=True)

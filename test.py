@@ -1,0 +1,26 @@
+"""Evaluation CLI — TPU-native equivalent of the reference ``test.py``.
+
+Restores a checkpoint (``--model_path``), runs one full validation pass over
+the test trees, prints the per-task metric bundle and renders confusion-matrix
+SVGs (reference test.py:30-39 -> utils.py:245-340 early return).  The
+reference's Windows-ism default path ``'E:./dataset/striking_test'``
+(test.py:23) is replaced by a portable default.
+"""
+
+import sys
+
+from train import _apply_device_flag
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    _apply_device_flag(argv)
+    from dasmtl.config import parse_test_args
+    from dasmtl.main import main_process
+
+    cfg = parse_test_args(argv)
+    main_process(cfg, is_test=True)
+
+
+if __name__ == "__main__":
+    main()

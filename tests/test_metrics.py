@@ -1,0 +1,53 @@
+"""Host metric parity against sklearn (the reference's metric source,
+utils.py:297-322)."""
+
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from dasmtl.train import metrics as m
+
+RNG = np.random.default_rng(42)
+CASES = [
+    (RNG.integers(0, 16, 200), RNG.integers(0, 16, 200), 16),
+    (RNG.integers(0, 2, 50), RNG.integers(0, 2, 50), 2),
+    # A class never predicted and a class never true (zero-division paths).
+    (np.array([0, 0, 1, 1, 2]), np.array([0, 0, 0, 0, 0]), 4),
+]
+
+
+@pytest.mark.parametrize("y_true,y_pred,n", CASES)
+def test_confusion_matrix_parity(y_true, y_pred, n):
+    np.testing.assert_array_equal(
+        m.confusion_matrix(y_true, y_pred, n),
+        skm.confusion_matrix(y_true, y_pred, labels=range(n)))
+
+
+@pytest.mark.parametrize("y_true,y_pred,n", CASES)
+def test_accuracy_parity(y_true, y_pred, n):
+    assert m.accuracy(y_true, y_pred) == pytest.approx(
+        skm.accuracy_score(y_true, y_pred))
+
+
+@pytest.mark.parametrize("y_true,y_pred,n", CASES)
+def test_per_class_f1_parity(y_true, y_pred, n):
+    np.testing.assert_allclose(
+        m.per_class_f1(y_true, y_pred, n),
+        skm.f1_score(y_true, y_pred, labels=range(n), average=None,
+                     zero_division=0))
+
+
+@pytest.mark.parametrize("y_true,y_pred,n", CASES)
+def test_weighted_prf_parity(y_true, y_pred, n):
+    got = m.weighted_prf(y_true, y_pred, n)
+    labels = range(n)
+    assert got["precision"] == pytest.approx(skm.precision_score(
+        y_true, y_pred, labels=labels, average="weighted", zero_division=0))
+    assert got["recall"] == pytest.approx(skm.recall_score(
+        y_true, y_pred, labels=labels, average="weighted", zero_division=0))
+    assert got["f1"] == pytest.approx(skm.f1_score(
+        y_true, y_pred, labels=labels, average="weighted", zero_division=0))
+
+
+def test_distance_mae():
+    assert m.distance_mae([0, 4, 10], [1, 4, 7]) == pytest.approx(4 / 3)

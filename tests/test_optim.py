@@ -1,0 +1,109 @@
+"""Optimizer parity: coupled-L2 Adam must follow torch.optim.Adam exactly
+(the reference's optimizer, utils.py:133-134), and the stepped LR schedule
+must match the reference's decay rule (utils.py:230-247 vs 622-625)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from dasmtl.train.optim import coupled_adam, stepped_lr
+from dasmtl.train.state import TrainState
+
+
+def test_coupled_adam_matches_torch_trajectory():
+    rng = np.random.default_rng(0)
+    w0 = rng.normal(size=(5, 3)).astype(np.float32)
+    lr, wd = 1e-3, 1e-5
+
+    # torch side: Adam with coupled weight_decay on a fixed quadratic-ish loss.
+    wt = torch.nn.Parameter(torch.from_numpy(w0.copy()))
+    opt = torch.optim.Adam([wt], lr=lr, weight_decay=wd)
+    target = torch.from_numpy(rng.normal(size=(5, 3)).astype(np.float32))
+    torch_traj = []
+    for _ in range(10):
+        opt.zero_grad()
+        loss = ((wt - target) ** 2).sum()
+        loss.backward()
+        opt.step()
+        torch_traj.append(wt.detach().numpy().copy())
+
+    # jax side: same loss, coupled_adam + external lr scaling.
+    tx = coupled_adam(weight_decay=wd)
+    params = {"w": jnp.asarray(w0)}
+    opt_state = tx.init(params)
+    tgt = jnp.asarray(target.numpy())
+
+    def loss_fn(p):
+        return ((p["w"] - tgt) ** 2).sum()
+
+    import optax
+    for i in range(10):
+        grads = jax.grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        updates = jax.tree.map(lambda u: lr * u, updates)
+        params = optax.apply_updates(params, updates)
+        np.testing.assert_allclose(np.asarray(params["w"]), torch_traj[i],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_coupled_adam_differs_from_adamw():
+    """Guard against the silent adamw substitution (SURVEY.md §7 hard parts):
+    with a large decay the coupled and decoupled trajectories must diverge."""
+    import optax
+
+    w0 = jnp.ones((4,)) * 2.0
+    grads = jnp.ones((4,))
+
+    def run(tx, scale_lr):
+        params = w0
+        st = tx.init(params)
+        for _ in range(3):
+            u, st = tx.update(grads, st, params)
+            if scale_lr:
+                u = jax.tree.map(lambda x: 1e-2 * x, u)
+            params = optax.apply_updates(params, u)
+        return np.asarray(params)
+
+    ours = run(coupled_adam(weight_decay=0.5), scale_lr=True)
+    theirs = run(optax.adamw(1e-2, weight_decay=0.5), scale_lr=False)
+    assert not np.allclose(ours, theirs)
+
+
+@pytest.mark.parametrize("epoch,expected", [
+    (0, 1e-3 / 1.5), (4, 1e-3 / 1.5), (5, 1e-3 / 1.5 ** 2),
+    (14, 1e-3 / 1.5 ** 3),
+])
+def test_stepped_lr_mtl_rule(epoch, expected):
+    # MTL/single-task: decay fires at epochs 0, 5, 10 (utils.py:245-247).
+    assert stepped_lr(epoch) == pytest.approx(expected)
+
+
+@pytest.mark.parametrize("epoch,expected", [
+    (0, 1e-3), (4, 1e-3), (5, 1e-3 / 1.5), (10, 1e-3 / 1.5 ** 2),
+])
+def test_stepped_lr_multiclassifier_rule(epoch, expected):
+    # Multi-classifier: epoch 0 is skipped (utils.py:622-625).
+    assert stepped_lr(epoch, decay_at_epoch0=False) == pytest.approx(expected)
+
+
+def test_train_state_lr_is_traced_not_baked():
+    """Changing lr must not recompile the update (lr enters as an array)."""
+    tx = coupled_adam()
+    params = {"w": jnp.ones((3,))}
+    state = TrainState.create(apply_fn=lambda *a, **k: None, params=params,
+                              batch_stats={}, tx=tx)
+    grads = {"w": jnp.ones((3,))}
+
+    calls = []
+
+    @jax.jit
+    def step(state, lr):
+        calls.append(1)  # traced once only
+        return state.apply_updates(grads, lr)
+
+    s1 = step(state, jnp.float32(1e-3))
+    s2 = step(s1, jnp.float32(5e-4))
+    assert len(calls) == 1
+    assert int(s2.step) == 2

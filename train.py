@@ -1,0 +1,42 @@
+"""Training CLI — TPU-native equivalent of the reference ``train.py``.
+
+Same flag surface as the reference entry (reference train.py:7-26) plus the
+hyperparameters it hard-codes, with ``--device={tpu,cpu,auto}`` replacing the
+``--GPU_device`` bool-trap flag (reference train.py:10,17 — ``type=bool`` makes
+any string truthy).  ``--device`` must be resolved before JAX initializes, so
+it is applied to ``JAX_PLATFORMS`` here, before any dasmtl/jax import.
+"""
+
+import os
+import sys
+
+
+def _apply_device_flag(argv) -> None:
+    for i, arg in enumerate(argv):
+        if arg == "--device" and i + 1 < len(argv):
+            value = argv[i + 1]
+        elif arg.startswith("--device="):
+            value = arg.split("=", 1)[1]
+        else:
+            continue
+        if value == "cpu":
+            # Force CPU even when the environment pre-selects an accelerator
+            # platform (e.g. JAX_PLATFORMS=axon on tunneled-TPU hosts).
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        elif value == "tpu" and not os.environ.get("JAX_PLATFORMS"):
+            os.environ["JAX_PLATFORMS"] = "tpu"
+        return
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    _apply_device_flag(argv)
+    from dasmtl.config import parse_train_args
+    from dasmtl.main import main_process
+
+    cfg = parse_train_args(argv)
+    main_process(cfg, is_test=False)
+
+
+if __name__ == "__main__":
+    main()
