@@ -25,9 +25,10 @@ Semantics preserved from the reference:
 
 TPU shape of the loop: the jitted train step fuses forward+loss+backward+
 update+BN-stats+decode into one XLA computation; the host only sees a handful
-of scalar metric sums per step.  Metric scalars are fetched with a one-step
-delay (``_MetricWindow`` keeps device arrays and converts lazily) so the host
-never blocks the device pipeline — steps stay enqueued back-to-back.
+of scalar metric sums per step.  The train loop accumulates those scalars as
+*device* arrays and converts to Python floats only at window-flush time, so
+the host never blocks the device pipeline mid-window — steps stay enqueued
+back-to-back.
 """
 
 from __future__ import annotations
@@ -101,9 +102,14 @@ class Trainer:
         self.lines = MetricLines(self.metrics_dir)
         self.ckpt = CheckpointManager(run_dir, max_keep=cfg.ckpt_max_keep)
         self.jsonl_path = os.path.join(self.metrics_dir, "metrics.jsonl")
-        # Primary gated task: first reported head (distance for MTL — the
-        # reference's gate, utils.py:329).
-        self.primary_task = spec.report_tasks[0][0]
+        # Gated task: the reference gates every trainer on *distance* accuracy
+        # when the model predicts distance — including the multi-classifier,
+        # whose 0.95 gate is on the decoded distance head, not the 32-way
+        # mixed accuracy (utils.py:329, 682-685, 716).  Models without a
+        # distance head (single_event) gate on their own task (utils.py:517).
+        reported = [t for t, _ in spec.report_tasks]
+        self.primary_task = ("distance" if "distance" in reported
+                             else reported[0])
         # Validation uses the same global batch as training so a dp-mesh
         # keeps every device fed (cfg.batch_size is per-device).
         self.eval_batch_size = cfg.batch_size * (

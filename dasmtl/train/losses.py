@@ -16,6 +16,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from dasmtl.config import mixed_label
+
 
 def weighted_nll(log_probs: jax.Array, labels: jax.Array,
                  weight: jax.Array) -> jax.Array:
@@ -43,8 +45,6 @@ def single_task_loss(outputs, batch, task: str):
 
 def multi_classifier_loss(outputs, batch):
     """Cross-entropy on the 32-way mixed label distance + 16*event."""
-    from dasmtl.config import mixed_label
-
     mixed = mixed_label(batch["distance"], batch["event"])
     logits = outputs[0]
     log_probs = jax.nn.log_softmax(logits, axis=-1)

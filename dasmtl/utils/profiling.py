@@ -1,0 +1,107 @@
+"""Model-complexity and step-timing instrumentation.
+
+The reference ships a ptflops MACs/params measurement, commented out
+(utils.py:127-131), and wall-clock deltas printed every 100 batches
+(utils.py:228,390); its README's headline efficiency claim is that the MTL
+network costs 67.8% of running both single-task baselines and 19.8% of the
+single-level multi-classifier (README.md:8).  Here the same numbers come from
+the compiler: ``jax.jit(...).lower(...).cost_analysis()`` reports the FLOPs
+of the exact XLA computation that will run, and ``jax.profiler`` traces
+replace ad-hoc timers (wired via ``--profile_dir``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def flops_of(fn: Callable, *args, **kwargs) -> Optional[float]:
+    """FLOPs of one call of jitted ``fn`` per XLA's cost model; ``None`` when
+    the backend doesn't report them."""
+    lowered = jax.jit(fn).lower(*args, **kwargs)
+    cost = lowered.compile().cost_analysis()
+    if not cost:
+        return None
+    if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+        cost = cost[0]
+    return float(cost.get("flops")) if "flops" in cost else None
+
+
+def model_complexity(model, input_shape: Tuple[int, ...] = (1, 100, 250, 1),
+                     ) -> Dict[str, Any]:
+    """Params + forward FLOPs for a Flax module — the ptflops replacement."""
+    x = jnp.zeros(input_shape, jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    params = sum(int(np.prod(p.shape))
+                 for p in jax.tree.leaves(variables["params"]))
+
+    def forward(variables, x):
+        return model.apply(variables, x, train=False)
+
+    return {"params": params,
+            "forward_flops": flops_of(forward, variables, x)}
+
+
+def complexity_report(input_shape: Tuple[int, ...] = (1, 100, 250, 1),
+                      ) -> Dict[str, Any]:
+    """Params/FLOPs for every model family plus the paper's two relative-cost
+    ratios (README.md:8) computed from the compiled graphs."""
+    from dasmtl.models import MTLNet, SingleTaskNet
+    from dasmtl.models.inception import InceptionV3Classifier
+
+    report: Dict[str, Any] = {
+        "MTL": model_complexity(MTLNet(), input_shape),
+        "single_distance": model_complexity(SingleTaskNet("distance"),
+                                            input_shape),
+        "single_event": model_complexity(SingleTaskNet("event"), input_shape),
+        "multi_classifier": model_complexity(
+            InceptionV3Classifier(num_classes=32), input_shape),
+    }
+    mtl = report["MTL"]["forward_flops"]
+    both_single = (report["single_distance"]["forward_flops"] or 0) + (
+        report["single_event"]["forward_flops"] or 0)
+    multi = report["multi_classifier"]["forward_flops"]
+    if mtl and both_single:
+        report["mtl_vs_both_single_tasks"] = mtl / both_single
+    if mtl and multi:
+        report["mtl_vs_multi_classifier"] = mtl / multi
+    return report
+
+
+class StepTimer:
+    """Wall-clock step timing with correct device-async semantics: ``stop``
+    blocks on the step's outputs before reading the clock, so the measured
+    interval covers device execution, not just dispatch."""
+
+    def __init__(self):
+        self.times = []
+        self._t0 = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, *outputs) -> float:
+        for out in outputs:
+            jax.block_until_ready(out)
+        dt = time.perf_counter() - self._t0
+        self.times.append(dt)
+        return dt
+
+    def summary(self) -> Dict[str, float]:
+        arr = np.asarray(self.times)
+        if arr.size == 0:
+            return {}
+        return {"mean_s": float(arr.mean()), "p50_s": float(np.median(arr)),
+                "min_s": float(arr.min()), "max_s": float(arr.max()),
+                "steps": int(arr.size)}
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(complexity_report(), indent=2))

@@ -1,0 +1,59 @@
+"""InceptionV3 multi-classifier (model C) golden tests.
+
+The reference assembles torchvision InceptionV3 with a 1-channel stem and 32
+classes, aux head disabled (modelC_multiClassifier.py:35-36,63,78-80);
+torchvision is not available in this environment (SURVEY.md §2.2), so the
+goldens here are this implementation's measured values — 21,850,560 params is
+consistent with stock InceptionV3 (~23.8 M at 1000 classes incl. aux) minus
+the aux head (~1.9 M) and the smaller fc (2048x32 vs 2048x1000, ~2.0 M) and
+the 1-channel stem."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dasmtl.models.inception import InceptionV3Classifier
+
+
+@pytest.fixture(scope="module")
+def model_and_vars():
+    m = InceptionV3Classifier(num_classes=32)
+    v = m.init({"params": jax.random.PRNGKey(0),
+                "dropout": jax.random.PRNGKey(1)},
+               jnp.zeros((1, 100, 250, 1)), train=False)
+    return m, v
+
+
+def test_param_count_golden(model_and_vars):
+    _, v = model_and_vars
+    n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(v["params"]))
+    assert n == 21_850_560
+
+
+def test_output_shape_and_logits(model_and_vars):
+    m, v = model_and_vars
+    (out,) = m.apply(v, jnp.ones((3, 100, 250, 1)), train=False)
+    assert out.shape == (3, 32)
+    # Raw logits (CE loss applies log_softmax), not log-probabilities.
+    assert not np.allclose(np.exp(np.asarray(out)).sum(-1), 1.0)
+
+
+def test_dropout_is_stochastic_in_train_mode(model_and_vars):
+    m, v = model_and_vars
+    x = jnp.ones((2, 100, 250, 1))
+    kw = dict(train=True, mutable=["batch_stats"])
+    (o1,), _ = m.apply(v, x, rngs={"dropout": jax.random.PRNGKey(1)}, **kw)
+    (o2,), _ = m.apply(v, x, rngs={"dropout": jax.random.PRNGKey(2)}, **kw)
+    assert not np.allclose(np.asarray(o1), np.asarray(o2))
+    # Eval mode is deterministic.
+    (e1,) = m.apply(v, x, train=False)
+    (e2,) = m.apply(v, x, train=False)
+    np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
+
+
+def test_one_channel_stem(model_and_vars):
+    _, v = model_and_vars
+    stem = v["params"]["Conv2d_1a_3x3"]["conv"]["kernel"]
+    assert stem.shape[2] == 1  # 1 input channel (reference :63)
+    assert stem.shape[3] == 32

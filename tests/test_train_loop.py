@@ -135,3 +135,15 @@ def test_restore_weights_is_weights_only(tmp_path, tiny_arrays):
     got = jax.tree.leaves(jax.device_get(restored.params))
     for a, b in zip(trained, got):
         np.testing.assert_array_equal(a, b)
+
+
+def test_primary_gate_task_matches_reference(tmp_path, tiny_arrays):
+    # The reference gates every trainer that predicts distance on *distance*
+    # accuracy — incl. the multi-classifier (utils.py:329, 682-685, 716);
+    # single_event gates on its own task (utils.py:517).
+    assert _mk_trainer(tmp_path / "a", tiny_arrays,
+                       model="MTL").primary_task == "distance"
+    assert _mk_trainer(tmp_path / "b", tiny_arrays,
+                       model="multi_classifier").primary_task == "distance"
+    assert _mk_trainer(tmp_path / "c", tiny_arrays,
+                       model="single_event").primary_task == "event"

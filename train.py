@@ -23,8 +23,14 @@ def _apply_device_flag(argv) -> None:
             # Force CPU even when the environment pre-selects an accelerator
             # platform (e.g. JAX_PLATFORMS=axon on tunneled-TPU hosts).
             os.environ["JAX_PLATFORMS"] = "cpu"
-        elif value == "tpu" and not os.environ.get("JAX_PLATFORMS"):
-            os.environ["JAX_PLATFORMS"] = "tpu"
+        elif value == "tpu":
+            current = os.environ.get("JAX_PLATFORMS", "")
+            if not current or current == "cpu":
+                # Honor the explicit flag even over a leftover cpu export
+                # (e.g. from a test-suite invocation); fails loudly on hosts
+                # without a TPU rather than silently training on CPU.  A
+                # non-cpu preset (tpu plugin platforms) is left as-is.
+                os.environ["JAX_PLATFORMS"] = "tpu"
         return
 
 
