@@ -14,7 +14,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from dasmtl.data import matio
+from dasmtl.data import matio, native
 from dasmtl.data.splits import Example
 from dasmtl.data.transforms import add_gaussian_snr, to_sample
 
@@ -38,6 +38,27 @@ def _load_one(path: str, key: str, noise_snr_db: Optional[float],
     return to_sample(mat)
 
 
+def _load_batch(paths, key: str, noise_snr_db: Optional[float],
+                rng: Optional[np.random.Generator]) -> np.ndarray:
+    """Load a list of same-shaped .mat files as [N, H, W, 1] float32, using
+    the native multithreaded loader when it is available and falling back to
+    the per-file scipy path otherwise."""
+    paths = list(paths)
+    if not paths:
+        return np.zeros((0, 0, 0, 1), np.float32)
+    if native.available():
+        try:
+            rows, cols = native.mat_dims(paths[0], key)
+            batch = native.load_many_f32(paths, key, rows, cols)
+            if noise_snr_db is not None:
+                for i in range(batch.shape[0]):
+                    batch[i] = add_gaussian_snr(batch[i], noise_snr_db, rng)
+            return batch[..., None]
+        except native.NativeMatError:
+            pass  # e.g. heterogeneous shapes or exotic MAT features
+    return np.stack([_load_one(p, key, noise_snr_db, rng) for p in paths])
+
+
 class RamSource(_SourceBase):
     """Eagerly loads every example into one contiguous [N, H, W, 1] array."""
 
@@ -46,12 +67,11 @@ class RamSource(_SourceBase):
                  noise_seed: int = 0, show_progress: bool = False):
         self.examples = list(examples)
         rng = np.random.default_rng(noise_seed)
-        it = self.examples
         if show_progress:
-            from tqdm import tqdm
-            it = tqdm(it, desc="preloading .mat files")
-        mats = [_load_one(ex.path, key, noise_snr_db, rng) for ex in it]
-        self.x = np.stack(mats) if mats else np.zeros((0, 0, 0, 1), np.float32)
+            print(f"preloading {len(self.examples)} .mat files "
+                  f"({'native' if native.available() else 'scipy'} loader)")
+        self.x = _load_batch([ex.path for ex in self.examples], key,
+                             noise_snr_db, rng)
         self.distance = np.array([ex.distance for ex in self.examples], np.int32)
         self.event = np.array([ex.event for ex in self.examples], np.int32)
 
@@ -72,10 +92,9 @@ class DiskSource(_SourceBase):
         self.event = np.array([ex.event for ex in self.examples], np.int32)
 
     def gather(self, indices: np.ndarray) -> np.ndarray:
-        return np.stack([
-            _load_one(self.examples[i].path, self.key, self.noise_snr_db,
-                      self._rng)
-            for i in np.asarray(indices)])
+        return _load_batch(
+            [self.examples[i].path for i in np.asarray(indices)],
+            self.key, self.noise_snr_db, self._rng)
 
 
 class ArraySource(_SourceBase):
