@@ -75,6 +75,10 @@ class Config:
     test_set_striking: str = "./dataset/striking_test"
     test_set_excavating: str = "./dataset/excavating_test"
     mat_key: str = "data"
+    # Background-thread batch prefetch depth: gather + device_put of batch
+    # i+1 overlap step i's device compute (the reference's loader is fully
+    # synchronous, utils.py:152-156).  0 disables.
+    prefetch_batches: int = 2
     # Opt-in SNR-targeted Gaussian noise for robustness evals
     # (reference dataset_preparation.py:83-105; disabled there at :244-245).
     noise_snr_db: Optional[float] = None
@@ -84,10 +88,12 @@ class Config:
     dp: int = -1  # data-parallel mesh size; -1 = all visible devices
     sp: int = 1  # spatial-parallel mesh size over the fiber-channel axis
     compute_dtype: str = "float32"  # float32 | bfloat16 (params stay f32)
-    # BatchNorm under GSPMD jit uses *global* batch statistics (XLA inserts the
-    # cross-device reductions) — i.e. sync-BN. With per-device batch == the
-    # reference's batch 32 this differs from the reference's per-replica stats;
-    # documented design choice (SURVEY.md §7 step 5).
+    # BatchNorm semantics under data parallelism (SURVEY.md §7 step 5):
+    # "global" = sync-BN over the full sharded batch (GSPMD inserts the
+    # cross-device reductions); "per_replica" = each device normalizes with
+    # its own shard's statistics — the reference's per-GPU semantics when the
+    # per-device batch equals the reference's 32 (utils.py:249-250).
+    bn_sync: str = "global"
 
     # ---- run outputs (reference utils.py:100-116) ----
     output_savedir: str = "./runs"
@@ -110,6 +116,8 @@ class Config:
             raise ValueError(f"unknown device {self.device!r}")
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
+        if self.bn_sync not in ("global", "per_replica"):
+            raise ValueError(f"unknown bn_sync {self.bn_sync!r}")
 
     @property
     def decay_at_epoch0(self) -> bool:
@@ -180,9 +188,15 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
                    help="spatial-parallel devices over the fiber axis")
     p.add_argument("--compute_dtype", type=str, default=d.compute_dtype,
                    choices=["float32", "bfloat16"])
+    p.add_argument("--bn_sync", type=str, default=d.bn_sync,
+                   choices=["global", "per_replica"],
+                   help="BatchNorm statistics under dp: global (sync-BN) or "
+                        "per-replica (reference per-GPU semantics)")
     p.add_argument("--seed", type=int, default=d.seed)
     p.add_argument("--noise_snr_db", type=float, default=None,
                    help="opt-in Gaussian noise SNR (dB) for robustness evals")
+    p.add_argument("--prefetch_batches", type=int, default=d.prefetch_batches,
+                   help="batch prefetch depth (0 disables the overlap thread)")
     p.add_argument("--use_pallas", action=argparse.BooleanOptionalAction,
                    default=d.use_pallas)
     p.add_argument("--resume", action=argparse.BooleanOptionalAction,

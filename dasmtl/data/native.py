@@ -5,16 +5,19 @@ a multithreaded batch loader filling a preallocated [N, H, W] float32 buffer —
 replacing the reference's one-file-at-a-time ``scipy.io.loadmat`` loop
 (dataset_preparation.py:262-265 eager preload, :311-320 per-item loads; its
 DataLoader runs ``num_workers=0``, utils.py:154-156, so nothing there is
-parallel).  The shared library is compiled on demand with g++ and cached next
-to the source; any build or parse failure falls back to scipy transparently
+parallel).  The shared library is compiled on demand with g++ into a cache
+directory (source-hash-named, so stale binaries can't shadow edits); any
+build, load, or parse failure falls back to scipy transparently
 (:func:`available` reports which path is active).
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
+import tempfile
 import threading
 from typing import Optional, Sequence
 
@@ -29,24 +32,71 @@ _ERROR_NAMES = {
 
 _SRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native", "dasmat.cpp")
-_LIB_PATH = os.path.join(os.path.dirname(_SRC), "libdasmat.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
 
+def _cache_dir() -> Optional[str]:
+    """A private (0700, owned-by-us) cache dir for the built .so, or None.
+
+    Never a shared world-writable directory: ``ctypes.CDLL`` on a
+    predictable path in /tmp would let another local user plant code.  The
+    fallback is a per-uid 0700 subdir of the temp dir, and ownership/mode are
+    verified before use (failure degrades to the scipy loader, never to an
+    unsafe load).
+    """
+    candidates = []
+    if os.environ.get("DASMTL_CACHE_DIR"):
+        candidates.append(os.environ["DASMTL_CACHE_DIR"])
+    candidates.append(os.path.join(os.path.expanduser("~"), ".cache",
+                                   "dasmtl"))
+    candidates.append(os.path.join(tempfile.gettempdir(),
+                                   f"dasmtl-{os.getuid()}"))
+    for path in candidates:
+        try:
+            os.makedirs(path, mode=0o700, exist_ok=True)
+            st = os.stat(path)
+            if st.st_uid != os.getuid() or (st.st_mode & 0o022):
+                continue  # not ours / group-or-world writable
+            return path
+        except OSError:
+            continue
+    return None
+
+
 def _build() -> Optional[str]:
-    """Compile the shared library if missing or stale; None on failure."""
-    if os.path.exists(_LIB_PATH) and (
-            os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC)):
-        return _LIB_PATH
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _LIB_PATH,
+    """Compile the shared library into the cache dir; None on failure.
+
+    The artifact name embeds a hash of the source, so a source edit can never
+    silently run a stale binary (an mtime comparison can — near-equal checkout
+    mtimes let an old ``.so`` shadow newer source), and nothing binary lives
+    in the repo tree.
+    """
+    try:
+        with open(_SRC, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        return None
+    cache_dir = _cache_dir()
+    if cache_dir is None:
+        return None
+    lib_path = os.path.join(cache_dir, f"libdasmat-{digest}.so")
+    if os.path.exists(lib_path):
+        return lib_path
+    tmp = f"{lib_path}.tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp,
            _SRC, "-lz", "-pthread"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return _LIB_PATH
+        os.replace(tmp, lib_path)
+        return lib_path
     except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
 
 
@@ -55,24 +105,31 @@ def _load() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
-        path = _build()
-        if path is None:
+        try:
+            path = _build()
+            if path is None:
+                _build_failed = True
+                return None
+            lib = ctypes.CDLL(path)
+            lib.das_mat_dims.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
+            lib.das_mat_dims.restype = ctypes.c_int
+            lib.das_load_mat_f32.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int]
+            lib.das_load_mat_f32.restype = ctypes.c_int
+            lib.das_load_many_f32.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+            lib.das_load_many_f32.restype = ctypes.c_int
+        except (OSError, AttributeError):
+            # CDLL load failure (wrong arch/libc, missing libz) or missing
+            # symbols — degrade to the scipy path instead of crashing the
+            # data layer.
             _build_failed = True
             return None
-        lib = ctypes.CDLL(path)
-        lib.das_mat_dims.argtypes = [
-            ctypes.c_char_p, ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
-        lib.das_mat_dims.restype = ctypes.c_int
-        lib.das_load_mat_f32.argtypes = [
-            ctypes.c_char_p, ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int]
-        lib.das_load_mat_f32.restype = ctypes.c_int
-        lib.das_load_many_f32.argtypes = [
-            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
-        lib.das_load_many_f32.restype = ctypes.c_int
         _lib = lib
         return _lib
 

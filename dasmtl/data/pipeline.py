@@ -18,13 +18,74 @@ A batch is a dict of numpy arrays:
 from __future__ import annotations
 
 import math
-from typing import Dict, Iterator
+import queue
+import threading
+from typing import Callable, Dict, Iterator, Optional
 
 import numpy as np
 
 from dasmtl.data.sources import _SourceBase
 
 Batch = Dict[str, np.ndarray]
+
+
+def prefetch(iterator: Iterator, depth: int = 2,
+             place_fn: Optional[Callable] = None) -> Iterator:
+    """Background-thread prefetch: produce up to ``depth`` items ahead so host
+    batch assembly (and optionally device placement via ``place_fn``) overlaps
+    device compute.
+
+    The reference's loader is fully synchronous (``num_workers=0``,
+    utils.py:152-156): every batch's disk read + collate sits on the critical
+    path.  Here batch ``i+1`` is gathered (``DiskSource`` .mat parsing, padding,
+    ``device_put``) while step ``i`` runs on the accelerator.  ``depth <= 0``
+    degrades to plain iteration.  Exceptions in the worker re-raise at the
+    consumption point.
+    """
+    if depth <= 0:
+        for item in iterator:
+            yield place_fn(item) if place_fn else item
+        return
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    sentinel = object()
+    stop = threading.Event()
+    failure = []
+
+    def worker():
+        try:
+            for item in iterator:
+                item = place_fn(item) if place_fn else item
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as exc:  # surfaced to the consumer below
+            failure.append(exc)
+        finally:
+            while not stop.is_set():
+                try:
+                    q.put(sentinel, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+    thread = threading.Thread(target=worker, daemon=True,
+                              name="dasmtl-prefetch")
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        thread.join()
+        if failure:
+            raise failure[0]
+    finally:
+        stop.set()
 
 
 def _make_batch(source: _SourceBase, idx: np.ndarray, batch_size: int) -> Batch:

@@ -29,6 +29,7 @@ from typing import List, Optional, Sequence, Tuple
 
 from sklearn.model_selection import KFold, train_test_split
 
+from dasmtl.config import mixed_label  # noqa: F401  (canonical encoding)
 from dasmtl.data.collector import DataCollector, distance_label_from_category
 
 EVENT_STRIKING = 0
@@ -85,9 +86,6 @@ def build_splits(striking_dir: str, excavating_dir: str, *,
             train.extend(Example(f, distance, event_id) for f in tr)
             val.extend(Example(f, distance, event_id) for f in va)
     return DatasetSplits(train=train, val=val)
-
-
-from dasmtl.config import mixed_label  # noqa: F401  (canonical encoding)
 
 
 def export_manifest_csv(examples: Sequence[Example], path: str) -> None:

@@ -26,10 +26,11 @@ import numpy as np
 from dasmtl.config import INPUT_HEIGHT, INPUT_WIDTH, Config
 from dasmtl.data.pipeline import BatchIterator
 from dasmtl.data.sources import DiskSource, RamSource, _SourceBase
-from dasmtl.data.splits import build_splits
+from dasmtl.data.splits import build_splits, export_manifest_csv
 from dasmtl.models.registry import ModelSpec, get_model_spec
 from dasmtl.parallel.mesh import (MeshPlan, create_mesh, replicated_sharding)
-from dasmtl.train.checkpoint import restore_latest_in, restore_weights
+from dasmtl.train.checkpoint import (best_metric_in_savedir,
+                                     restore_latest_in, restore_weights)
 from dasmtl.train.loop import Trainer, ValidationResult
 from dasmtl.train.optim import coupled_adam
 from dasmtl.train.state import TrainState
@@ -74,10 +75,13 @@ def replicate_state(state: TrainState, plan: Optional[MeshPlan]) -> TrainState:
 
 
 def build_sources(cfg: Config, is_test: bool,
+                  manifest_dir: Optional[str] = None,
                   ) -> Tuple[_SourceBase, _SourceBase]:
     """(train_source, val_source) per the reference's split semantics
     (dataset_preparation.py:118-239; in test mode every file of the *test*
-    tree lands in the val list, :139-147)."""
+    tree lands in the val list, :139-147).  With ``manifest_dir``, writes the
+    name/label CSV manifests the reference emits during dataset construction
+    (dataset_preparation.py:275-297)."""
     if is_test:
         striking, excavating = cfg.test_set_striking, cfg.test_set_excavating
     else:
@@ -87,6 +91,11 @@ def build_sources(cfg: Config, is_test: bool,
                           random_state=cfg.random_state,
                           fold_index=cfg.fold_index, is_test=is_test,
                           mat_keys=(cfg.mat_key,))
+    if manifest_dir is not None:
+        export_manifest_csv(splits.train,
+                            os.path.join(manifest_dir, "train_manifest.csv"))
+        export_manifest_csv(splits.val,
+                            os.path.join(manifest_dir, "val_manifest.csv"))
     src_cls = RamSource if cfg.dataset_ram else DiskSource
     kwargs = dict(key=cfg.mat_key, noise_snr_db=cfg.noise_snr_db,
                   noise_seed=cfg.seed)
@@ -129,7 +138,8 @@ def main_process(cfg: Config, is_test: bool = False,
             print(f"restored weights from {cfg.model_path}")
         state = replicate_state(state, plan)
 
-        train_source, val_source = build_sources(cfg, is_test)
+        train_source, val_source = build_sources(cfg, is_test,
+                                                 manifest_dir=run_dir)
         print(f"examples: train={len(train_source)} val={len(val_source)}")
         global_batch = cfg.batch_size * (plan.dp if plan else 1)
         train_iter = BatchIterator(train_source, global_batch, seed=cfg.seed)
@@ -144,6 +154,10 @@ def main_process(cfg: Config, is_test: bool = False,
                                         model=cfg.model)
             if resumed is not None:
                 trainer.state = replicate_state(resumed, plan)
+                # Inherit the gated-best floor from previous runs so a worse
+                # validation in this fresh run dir is never re-crowned 'best'.
+                trainer.ckpt.seed_best(best_metric_in_savedir(
+                    cfg.output_savedir, model=cfg.model))
                 print(f"resumed at epoch "
                       f"{int(jax.device_get(trainer.state.epoch))} from "
                       f"{cfg.output_savedir}")

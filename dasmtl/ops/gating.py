@@ -53,7 +53,9 @@ def _gate_pallas_fwd_impl(mask_logits: jax.Array,
                           features: jax.Array) -> jax.Array:
     from jax.experimental import pallas as pl
 
-    interpret = jax.default_backend() != "tpu"
+    # Compiled kernel on real TPU platforms ("tpu", or "axon" — this
+    # container's TPU-tunnel PJRT plugin); interpreter elsewhere (CPU tests).
+    interpret = jax.default_backend() not in ("tpu", "axon")
     b = mask_logits.shape[0]
     inner = mask_logits.shape[1:]
     grid = (b,)

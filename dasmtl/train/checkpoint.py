@@ -91,6 +91,15 @@ class CheckpointManager:
         return (os.path.join(self.root, f"step_{steps[-1]}")
                 if steps else None)
 
+    def seed_best(self, metric: Optional[float]) -> None:
+        """Raise the best-so-far floor (used when ``--resume`` continues a
+        previous run in a fresh run dir, so a worse validation is never
+        re-crowned 'best')."""
+        if metric is None:
+            return
+        if self._best_metric is None or metric > self._best_metric:
+            self._best_metric = metric
+
     # -- best (accuracy-gated, reference utils.py:329-334) -------------------
     def save_best(self, state: TrainState, metric: float) -> Optional[str]:
         if self._best_metric is not None and metric <= self._best_metric:
@@ -178,3 +187,20 @@ def best_metric_on_disk(run_dir: str) -> Optional[float]:
     if not os.path.exists(path):
         return None
     return float(np.loadtxt(path))
+
+
+def best_metric_in_savedir(savedir: str,
+                           model: Optional[str] = None) -> Optional[float]:
+    """Max gated-best metric across every run dir under ``savedir`` (filtered
+    by model family like :func:`find_latest_checkpoint`) — the floor a
+    ``--resume`` into a fresh run dir must inherit."""
+    if not os.path.isdir(savedir):
+        return None
+    best: Optional[float] = None
+    for run_name in os.listdir(savedir):
+        if model is not None and f"model_type={model} " not in run_name + " ":
+            continue
+        metric = best_metric_on_disk(os.path.join(savedir, run_name))
+        if metric is not None and (best is None or metric > best):
+            best = metric
+    return best

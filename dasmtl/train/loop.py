@@ -43,7 +43,7 @@ import jax
 import numpy as np
 
 from dasmtl.config import Config, mixed_label
-from dasmtl.data.pipeline import BatchIterator, eval_batches
+from dasmtl.data.pipeline import BatchIterator, eval_batches, prefetch
 from dasmtl.models.registry import ModelSpec
 from dasmtl.parallel.mesh import MeshPlan, shard_batch
 from dasmtl.train import metrics as host_metrics
@@ -96,7 +96,8 @@ class Trainer:
         self.val_source = val_source
         self.run_dir = run_dir
         self.mesh_plan = mesh_plan
-        self.train_step = make_train_step(spec)
+        self.train_step = make_train_step(spec, mesh_plan=mesh_plan,
+                                          bn_sync=cfg.bn_sync)
         self.eval_step = make_eval_step(spec)
         self.metrics_dir = os.path.join(run_dir, "metrics")
         self.lines = MetricLines(self.metrics_dir)
@@ -117,9 +118,13 @@ class Trainer:
 
     # -- helpers -------------------------------------------------------------
     def _place(self, batch):
+        """Host batch -> device arrays (sharded under a mesh).  Called from
+        the prefetch worker thread, so the H2D copy of batch ``i+1`` overlaps
+        step ``i``'s compute (the reference's per-step ``.cuda()`` copy sits
+        on the critical path, utils.py:350-353)."""
         if self.mesh_plan is not None:
             return shard_batch(self.mesh_plan, batch)
-        return batch
+        return jax.device_put(batch)
 
     def _log_jsonl(self, record: Dict[str, Any]) -> None:
         with open(self.jsonl_path, "a") as f:
@@ -137,7 +142,9 @@ class Trainer:
         labels: Dict[str, List[np.ndarray]] = {"distance": [], "event": []}
         loss_sum, count = 0.0, 0.0
         part_sums: Dict[str, float] = {}
-        for batch in eval_batches(self.val_source, self.eval_batch_size):
+        for batch in prefetch(eval_batches(self.val_source,
+                                           self.eval_batch_size),
+                              depth=self.cfg.prefetch_batches):
             for k in labels:
                 labels[k].append(batch[k])
             out = self.eval_step(self.state, self._place(batch))
@@ -190,9 +197,12 @@ class Trainer:
         window: Dict[str, float] = {}
         t0 = time.perf_counter()
         lr_arr = np.float32(lr)
-        for i, batch in enumerate(self.train_iter.epoch(epoch)):
+        batches = prefetch(self.train_iter.epoch(epoch),
+                           depth=self.cfg.prefetch_batches,
+                           place_fn=self._place)
+        for i, batch in enumerate(batches):
             self.state, step_metrics = self.train_step(
-                self.state, self._place(batch), lr_arr)
+                self.state, batch, lr_arr)
             # Accumulate device scalars without forcing a sync each step.
             for k, v in step_metrics.items():
                 window[k] = window.get(k, 0.0) + v

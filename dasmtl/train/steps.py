@@ -22,10 +22,11 @@ a recompile.
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from dasmtl.config import mixed_label
 from dasmtl.models.registry import ModelSpec
@@ -45,12 +46,33 @@ def _batch_labels(batch: Batch) -> Dict[str, jax.Array]:
     return labels
 
 
-def make_train_step(spec: ModelSpec):
+def make_train_step(spec: ModelSpec, mesh_plan=None,
+                    bn_sync: str = "global"):
     """Returns ``train_step(state, batch, lr) -> (state, metrics)``.
 
     Metrics are *sums* (weighted correct counts, weighted loss sums, example
     counts) so the host can window/normalize them exactly (the reference's
-    running 100-batch windows, utils.py:376-398)."""
+    running 100-batch windows, utils.py:376-398).
+
+    ``bn_sync`` picks the BatchNorm semantics under data parallelism
+    (SURVEY.md §7 step 5):
+
+    - ``"global"`` (default): the plain jitted step under GSPMD — BatchNorm
+      reduces over the full sharded batch axis, so XLA inserts cross-device
+      reductions (sync-BN).  Matches the single-device trajectory only when
+      the *global* batch equals the reference's.
+    - ``"per_replica"``: a ``shard_map`` step where every device normalizes
+      with its own batch-shard statistics — the reference's semantics
+      (``model.train()`` per-GPU batch stats, utils.py:249-250) when the
+      per-device batch is the reference's 32.  Gradients are the exact global
+      weighted mean (psum of weighted-sum grads / psum of counts); running
+      stats are the replica mean.  Requires a mesh with ``sp == 1``.
+    """
+    if bn_sync not in ("global", "per_replica"):
+        raise ValueError(f"unknown bn_sync {bn_sync!r}")
+    if (bn_sync == "per_replica" and mesh_plan is not None
+            and mesh_plan.n_devices > 1):
+        return _make_per_replica_train_step(spec, mesh_plan)
 
     def train_step(state: TrainState, batch: Batch,
                    lr: jax.Array) -> Tuple[TrainState, Dict[str, jax.Array]]:
@@ -85,6 +107,66 @@ def make_train_step(spec: ModelSpec):
         return new_state, metrics
 
     return jax.jit(train_step, donate_argnums=(0,))
+
+
+def _make_per_replica_train_step(spec: ModelSpec, mesh_plan):
+    """The ``bn_sync="per_replica"`` step: shard_map over the ``dp`` axis so
+    BatchNorm sees only the device-local batch shard, with explicit psum
+    collectives for gradients/metrics and pmean for running stats."""
+    if mesh_plan.sp != 1:
+        raise ValueError(
+            "bn_sync=per_replica requires sp=1 — spatially sharded feature "
+            "maps have no 'replica' whose batch statistics are complete")
+
+    batch_specs = {"x": P("dp"), "distance": P("dp"), "event": P("dp"),
+                   "weight": P("dp")}
+
+    def local_step(state: TrainState, batch: Batch,
+                   lr: jax.Array) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        step_rng = jax.random.fold_in(state.rng, state.step)
+        # Distinct dropout streams per replica (torch DataParallel-style).
+        step_rng = jax.random.fold_in(step_rng, jax.lax.axis_index("dp"))
+
+        def loss_fn(params):
+            variables = {"params": params, "batch_stats": state.batch_stats}
+            rngs = {"dropout": step_rng} if spec.uses_dropout else None
+            outputs, mutated = state.apply_fn(
+                variables, batch["x"], train=True, mutable=["batch_stats"],
+                rngs=rngs)
+            loss, parts = spec.loss_fn(outputs, batch)  # local weighted mean
+            n_local = batch["weight"].sum()
+            # Optimize the weighted SUM so psum'd grads divide exactly by the
+            # global count — identical objective to the global-BN step.
+            return loss * n_local, (parts, mutated["batch_stats"], outputs,
+                                    n_local)
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        ((loss_sum, (parts, local_stats, outputs, n_local)),
+         grads) = grad_fn(state.params)
+        n_global = jnp.maximum(jax.lax.psum(n_local, "dp"), 1.0)
+        grads = jax.tree.map(
+            lambda g: jax.lax.psum(g, "dp") / n_global, grads)
+        new_stats = jax.tree.map(lambda s: jax.lax.pmean(s, "dp"),
+                                 local_stats)
+        new_state = state.apply_updates(grads, lr).replace(
+            batch_stats=new_stats)
+
+        preds = spec.decode(outputs)
+        labels = _batch_labels(batch)
+        weight = batch["weight"]
+        metrics = {"loss_sum": loss_sum, "count": n_local}
+        for task in preds:
+            metrics[f"correct_{task}"] = _weighted_correct(
+                preds[task], labels[task], weight)
+        for k, v in parts.items():
+            metrics[f"loss_sum_{k}"] = v * n_local
+        metrics = {k: jax.lax.psum(v, "dp") for k, v in metrics.items()}
+        return new_state, metrics
+
+    mapped = jax.shard_map(local_step, mesh=mesh_plan.mesh,
+                           in_specs=(P(), batch_specs, P()),
+                           out_specs=(P(), P()), check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0,))
 
 
 def make_eval_step(spec: ModelSpec):

@@ -1,0 +1,49 @@
+"""JAX platform pinning for this container (jax-import-free).
+
+The container pre-sets ``JAX_PLATFORMS=axon`` (TPU-tunnel PJRT plugin,
+registered via sitecustomize) whose init can block for minutes on an
+exclusive TPU claim.  Anything that must run on CPU deterministically —
+tests, the multichip dry run, the bench CPU fallback — needs BOTH
+``JAX_PLATFORMS=cpu`` and an empty ``PALLAS_AXON_POOL_IPS`` (which skips
+plugin registration entirely) in place *before the first jax import*.
+
+This module is the single home of that knowledge (round 1 kept three copies,
+and the two driver-facing scripts missing it caused both driver failures —
+BENCH_r01.json / MULTICHIP_r01.json).  It imports nothing heavy, so parent
+processes can use it without touching jax.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def cpu_pinned_env(n_devices: Optional[int] = None,
+                   base: Optional[dict] = None) -> dict:
+    """A copy of ``base`` (default ``os.environ``) pinned to the pure-CPU
+    JAX platform; with ``n_devices``, forces that many virtual CPU devices
+    (the standard fake-multi-device mechanism for mesh tests)."""
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    if n_devices is not None:
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def pin_cpu_in_process(n_devices: Optional[int] = None) -> bool:
+    """Apply the pinning to ``os.environ``; returns False (no-op) when jax is
+    already imported, because the platform choice is latched at first import."""
+    import sys
+
+    if "jax" in sys.modules:
+        return False
+    env = cpu_pinned_env(n_devices)
+    for key in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS", "XLA_FLAGS"):
+        if key in env:
+            os.environ[key] = env[key]
+    return True

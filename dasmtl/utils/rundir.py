@@ -17,7 +17,15 @@ def make_run_dir(savedir: str, model_type: str, is_test: bool) -> str:
     # year-less names sort wrongly across New Year, which would break any
     # name-ordered tooling over the savedir.
     ts = datetime.datetime.now().strftime("%Y-%m-%d-%H_%M_%S")
-    name = f"{ts} model_type={model_type} is_test={is_test}"
-    path = os.path.join(savedir, name)
-    os.makedirs(path, exist_ok=True)
-    return path
+    base = f"{ts} model_type={model_type} is_test={is_test}"
+    # exist_ok=False + suffix bump: two runs launched within the same second
+    # (parallel sweeps) must never share a dir and interleave logs/checkpoints.
+    for attempt in range(1000):
+        name = base if attempt == 0 else f"{base} ({attempt})"
+        path = os.path.join(savedir, name)
+        try:
+            os.makedirs(path, exist_ok=False)
+            return path
+        except FileExistsError:
+            continue
+    raise RuntimeError(f"could not create a unique run dir under {savedir}")

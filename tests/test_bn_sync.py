@@ -1,0 +1,132 @@
+"""BatchNorm semantics under data parallelism (--bn_sync, SURVEY.md §7 step 5).
+
+``per_replica`` reproduces the reference's per-GPU batch statistics
+(model.train() batch stats, reference utils.py:249-250) on a dp mesh; these
+tests pin its numerics against single-device execution.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from dasmtl.config import Config
+from dasmtl.main import build_state
+from dasmtl.models.registry import get_model_spec
+from dasmtl.parallel.mesh import (create_mesh, replicated_sharding,
+                                  shard_batch)
+from dasmtl.train.steps import make_train_step
+
+HW = (52, 64)
+
+
+def _batch(batch_size, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "x": rng.normal(size=(batch_size,) + HW + (1,)).astype(np.float32),
+        "distance": rng.integers(0, 16, size=(batch_size,)).astype(np.int32),
+        "event": rng.integers(0, 2, size=(batch_size,)).astype(np.int32),
+        "weight": np.ones((batch_size,), np.float32),
+    }
+
+
+def _leaves(tree):
+    return jax.tree.leaves(jax.device_get(tree))
+
+
+def test_per_replica_matches_single_device_on_duplicated_shards():
+    """With every dp shard holding the SAME local batch, the per-replica step
+    must reproduce the single-device step exactly: identical local BN stats,
+    psum'd grads / psum'd counts == single-device grads."""
+    cfg = Config(model="MTL", batch_size=4)
+    spec = get_model_spec(cfg.model)
+    local = _batch(4)
+    dup = {k: np.concatenate([v, v]) for k, v in local.items()}
+
+    state1 = build_state(cfg, spec, input_hw=HW)
+    new1, m1 = make_train_step(spec)(state1, jax.device_put(local),
+                                     np.float32(1e-3))
+
+    plan = create_mesh(dp=2, sp=1, devices=jax.devices()[:2])
+    state2 = jax.device_put(build_state(cfg, spec, input_hw=HW),
+                            replicated_sharding(plan))
+    step = make_train_step(spec, mesh_plan=plan, bn_sync="per_replica")
+    new2, m2 = step(state2, shard_batch(plan, dup), np.float32(1e-3))
+
+    for a, b in zip(_leaves(new1.params), _leaves(new2.params)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+    for a, b in zip(_leaves(new1.batch_stats), _leaves(new2.batch_stats)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(float(m1["loss_sum"]) / float(m1["count"]),
+                               float(m2["loss_sum"]) / float(m2["count"]),
+                               rtol=1e-6)
+    assert float(m2["count"]) == 8.0
+
+
+def test_per_replica_stats_are_replica_mean():
+    """With two DIFFERENT shards, new running stats must equal the mean of
+    the two single-device runs' stats (pmean over replicas)."""
+    cfg = Config(model="MTL", batch_size=4)
+    spec = get_model_spec(cfg.model)
+    shard_a, shard_b = _batch(4, seed=1), _batch(4, seed=2)
+    both = {k: np.concatenate([shard_a[k], shard_b[k]]) for k in shard_a}
+
+    single = make_train_step(spec)
+    sa, _ = single(build_state(cfg, spec, input_hw=HW),
+                   jax.device_put(shard_a), np.float32(1e-3))
+    sb, _ = single(build_state(cfg, spec, input_hw=HW),
+                   jax.device_put(shard_b), np.float32(1e-3))
+
+    plan = create_mesh(dp=2, sp=1, devices=jax.devices()[:2])
+    state = jax.device_put(build_state(cfg, spec, input_hw=HW),
+                           replicated_sharding(plan))
+    step = make_train_step(spec, mesh_plan=plan, bn_sync="per_replica")
+    new, _ = step(state, shard_batch(plan, both), np.float32(1e-3))
+
+    for a, b, m in zip(_leaves(sa.batch_stats), _leaves(sb.batch_stats),
+                       _leaves(new.batch_stats)):
+        np.testing.assert_allclose((a + b) / 2, m, rtol=1e-5, atol=1e-6)
+
+
+def test_per_replica_differs_from_global_bn():
+    """Heterogeneous shards: sync-BN (global statistics) and per-replica BN
+    must produce different updates — otherwise the flag is wired to nothing."""
+    cfg = Config(model="MTL", batch_size=4)
+    spec = get_model_spec(cfg.model)
+    rng = np.random.default_rng(5)
+    shard_a = _batch(4, seed=3)
+    shard_b = _batch(4, seed=4)
+    shard_b["x"] = (shard_b["x"] * 3.0 + 1.0).astype(np.float32)  # skew stats
+    both = {k: np.concatenate([shard_a[k], shard_b[k]]) for k in shard_a}
+
+    plan = create_mesh(dp=2, sp=1, devices=jax.devices()[:2])
+
+    results = {}
+    for mode in ("global", "per_replica"):
+        state = jax.device_put(build_state(cfg, spec, input_hw=HW),
+                               replicated_sharding(plan))
+        step = make_train_step(spec, mesh_plan=plan, bn_sync=mode)
+        with plan.mesh:
+            new, metrics = step(state, shard_batch(plan, both),
+                                np.float32(1e-3))
+        loss = float(metrics["loss_sum"]) / float(metrics["count"])
+        assert np.isfinite(loss)
+        results[mode] = _leaves(new.batch_stats)
+
+    max_diff = max(float(np.max(np.abs(a - b))) for a, b in
+                   zip(results["global"], results["per_replica"]))
+    assert max_diff > 1e-4, "per_replica BN produced sync-BN statistics"
+
+
+def test_per_replica_requires_sp1():
+    plan = create_mesh(dp=2, sp=2, devices=jax.devices()[:4])
+    spec = get_model_spec("MTL")
+    with pytest.raises(ValueError, match="per_replica requires sp=1"):
+        make_train_step(spec, mesh_plan=plan, bn_sync="per_replica")
+
+
+def test_unknown_bn_sync_rejected():
+    spec = get_model_spec("MTL")
+    with pytest.raises(ValueError, match="unknown bn_sync"):
+        make_train_step(spec, bn_sync="sometimes")
+    with pytest.raises(ValueError, match="unknown bn_sync"):
+        Config(bn_sync="sometimes")

@@ -1,0 +1,55 @@
+"""Convergence to the reference's checkpoint gate (slow; run with -m slow).
+
+The reference only ever writes a checkpoint when validation distance accuracy
+crosses 0.98 (utils.py:329) — the threshold implies real runs reach it.  This
+test drives the full Trainer on the synthetic tree until the gate is crossed
+and asserts the gated-best checkpoint actually lands, exercising the
+validate -> gate -> ckpts/best path for real (VERDICT round 1, item 6).
+
+A recorded run lives at ``artifacts/convergence_r02.log``.
+"""
+
+import glob
+import os
+
+import pytest
+
+from dasmtl.config import Config
+from dasmtl.main import main_process
+
+
+@pytest.mark.slow
+def test_mtl_reaches_distance_gate_and_writes_best(tmp_path):
+    from dasmtl.data.synthetic import make_synthetic_dataset
+
+    data_root = str(tmp_path / "data")
+    striking, excavating = make_synthetic_dataset(
+        data_root, files_per_category=8, num_categories=16, shape=(100, 250),
+        seed=7)
+
+    savedir = str(tmp_path / "runs")
+    cfg = Config(
+        model="MTL", batch_size=32, epoch_num=30, val_every=2,
+        trainval_set_striking=striking, trainval_set_excavating=excavating,
+        output_savedir=savedir, seed=0,
+        # Gate at the reference's 0.98 (Config resolves MTL -> 0.98).
+    )
+    result = main_process(cfg, is_test=False)
+
+    best_dirs = glob.glob(os.path.join(savedir, "*", "ckpts", "best"))
+    acc_curve = []
+    for run_metrics in glob.glob(os.path.join(savedir, "*", "metrics",
+                                              "metrics.jsonl")):
+        import json
+
+        with open(run_metrics) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("kind") == "val":
+                    acc_curve.append(rec.get("acc_distance"))
+    peak = max(acc_curve) if acc_curve else 0.0
+    assert peak >= cfg.acc_gate, (
+        f"never crossed the {cfg.acc_gate} distance gate; peak={peak:.4f}, "
+        f"curve={acc_curve}")
+    assert best_dirs, "gate crossed but no ckpts/best written"
+    assert result.reports["distance"]["accuracy"] > 0.9

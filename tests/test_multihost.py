@@ -1,0 +1,76 @@
+"""Multi-host bring-up smoke test: 2 real processes, 1 CPU device each.
+
+Exercises ``dasmtl.parallel.mesh.initialize_distributed`` (the
+``jax.distributed.initialize`` hook, mesh.py) end-to-end: both processes join
+one coordinator, see the global device set, and complete a cross-process
+collective.  This is the first rung of the multi-host ladder the reference
+never had (no process group anywhere, SURVEY.md §2.4).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+from dasmtl.utils.platform import cpu_pinned_env
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = """
+import sys
+import numpy as np
+from dasmtl.parallel.mesh import initialize_distributed
+
+addr, pid = sys.argv[1], int(sys.argv[2])
+initialize_distributed(coordinator_address=addr, num_processes=2,
+                       process_id=pid)
+import jax
+import jax.numpy as jnp
+assert jax.process_count() == 2, f"process_count={jax.process_count()}"
+assert jax.device_count() == 2, f"device_count={jax.device_count()}"
+assert jax.local_device_count() == 1
+
+from jax.experimental import multihost_utils
+got = multihost_utils.process_allgather(
+    jnp.ones((1,), jnp.float32) * (pid + 1))
+np.testing.assert_allclose(np.asarray(got).ravel(), [1.0, 2.0])
+print(f"multihost ok {pid}")
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_distributed_smoke():
+    env = cpu_pinned_env(n_devices=1)  # one local CPU device per process
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    addr = f"localhost:{_free_port()}"
+    procs = [
+        subprocess.Popen([sys.executable, "-c", _CHILD, addr, str(i)],
+                         cwd=_REPO, env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out}"
+        assert f"multihost ok {i}" in out
+
+
+def test_initialize_distributed_noop_without_coordinator():
+    from dasmtl.parallel.mesh import initialize_distributed
+
+    initialize_distributed(None)  # must be a harmless no-op single-process

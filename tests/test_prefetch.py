@@ -1,0 +1,92 @@
+"""Prefetch overlap thread (dasmtl.data.pipeline.prefetch).
+
+Replaces the reference's fully synchronous loader path (num_workers=0,
+utils.py:152-156) with a background double-buffer; these tests pin ordering,
+placement, error propagation, and shutdown behavior.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dasmtl.data.pipeline import BatchIterator, prefetch
+from dasmtl.data.sources import ArraySource
+
+
+def _source(n=10):
+    rng = np.random.default_rng(0)
+    return ArraySource(rng.normal(size=(n, 8, 9, 1)),
+                       rng.integers(0, 16, n), rng.integers(0, 2, n))
+
+
+@pytest.mark.parametrize("depth", [0, 1, 2, 4])
+def test_prefetch_preserves_order_and_content(depth):
+    it = BatchIterator(_source(), batch_size=4, seed=3)
+    plain = list(it.epoch(0))
+    fetched = list(prefetch(it.epoch(0), depth=depth))
+    assert len(plain) == len(fetched) == 3
+    for a, b in zip(plain, fetched):
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_prefetch_applies_place_fn_in_worker():
+    worker_names = []
+
+    def place(batch):
+        worker_names.append(threading.current_thread().name)
+        return {k: v + 0 for k, v in batch.items()}
+
+    out = list(prefetch(iter([{"x": np.ones(3)}] * 4), depth=2,
+                        place_fn=place))
+    assert len(out) == 4
+    assert all(name == "dasmtl-prefetch" for name in worker_names)
+
+
+def test_prefetch_propagates_worker_exception():
+    def gen():
+        yield 1
+        raise RuntimeError("boom in loader")
+
+    it = prefetch(gen(), depth=2)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="boom in loader"):
+        list(it)
+
+
+def test_prefetch_abandoned_consumer_does_not_hang():
+    produced = []
+
+    def gen():
+        for i in range(1000):
+            produced.append(i)
+            yield i
+
+    it = prefetch(gen(), depth=2)
+    assert next(it) == 0
+    it.close()  # abandon mid-stream
+    time.sleep(0.3)  # give the worker time to notice the stop flag
+    n_before = len(produced)
+    time.sleep(0.3)
+    assert len(produced) == n_before, "worker kept producing after close()"
+    assert len(produced) < 1000
+
+
+def test_prefetch_runs_ahead_of_consumer():
+    started = threading.Event()
+
+    def gen():
+        for i in range(5):
+            yield i
+            if i == 2:
+                started.set()
+
+    it = prefetch(gen(), depth=3)
+    first = next(it)
+    assert first == 0
+    # With depth 3 the worker should have produced past item 2 without any
+    # further consumption.
+    assert started.wait(timeout=2.0)
+    assert list(it) == [1, 2, 3, 4]

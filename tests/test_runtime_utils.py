@@ -1,0 +1,54 @@
+"""Run-dir uniqueness and best-metric carryover (round-1 ADVICE items)."""
+
+import os
+
+import numpy as np
+
+from dasmtl.config import Config
+from dasmtl.main import build_state
+from dasmtl.models.registry import get_model_spec
+from dasmtl.train.checkpoint import (CheckpointManager, best_metric_in_savedir)
+from dasmtl.utils.rundir import make_run_dir
+
+
+def test_run_dirs_unique_within_same_second(tmp_path):
+    paths = {make_run_dir(str(tmp_path), "MTL", False) for _ in range(5)}
+    assert len(paths) == 5
+    for p in paths:
+        assert os.path.isdir(p)
+
+
+def test_best_metric_carryover_across_run_dirs(tmp_path):
+    """--resume into a fresh run dir must inherit the old run's gated-best
+    floor, so a worse validation is never re-crowned 'best'."""
+    cfg = Config(model="single_event", batch_size=2)
+    spec = get_model_spec(cfg.model)
+    state = build_state(cfg, spec, input_hw=(52, 64))
+
+    old_run = str(tmp_path / "runs" / "2026-01-01-00_00_00 model_type=single_event is_test=False")
+    os.makedirs(old_run)
+    mgr_old = CheckpointManager(old_run)
+    assert mgr_old.save_best(state, 0.991) is not None
+
+    savedir = str(tmp_path / "runs")
+    assert best_metric_in_savedir(savedir, model="single_event") == 0.991
+    assert best_metric_in_savedir(savedir, model="MTL") is None
+
+    new_run = str(tmp_path / "runs" / "2026-01-02-00_00_00 model_type=single_event is_test=False")
+    os.makedirs(new_run)
+    mgr_new = CheckpointManager(new_run)
+    mgr_new.seed_best(best_metric_in_savedir(savedir, model="single_event"))
+    # Worse than the inherited floor: rejected.
+    assert mgr_new.save_best(state, 0.985) is None
+    # Better: saved, and the floor advances.
+    assert mgr_new.save_best(state, 0.995) is not None
+    assert mgr_new.save_best(state, 0.992) is None
+
+
+def test_seed_best_none_is_noop(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "run"))
+    mgr.seed_best(None)
+    cfg = Config(model="single_event", batch_size=2)
+    spec = get_model_spec(cfg.model)
+    state = build_state(cfg, spec, input_hw=(52, 64))
+    assert mgr.save_best(state, 0.5) is not None
