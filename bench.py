@@ -34,7 +34,10 @@ _MARK = "BENCH_RESULT "
 # fallback always has at least _CPU_MIN_TIMEOUT left inside the budget — the
 # harness must emit its JSON line even when every TPU attempt stalls.
 _BUDGET_S = float(os.environ.get("DASMTL_BENCH_BUDGET_S", "540"))
-_TPU_ATTEMPTS = ((180, 0), (75, 10))  # (timeout_s, backoff_before_s)
+# Measured this session: a successful TPU child run takes ~180s end-to-end
+# (init ~30s + compile ~35s + model/state build + measure), so the first
+# attempt gets 300s headroom within the 540s budget.
+_TPU_ATTEMPTS = ((300, 0), (60, 10))  # (timeout_s, backoff_before_s)
 _CPU_MIN_TIMEOUT = 180
 
 # Peak dense bf16 FLOP/s by TPU generation (public spec sheets) for MFU.
@@ -111,7 +114,10 @@ def _measure_config(batch_size: int, dtype: str, use_pallas: bool,
         result["step_flops"] = step_flops
         kind = device_kind.lower()
         peak = next((v for k, v in _PEAK_BF16.items() if k in kind), None)
-        if on_accel and peak:
+        # MFU only against the published bf16 peak for bf16 configs — TPU
+        # float32 matmul peak isn't published per-generation, so a f32 MFU
+        # against the bf16 peak would be systematically understated.
+        if on_accel and peak and dtype == "bfloat16":
             result["mfu"] = round(step_flops * measure / elapsed / peak, 4)
     return result
 
@@ -157,9 +163,10 @@ def _child_sweep() -> None:
     print(_MARK + json.dumps(rows))
 
 
-def _run_child(env: dict, timeout: float):
-    """One measurement attempt; returns (result dict | None, diagnostics)."""
-    cmd = [sys.executable, os.path.abspath(__file__), "--child"]
+def _run_child(env: dict, timeout: float, flag: str = "--child"):
+    """One measurement attempt in a subprocess (``flag`` selects the child
+    mode); returns (parsed BENCH_RESULT | None, diagnostics)."""
+    cmd = [sys.executable, os.path.abspath(__file__), flag]
     try:
         proc = subprocess.run(cmd, cwd=_REPO, env=env, capture_output=True,
                               text=True, timeout=timeout)
@@ -226,21 +233,13 @@ def sweep() -> int:
     """Run the perf-lever sweep in a child on the best available platform."""
     from dasmtl.utils.platform import cpu_pinned_env
 
-    for env, timeout in ((dict(os.environ), 900), (cpu_pinned_env(), 1800)):
-        cmd = [sys.executable, os.path.abspath(__file__), "--child-sweep"]
-        try:
-            proc = subprocess.run(cmd, cwd=_REPO, env=env,
-                                  capture_output=True, text=True,
-                                  timeout=timeout)
-        except subprocess.TimeoutExpired:
-            print("sweep: attempt timed out", file=sys.stderr)
-            continue
-        print(proc.stderr, end="", file=sys.stderr)
-        for line in proc.stdout.splitlines():
-            if line.startswith(_MARK):
-                print(line[len(_MARK):])
-                return 0
-        print(f"sweep: attempt failed rc={proc.returncode}", file=sys.stderr)
+    for env, timeout in ((dict(os.environ), 1500), (cpu_pinned_env(), 1800)):
+        rows, diag = _run_child(env, timeout, flag="--child-sweep")
+        print(diag, end="", file=sys.stderr)
+        if rows is not None:
+            print(json.dumps(rows))
+            return 0
+        print("sweep: attempt failed", file=sys.stderr)
     return 1
 
 

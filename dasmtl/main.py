@@ -29,7 +29,7 @@ from dasmtl.data.sources import DiskSource, RamSource, _SourceBase
 from dasmtl.data.splits import build_splits, export_manifest_csv
 from dasmtl.models.registry import ModelSpec, get_model_spec
 from dasmtl.parallel.mesh import (MeshPlan, create_mesh, replicated_sharding)
-from dasmtl.train.checkpoint import (best_metric_in_savedir,
+from dasmtl.train.checkpoint import (best_metric_on_disk,
                                      restore_latest_in, restore_weights)
 from dasmtl.train.loop import Trainer, ValidationResult
 from dasmtl.train.optim import coupled_adam
@@ -153,14 +153,15 @@ def main_process(cfg: Config, is_test: bool = False,
             resumed = restore_latest_in(trainer.state, cfg.output_savedir,
                                         model=cfg.model)
             if resumed is not None:
-                trainer.state = replicate_state(resumed, plan)
-                # Inherit the gated-best floor from previous runs so a worse
-                # validation in this fresh run dir is never re-crowned 'best'.
-                trainer.ckpt.seed_best(best_metric_in_savedir(
-                    cfg.output_savedir, model=cfg.model))
+                resumed_state, resumed_run = resumed
+                trainer.state = replicate_state(resumed_state, plan)
+                # Inherit the gated-best floor from the run being continued —
+                # and only that run, so an unrelated experiment's higher best
+                # in the same savedir can't suppress this run's checkpoints.
+                trainer.ckpt.seed_best(best_metric_on_disk(resumed_run))
                 print(f"resumed at epoch "
                       f"{int(jax.device_get(trainer.state.epoch))} from "
-                      f"{cfg.output_savedir}")
+                      f"{resumed_run}")
             else:
                 print(f"--resume: no checkpoint under {cfg.output_savedir}; "
                       "starting fresh")

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import os
 import re
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
@@ -170,16 +170,22 @@ def find_latest_checkpoint(savedir: str,
 
 
 def restore_latest_in(state: TrainState, savedir: str,
-                      model: Optional[str] = None) -> Optional[TrainState]:
-    """Full-state resume from the newest checkpoint under ``savedir``;
-    ``None`` when there is nothing to resume from."""
+                      model: Optional[str] = None,
+                      ) -> Optional[Tuple[TrainState, str]]:
+    """Full-state resume from the newest checkpoint under ``savedir``.
+
+    Returns ``(restored_state, run_dir_resumed_from)`` so the caller can also
+    inherit per-run artifacts (e.g. the gated-best floor) from exactly the run
+    being continued — not from unrelated experiments that happen to share the
+    savedir.  ``None`` when there is nothing to resume from."""
     path = find_latest_checkpoint(savedir, model=model)
     if path is None:
         return None
     ckptr = ocp.StandardCheckpointer()
     template = jax.device_get(state_payload(state))
     payload = ckptr.restore(os.path.abspath(path), template)
-    return _with_payload(state, payload)
+    run_dir = os.path.dirname(os.path.dirname(path))  # <run>/ckpts/step_<n>
+    return _with_payload(state, payload), run_dir
 
 
 def best_metric_on_disk(run_dir: str) -> Optional[float]:
@@ -189,18 +195,3 @@ def best_metric_on_disk(run_dir: str) -> Optional[float]:
     return float(np.loadtxt(path))
 
 
-def best_metric_in_savedir(savedir: str,
-                           model: Optional[str] = None) -> Optional[float]:
-    """Max gated-best metric across every run dir under ``savedir`` (filtered
-    by model family like :func:`find_latest_checkpoint`) — the floor a
-    ``--resume`` into a fresh run dir must inherit."""
-    if not os.path.isdir(savedir):
-        return None
-    best: Optional[float] = None
-    for run_name in os.listdir(savedir):
-        if model is not None and f"model_type={model} " not in run_name + " ":
-            continue
-        metric = best_metric_on_disk(os.path.join(savedir, run_name))
-        if metric is not None and (best is None or metric > best):
-            best = metric
-    return best

@@ -1,0 +1,75 @@
+"""Measure the reference implementation's training throughput on this host.
+
+Imports the reference's own model code from ``/root/reference`` (read-only;
+nothing is copied) and times its exact inner loop — forward, summed NLL,
+``zero_grad/backward/step`` (reference utils.py:346-374) with Adam(lr=1e-3,
+weight_decay=1e-5) (utils.py:133-134) — on the torch CPU backend, the only
+torch device in this container.
+
+This pins the "reference on identical hardware" row of BASELINE.md: the same
+host CPU runs the reference's eager PyTorch loop and our jitted XLA loop
+(bench.py CPU fallback), making the TPU number's vs-reference ratio concrete.
+
+Run:  python scripts/bench_reference_torch.py [--batch 32] [--steps 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+REFERENCE = "/root/reference"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--warmup", type=int, default=2)
+    args = ap.parse_args()
+
+    sys.path.insert(0, REFERENCE)
+    import torch
+    from model.modelA_MTL import MTL_Net  # the reference's own module
+
+    torch.manual_seed(0)
+    model = MTL_Net()
+    model.train()
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3, weight_decay=1e-5)
+    criterion = torch.nn.NLLLoss()
+
+    x = torch.randn(args.batch, 1, 100, 250)
+    dist = torch.randint(0, 16, (args.batch,))
+    event = torch.randint(0, 2, (args.batch,))
+
+    def step():
+        out1, out2 = model(x)
+        loss = criterion(out1, dist) + criterion(out2, event)
+        opt.zero_grad()
+        loss.backward()
+        opt.step()
+        return float(loss)
+
+    for _ in range(args.warmup):
+        step()
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        step()
+    elapsed = time.perf_counter() - t0
+
+    print(json.dumps({
+        "metric": "reference_mtl_train_samples_per_s",
+        "value": round(args.batch * args.steps / elapsed, 2),
+        "unit": "samples/s",
+        "backend": "torch-cpu",
+        "batch_size": args.batch,
+        "step_time_ms": round(elapsed / args.steps * 1e3, 1),
+        "torch_threads": torch.get_num_threads(),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

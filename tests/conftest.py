@@ -16,12 +16,23 @@ mesh either way).
 """
 
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon sitecustomize imports jax at interpreter startup, and jax.config
+# snapshots JAX_PLATFORMS at import time — so when jax is already loaded the
+# env var above is a no-op and the suite would silently run on the 1-chip TPU
+# tunnel.  Re-pin through the live config (backends initialize lazily, so this
+# is still early enough; XLA_FLAGS is read from os.environ at init and works).
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

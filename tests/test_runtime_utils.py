@@ -7,7 +7,8 @@ import numpy as np
 from dasmtl.config import Config
 from dasmtl.main import build_state
 from dasmtl.models.registry import get_model_spec
-from dasmtl.train.checkpoint import (CheckpointManager, best_metric_in_savedir)
+from dasmtl.train.checkpoint import (CheckpointManager, best_metric_on_disk,
+                                     find_latest_checkpoint)
 from dasmtl.utils.rundir import make_run_dir
 
 
@@ -18,9 +19,10 @@ def test_run_dirs_unique_within_same_second(tmp_path):
         assert os.path.isdir(p)
 
 
-def test_best_metric_carryover_across_run_dirs(tmp_path):
-    """--resume into a fresh run dir must inherit the old run's gated-best
-    floor, so a worse validation is never re-crowned 'best'."""
+def test_best_metric_carryover_from_resumed_run(tmp_path):
+    """--resume into a fresh run dir must inherit the gated-best floor of the
+    run being continued (and only that run — an unrelated experiment's higher
+    best in the same savedir must not suppress this run's checkpoints)."""
     cfg = Config(model="single_event", batch_size=2)
     spec = get_model_spec(cfg.model)
     state = build_state(cfg, spec, input_hw=(52, 64))
@@ -29,18 +31,28 @@ def test_best_metric_carryover_across_run_dirs(tmp_path):
     os.makedirs(old_run)
     mgr_old = CheckpointManager(old_run)
     assert mgr_old.save_best(state, 0.991) is not None
+    mgr_old.save(state)  # the step checkpoint --resume will find
+
+    # An unrelated run of the same model with a higher best but no newer
+    # checkpoint: must NOT become the inherited floor.
+    other_run = str(tmp_path / "runs" / "2025-12-01-00_00_00 model_type=single_event is_test=False")
+    os.makedirs(other_run)
+    CheckpointManager(other_run).save_best(state, 0.999)
 
     savedir = str(tmp_path / "runs")
-    assert best_metric_in_savedir(savedir, model="single_event") == 0.991
-    assert best_metric_in_savedir(savedir, model="MTL") is None
+    latest = find_latest_checkpoint(savedir, model="single_event")
+    resumed_run = os.path.dirname(os.path.dirname(latest))
+    assert resumed_run == old_run
+    assert best_metric_on_disk(resumed_run) == 0.991
 
     new_run = str(tmp_path / "runs" / "2026-01-02-00_00_00 model_type=single_event is_test=False")
     os.makedirs(new_run)
     mgr_new = CheckpointManager(new_run)
-    mgr_new.seed_best(best_metric_in_savedir(savedir, model="single_event"))
+    mgr_new.seed_best(best_metric_on_disk(resumed_run))
     # Worse than the inherited floor: rejected.
     assert mgr_new.save_best(state, 0.985) is None
-    # Better: saved, and the floor advances.
+    # Better than the resumed run's floor (even though below the unrelated
+    # run's 0.999): saved, and the floor advances.
     assert mgr_new.save_best(state, 0.995) is not None
     assert mgr_new.save_best(state, 0.992) is None
 
