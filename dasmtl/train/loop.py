@@ -36,6 +36,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import signal
 import time
 from typing import Any, Dict, Iterable, List, Optional
 
@@ -115,6 +116,16 @@ class Trainer:
         # keeps every device fed (cfg.batch_size is per-device).
         self.eval_batch_size = cfg.batch_size * (
             mesh_plan.dp if mesh_plan else 1)
+        self._preempted = False
+
+    def request_preempt(self) -> None:
+        """Ask the running ``fit`` to stop at the next safe point and write a
+        full-state checkpoint.  Called by the SIGTERM handler ``fit``
+        installs — TPU pods deliver SIGTERM on maintenance/preemption — or
+        directly by embedding code.  (The reference loses the entire run on
+        any interruption: weights-only, gate-conditional saves,
+        utils.py:329-337.)"""
+        self._preempted = True
 
     # -- helpers -------------------------------------------------------------
     def _place(self, batch):
@@ -200,7 +211,9 @@ class Trainer:
         batches = prefetch(self.train_iter.epoch(epoch),
                            depth=self.cfg.prefetch_batches,
                            place_fn=self._place)
+        last_step = -1
         for i, batch in enumerate(batches):
+            last_step = i
             self.state, step_metrics = self.train_step(
                 self.state, batch, lr_arr)
             # Accumulate device scalars without forcing a sync each step.
@@ -211,10 +224,15 @@ class Trainer:
                                    time.perf_counter() - t0)
                 window = {}
                 t0 = time.perf_counter()
+            if self._preempted:
+                break
         if window:
-            self._flush_window(epoch, self.train_iter.steps_per_epoch() - 1,
-                               window, time.perf_counter() - t0)
-        self.state = self.state.replace(epoch=self.state.epoch + 1)
+            self._flush_window(epoch, last_step, window,
+                               time.perf_counter() - t0)
+        if not self._preempted:
+            # A preempted (partial) epoch keeps its counter so resume re-runs
+            # the epoch from its shuffle-deterministic start.
+            self.state = self.state.replace(epoch=self.state.epoch + 1)
 
     def _flush_window(self, epoch: int, step_in_epoch: int,
                       window: Dict[str, float], elapsed: float) -> None:
@@ -251,16 +269,46 @@ class Trainer:
         cfg = self.cfg
         results: List[ValidationResult] = []
         start_epoch = int(jax.device_get(self.state.epoch))
-        for epoch in range(start_epoch, cfg.epoch_num):
-            lr = stepped_lr(epoch, base_lr=cfg.lr, factor=cfg.lr_decay_factor,
-                            every=cfg.lr_decay_every,
-                            decay_at_epoch0=cfg.decay_at_epoch0)
-            if epoch % cfg.val_every == 0:
-                results.append(self._validate_and_checkpoint(epoch))
-            print(f"[epoch {epoch}] lr={lr:.6g}")
-            self._train_epoch(epoch, lr)
-            if cfg.ckpt_every_epochs and (epoch + 1) % cfg.ckpt_every_epochs == 0:
-                self.ckpt.save(self.state)
+        self._preempted = False  # a prior preempted fit() must not stick
+        # Preemption safety: TPU pods deliver SIGTERM ahead of maintenance /
+        # capacity reclaims — stop at the next step boundary and write a full
+        # resumable checkpoint instead of losing the run.
+        # (signal.signal legitimately returns None for C-installed handlers,
+        # so None can't double as the "install failed" sentinel.)
+        handler_installed = False
+        prev_handler = None
+        try:
+            prev_handler = signal.signal(
+                signal.SIGTERM, lambda signum, frame: self.request_preempt())
+            handler_installed = True
+        except ValueError:
+            pass  # not the main thread (e.g. embedded use); handler skipped
+        try:
+            for epoch in range(start_epoch, cfg.epoch_num):
+                lr = stepped_lr(epoch, base_lr=cfg.lr,
+                                factor=cfg.lr_decay_factor,
+                                every=cfg.lr_decay_every,
+                                decay_at_epoch0=cfg.decay_at_epoch0)
+                if epoch % cfg.val_every == 0:
+                    results.append(self._validate_and_checkpoint(epoch))
+                print(f"[epoch {epoch}] lr={lr:.6g}")
+                self._train_epoch(epoch, lr)
+                if self._preempted:
+                    path = self.ckpt.save(self.state)
+                    print(f"[preempt] SIGTERM: saved full state at epoch "
+                          f"{epoch} -> {path}; resume with --resume")
+                    return results
+                if cfg.ckpt_every_epochs and (
+                        epoch + 1) % cfg.ckpt_every_epochs == 0:
+                    self.ckpt.save(self.state)
+        finally:
+            if handler_installed:
+                # A C-installed prior handler reads back as None and can't be
+                # re-installed from Python; fall back to the default action so
+                # SIGTERM still terminates the process after fit() returns.
+                signal.signal(signal.SIGTERM,
+                              prev_handler if prev_handler is not None
+                              else signal.SIG_DFL)
         results.append(self._validate_and_checkpoint(cfg.epoch_num))
         self.ckpt.save(self.state)
         return results

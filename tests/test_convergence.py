@@ -24,12 +24,16 @@ def test_mtl_reaches_distance_gate_and_writes_best(tmp_path):
 
     data_root = str(tmp_path / "data")
     striking, excavating = make_synthetic_dataset(
-        data_root, files_per_category=8, num_categories=16, shape=(100, 250),
+        data_root, files_per_category=16, num_categories=16, shape=(100, 250),
         seed=7)
 
     savedir = str(tmp_path / "runs")
     cfg = Config(
-        model="MTL", batch_size=32, epoch_num=30, val_every=2,
+        model="MTL", batch_size=32, epoch_num=40, val_every=2,
+        # The reference's /1.5-every-5 schedule freezes the LR three orders
+        # down by epoch 40; a gentler cadence lets the small fixture run
+        # actually reach the gate within the test budget.
+        lr_decay_every=10,
         trainval_set_striking=striking, trainval_set_excavating=excavating,
         output_savedir=savedir, seed=0,
         # Gate at the reference's 0.98 (Config resolves MTL -> 0.98).

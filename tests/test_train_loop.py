@@ -23,9 +23,10 @@ HW = (52, 64)
 def _mk_trainer(tmp_path, tiny_arrays, model="MTL", **cfg_kw):
     x, d, e = tiny_arrays
     src = ArraySource(x, d, e)
-    cfg = Config(model=model, batch_size=16, epoch_num=2, val_every=1,
-                 ckpt_every_epochs=1, log_every_steps=2,
-                 output_savedir=str(tmp_path), **cfg_kw)
+    defaults = dict(batch_size=16, epoch_num=2, val_every=1,
+                    ckpt_every_epochs=1, log_every_steps=2,
+                    output_savedir=str(tmp_path))
+    cfg = Config(model=model, **{**defaults, **cfg_kw})
     spec = get_model_spec(model)
     state = build_state(cfg, spec, input_hw=HW)
     it = BatchIterator(src, cfg.batch_size, seed=0)
@@ -135,6 +136,55 @@ def test_restore_weights_is_weights_only(tmp_path, tiny_arrays):
     got = jax.tree.leaves(jax.device_get(restored.params))
     for a, b in zip(trained, got):
         np.testing.assert_array_equal(a, b)
+
+
+def test_preempt_stops_early_and_saves_resumable_state(tmp_path, tiny_arrays):
+    """request_preempt() mid-run: fit stops at the next step boundary, writes
+    a full-state checkpoint, and does NOT advance the partial epoch's counter
+    (resume re-runs that epoch from its deterministic shuffle)."""
+    tr = _mk_trainer(tmp_path, tiny_arrays, epoch_num=5)
+    orig = tr._train_epoch
+
+    def preempt_then_train(epoch, lr):
+        # Request lands mid-run (fit() clears any stale flag on entry, so a
+        # pre-fit request is deliberately not honored).
+        tr.request_preempt()
+        orig(epoch, lr)
+
+    tr._train_epoch = preempt_then_train
+    results = tr.fit()
+    assert len(results) == 1  # only the epoch-0 validation ran
+    assert int(jax.device_get(tr.state.epoch)) == 0  # epoch not advanced
+    latest = tr.ckpt.latest_path()
+    assert latest is not None
+
+    fresh = _mk_trainer(tmp_path / "resume", tiny_arrays, epoch_num=5)
+    fresh.state = fresh.ckpt.restore(fresh.state, latest)
+    assert int(jax.device_get(fresh.state.epoch)) == 0
+    assert int(jax.device_get(fresh.state.step)) >= 1
+
+
+def test_sigterm_triggers_preempt_checkpoint(tmp_path, tiny_arrays):
+    """The SIGTERM handler fit() installs routes to request_preempt: a signal
+    delivered during training ends the run with a saved checkpoint (TPU-pod
+    preemption contract)."""
+    import signal as _signal
+
+    tr = _mk_trainer(tmp_path, tiny_arrays, epoch_num=5)
+    orig = tr._train_epoch
+
+    def send_sigterm_then_train(epoch, lr):
+        os.kill(os.getpid(), _signal.SIGTERM)
+        orig(epoch, lr)
+
+    tr._train_epoch = send_sigterm_then_train
+    before = _signal.getsignal(_signal.SIGTERM)
+    results = tr.fit()
+    assert tr._preempted
+    assert len(results) == 1
+    assert tr.ckpt.latest_path() is not None
+    # The previous handler is restored after fit.
+    assert _signal.getsignal(_signal.SIGTERM) is before
 
 
 def test_primary_gate_task_matches_reference(tmp_path, tiny_arrays):
