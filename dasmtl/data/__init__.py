@@ -3,3 +3,5 @@ from dasmtl.data.splits import DatasetSplits, build_splits  # noqa: F401
 from dasmtl.data.sources import ArraySource, DiskSource, RamSource  # noqa: F401
 from dasmtl.data.pipeline import BatchIterator, eval_batches  # noqa: F401
 from dasmtl.data.synthetic import make_synthetic_dataset  # noqa: F401
+from dasmtl.data.windowing import (plan_windows, iter_windows,  # noqa: F401
+                                   shard_windows, window_batches)

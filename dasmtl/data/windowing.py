@@ -1,0 +1,182 @@
+"""Streaming window extraction from long DAS fiber records.
+
+The reference consumes pre-cut ``(100, 250)`` windows only — the field
+recordings are sliced into per-sample ``.mat`` files *offline*, outside the
+repo (reference README.md:34-36), so a continuously recording fiber cannot be
+fed to the models without an external preprocessing step.  This module is the
+online, TPU-friendly equivalent: a long ``(channels, time)`` time-space matrix
+streams through static-shape windows ready for the jitted forward pass, and
+the stream partitions deterministically across hosts/devices so arbitrarily
+long records scale out instead of up (SURVEY.md §5 long-context row).
+
+Design notes (TPU-first):
+
+- every emitted window has the SAME static shape, so one compiled executable
+  serves the whole stream — no recompiles, no dynamic shapes;
+- when the stride grid stops short of the record edge, ``pad_tail=True`` adds
+  one final window *clamped to the edge* (overlapping its neighbor) so the
+  whole record is covered by real data; zero padding (with fractional weight,
+  the padded-batch convention of :mod:`dasmtl.data.pipeline`) occurs only
+  when the record itself is smaller than the window;
+- ``shard_windows`` slices the window index space contiguously per host, and
+  ``window_batches`` emits the SAME number of batches on every host (trailing
+  all-padding batches where a host's share runs short) — required for
+  multi-host SPMD, where every process must enter the jitted computation the
+  same number of times or the collectives deadlock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from dasmtl.config import INPUT_HEIGHT, INPUT_WIDTH
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowPlan:
+    """Static geometry of a windowed sweep over a ``(channels, time)`` record.
+
+    ``n_spatial`` x ``n_temporal`` windows of shape ``window`` are laid on a
+    stride grid; index ``i`` maps to grid position ``(i // n_temporal,
+    i % n_temporal)`` (time-major within a fiber span, matching how a live
+    stream arrives).
+    """
+
+    record_shape: Tuple[int, int]
+    window: Tuple[int, int]
+    stride: Tuple[int, int]
+    pad_tail: bool
+
+    @property
+    def n_spatial(self) -> int:
+        return self._count(self.record_shape[0], self.window[0],
+                           self.stride[0])
+
+    @property
+    def n_temporal(self) -> int:
+        return self._count(self.record_shape[1], self.window[1],
+                           self.stride[1])
+
+    @property
+    def n_windows(self) -> int:
+        return self.n_spatial * self.n_temporal
+
+    def _count(self, size: int, window: int, stride: int) -> int:
+        if size < window:
+            return 1 if self.pad_tail else 0
+        full = (size - window) // stride + 1
+        covered_end = (full - 1) * stride + window
+        if self.pad_tail and covered_end < size:
+            full += 1  # one clamped window covering [size - window, size)
+        return full
+
+    def origin(self, index: int) -> Tuple[int, int]:
+        """Top-left (channel, time) coordinate of window ``index``.  The last
+        grid position on each axis is clamped to ``size - window`` so a tail
+        window always covers the record edge with real data (zero padding
+        only ever happens when the record is smaller than the window)."""
+        si, ti = divmod(index, self.n_temporal)
+        c = min(si * self.stride[0],
+                max(0, self.record_shape[0] - self.window[0]))
+        t = min(ti * self.stride[1],
+                max(0, self.record_shape[1] - self.window[1]))
+        return c, t
+
+
+def plan_windows(record_shape: Tuple[int, int],
+                 window: Tuple[int, int] = (INPUT_HEIGHT, INPUT_WIDTH),
+                 stride: Optional[Tuple[int, int]] = None,
+                 pad_tail: bool = True) -> WindowPlan:
+    """Lay a static window grid over a record.  ``stride`` defaults to the
+    window itself (non-overlapping, the reference's offline slicing)."""
+    if stride is None:
+        stride = window
+    if min(window) < 1 or min(stride) < 1:
+        raise ValueError(f"window {window} and stride {stride} must be >= 1")
+    return WindowPlan(record_shape=tuple(record_shape), window=tuple(window),
+                      stride=tuple(stride), pad_tail=pad_tail)
+
+
+def extract_window(record: np.ndarray, plan: WindowPlan,
+                   index: int) -> Tuple[np.ndarray, float]:
+    """Window ``index`` as ``(window_h, window_w) float32``, plus its weight
+    (fraction of real — unpadded — area; 1.0 unless the record itself is
+    smaller than the window, thanks to edge clamping in ``origin``)."""
+    h, w = plan.window
+    c0, t0 = plan.origin(index)
+    piece = record[c0:c0 + h, t0:t0 + w]
+    ph, pw = piece.shape
+    if (ph, pw) == (h, w):
+        return np.asarray(piece, np.float32), 1.0
+    if not plan.pad_tail:
+        raise IndexError(f"window {index} is ragged and pad_tail is off")
+    out = np.zeros((h, w), np.float32)
+    out[:ph, :pw] = piece
+    return out, (ph * pw) / float(h * w)
+
+
+def iter_windows(record: np.ndarray, plan: Optional[WindowPlan] = None,
+                 start: int = 0, stop: Optional[int] = None,
+                 ) -> Iterator[Tuple[np.ndarray, float]]:
+    """Yield ``(window, weight)`` for indices ``[start, stop)`` of the grid."""
+    if plan is None:
+        plan = plan_windows(record.shape)
+    stop = plan.n_windows if stop is None else min(stop, plan.n_windows)
+    for i in range(start, stop):
+        yield extract_window(record, plan, i)
+
+
+def shard_windows(plan: WindowPlan, process_index: int,
+                  process_count: int) -> Tuple[int, int]:
+    """Contiguous ``[start, stop)`` slice of the window index space owned by
+    one host — the multi-host input split (every process feeds only its own
+    devices; ``jax.process_index()``/``jax.process_count()`` supply the
+    arguments in a distributed run)."""
+    if not 0 <= process_index < process_count:
+        raise ValueError(f"process_index {process_index} outside "
+                         f"[0, {process_count})")
+    per = math.ceil(plan.n_windows / process_count)
+    start = min(process_index * per, plan.n_windows)
+    return start, min(start + per, plan.n_windows)
+
+
+def window_batches(record: np.ndarray, batch_size: int,
+                   plan: Optional[WindowPlan] = None,
+                   process_index: int = 0, process_count: int = 1,
+                   ) -> Iterator[dict]:
+    """Model-ready static-shape batches from a long record.
+
+    Yields ``{"x": [B, h, w, 1] float32, "weight": [B], "index": [B]}``;
+    short/empty slots zero-pad to ``batch_size`` with weight 0.0 and index -1
+    (same convention as the training pipeline, so one executable serves every
+    batch).  ``index`` maps predictions back to grid positions via
+    :meth:`WindowPlan.origin`.
+
+    Every process yields the SAME number of batches —
+    ``ceil(ceil(n_windows / process_count) / batch_size)`` — emitting
+    all-padding batches once its contiguous share is exhausted.  Unequal
+    batch counts would deadlock a multi-host SPMD run: every process must
+    invoke the jitted computation in lockstep.
+    """
+    if plan is None:
+        plan = plan_windows(record.shape)
+    start, stop = shard_windows(plan, process_index, process_count)
+    max_share = math.ceil(plan.n_windows / process_count)
+    n_batches = math.ceil(max_share / batch_size) if plan.n_windows else 0
+    h, w = plan.window
+    for bi in range(n_batches):
+        b0 = start + bi * batch_size
+        n = max(0, min(batch_size, stop - b0))
+        x = np.zeros((batch_size, h, w, 1), np.float32)
+        weight = np.zeros((batch_size,), np.float32)
+        index = np.full((batch_size,), -1, np.int64)
+        for j in range(n):
+            win, wt = extract_window(record, plan, b0 + j)
+            x[j, :, :, 0] = win
+            weight[j] = wt
+            index[j] = b0 + j
+        yield {"x": x, "weight": weight, "index": index}

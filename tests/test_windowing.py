@@ -1,0 +1,140 @@
+"""Streaming long-record windowing (the online equivalent of the reference's
+offline slicing, README.md:34-36) and its multi-host sharding."""
+
+import numpy as np
+import pytest
+
+from dasmtl.data.windowing import (extract_window, iter_windows,
+                                   plan_windows, shard_windows,
+                                   window_batches)
+
+
+def test_grid_geometry_non_overlapping():
+    plan = plan_windows((100, 1000), window=(100, 250), pad_tail=True)
+    assert (plan.n_spatial, plan.n_temporal) == (1, 4)
+    assert plan.n_windows == 4
+    # Exact tiling: every window is interior, weight 1.
+    rec = np.arange(100 * 1000, dtype=np.float64).reshape(100, 1000)
+    wins = list(iter_windows(rec, plan))
+    assert len(wins) == 4
+    for k, (win, wt) in enumerate(wins):
+        assert wt == 1.0
+        np.testing.assert_array_equal(win, rec[:, k * 250:(k + 1) * 250])
+
+
+def test_tail_window_clamps_to_record_edge():
+    rec = np.random.default_rng(3).normal(size=(100, 600))
+    plan = plan_windows(rec.shape, window=(100, 250))  # grid covers 500 cols
+    assert plan.n_temporal == 3
+    win, wt = extract_window(rec, plan, 2)
+    # The tail overlaps its neighbor instead of zero-padding past the edge:
+    # all real data, weight 1, covering the final 250 columns.
+    assert wt == 1.0
+    np.testing.assert_array_equal(win, rec[:, 350:600].astype(np.float32))
+    # pad_tail off: the tail window doesn't exist.
+    plan2 = plan_windows(rec.shape, window=(100, 250), pad_tail=False)
+    assert plan2.n_temporal == 2
+
+
+def test_record_smaller_than_window_zero_pads():
+    rec = np.ones((100, 120), np.float64)
+    plan = plan_windows(rec.shape, window=(100, 250))
+    assert plan.n_temporal == 1
+    win, wt = extract_window(rec, plan, 0)
+    np.testing.assert_array_equal(win[:, 120:], 0.0)
+    assert wt == pytest.approx(120 / 250)
+    assert plan_windows(rec.shape, window=(100, 250),
+                        pad_tail=False).n_windows == 0
+
+
+def test_stride_larger_than_window_covers_edge():
+    # Subsampling sweep (stride > window): the tail window clamps to the edge
+    # instead of originating past the record end.
+    rec = np.arange(10, dtype=np.float64)[None, :].repeat(1, 0)
+    plan = plan_windows((1, 10), window=(1, 2), stride=(1, 7))
+    assert plan.n_temporal == 3  # t=0, t=7, clamped tail t=8
+    assert plan.origin(2) == (0, 8)
+    win, wt = extract_window(rec, plan, 2)
+    assert wt == 1.0
+    np.testing.assert_array_equal(win[0], [8.0, 9.0])
+
+
+def test_overlapping_stride_and_spatial_axis():
+    rec = np.random.default_rng(0).normal(size=(200, 500))
+    plan = plan_windows(rec.shape, window=(100, 250), stride=(100, 125),
+                        pad_tail=False)
+    assert (plan.n_spatial, plan.n_temporal) == (2, 3)
+    # Window 4 = spatial row 1, temporal col 1 -> origin (100, 125).
+    win, wt = extract_window(rec, plan, 4)
+    np.testing.assert_array_equal(win, rec[100:200, 125:375].astype(np.float32))
+    assert wt == 1.0
+
+
+def test_shard_windows_partitions_completely():
+    plan = plan_windows((100, 2500), window=(100, 250))  # 10 windows
+    slices = [shard_windows(plan, p, 3) for p in range(3)]
+    assert slices == [(0, 4), (4, 8), (8, 10)]
+    covered = [i for s, e in slices for i in range(s, e)]
+    assert covered == list(range(plan.n_windows))
+    with pytest.raises(ValueError):
+        shard_windows(plan, 3, 3)
+
+
+def test_window_batches_static_shapes_and_model_forward():
+    rec = np.random.default_rng(1).normal(size=(52, 300))
+    plan = plan_windows(rec.shape, window=(52, 64), pad_tail=True)
+    batches = list(window_batches(rec, batch_size=4, plan=plan))
+    # 300/64 -> 4 full + 1 padded tail = 5 windows -> 2 batches of 4.
+    assert plan.n_windows == 5 and len(batches) == 2
+    for b in batches:
+        assert b["x"].shape == (4, 52, 64, 1)
+        assert b["x"].dtype == np.float32
+    # The clamped tail window is all real data (weight 1); slots past the
+    # stream end carry weight 0 and index -1.
+    assert batches[-1]["weight"][0] == 1.0
+    assert list(batches[-1]["index"][-3:]) == [-1, -1, -1]
+    assert np.all(batches[-1]["weight"][-3:] == 0.0)
+
+    # The jitted flagship forward consumes the stream with ONE executable.
+    import jax
+
+    from dasmtl.models import MTLNet
+
+    model = MTLNet()
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, 52, 64, 1), np.float32), train=False)
+    fwd = jax.jit(lambda x: model.apply(variables, x, train=False))
+    for b in batches:
+        out_d, out_e = fwd(b["x"])
+        assert out_d.shape == (4, 16) and out_e.shape == (4, 2)
+
+
+def test_every_host_yields_equal_batch_count():
+    """SPMD lockstep: hosts whose contiguous share runs short (even empty)
+    still emit the same number of (all-padding) batches."""
+    rec = np.zeros((52, 64 * 4), np.float64)
+    plan = plan_windows(rec.shape, window=(52, 64))  # exactly 4 windows
+    counts, real = [], []
+    for p in range(3):
+        bs = list(window_batches(rec, 4, plan=plan, process_index=p,
+                                 process_count=3))
+        counts.append(len(bs))
+        real.append(int(sum((b["weight"] > 0).sum() for b in bs)))
+    assert counts == [1, 1, 1]  # host 2 has no windows but still one batch
+    assert real == [2, 2, 0]
+    assert sum(real) == plan.n_windows
+
+
+def test_two_host_shards_agree_with_single_host():
+    rec = np.random.default_rng(2).normal(size=(52, 500))
+    plan = plan_windows(rec.shape, window=(52, 64))
+    single = [b["index"][b["index"] >= 0]
+              for b in window_batches(rec, 4, plan=plan)]
+    single = np.concatenate(single)
+    multi = []
+    for p in range(2):
+        for b in window_batches(rec, 4, plan=plan, process_index=p,
+                                process_count=2):
+            multi.append(b["index"][b["index"] >= 0])
+    np.testing.assert_array_equal(np.sort(np.concatenate(multi)),
+                                  np.sort(single))
