@@ -79,6 +79,11 @@ class WindowPlan:
         grid position on each axis is clamped to ``size - window`` so a tail
         window always covers the record edge with real data (zero padding
         only ever happens when the record is smaller than the window)."""
+        if not 0 <= index < self.n_windows:
+            # Catches the batch-padding index -1 in particular, which would
+            # otherwise silently map to a wrong (negative-origin) position.
+            raise IndexError(f"window index {index} outside "
+                             f"[0, {self.n_windows})")
         si, ti = divmod(index, self.n_temporal)
         c = min(si * self.stride[0],
                 max(0, self.record_shape[0] - self.window[0]))

@@ -61,12 +61,26 @@ class CheckpointManager:
 
     # -- periodic ------------------------------------------------------------
     def save(self, state: TrainState) -> str:
+        """Asynchronous full-state save: Orbax copies the payload off device
+        before returning (so the next train step donating the state buffers
+        cannot corrupt it), then the disk write proceeds in a background
+        thread while training continues.  Call :meth:`wait` before relying on
+        the file (end of run, preemption exit); consecutive saves serialize
+        on the previous write."""
         step = int(jax.device_get(state.step))
         path = os.path.join(self.root, f"step_{step}")
+        self._ckptr.wait_until_finished()  # one write in flight at a time
+        self._prune()  # prunes only finalized step dirs, never the in-flight
+        # AsyncCheckpointer.save blocks until the payload is copied off
+        # device, then writes in the background — that contract is what makes
+        # donation safe.  Passing the jax.Arrays (not a device_get'd copy)
+        # also lets Orbax write per-host shards in a multi-host run.
         self._ckptr.save(path, state_payload(state), force=True)
-        self._ckptr.wait_until_finished()
-        self._prune()
         return path
+
+    def wait(self) -> None:
+        """Block until any in-flight background save is durably finalized."""
+        self._ckptr.wait_until_finished()
 
     def _steps(self):
         if not os.path.isdir(self.root):
@@ -106,8 +120,9 @@ class CheckpointManager:
             return None
         self._best_metric = metric
         path = os.path.join(self.root, "best")
+        self._ckptr.wait_until_finished()  # serialize with in-flight saves
         self._ckptr.save(path, state_payload(state), force=True)
-        self._ckptr.wait_until_finished()
+        self._ckptr.wait_until_finished()  # rare + gated: keep synchronous
         with open(os.path.join(self.root, "best_metric.txt"), "w") as f:
             f.write(f"{metric:.6f}\n")
         return path
@@ -118,6 +133,7 @@ class CheckpointManager:
         """Restore into the (freshly initialized) ``state`` template; shapes
         and dtypes must match, like the reference's ``strict=True`` load
         (utils.py:122-123)."""
+        self._ckptr.wait_until_finished()  # an in-flight save may be `path`
         if path is None:
             path = self.latest_path()
         if path is None:

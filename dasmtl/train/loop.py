@@ -295,6 +295,7 @@ class Trainer:
                 self._train_epoch(epoch, lr)
                 if self._preempted:
                     path = self.ckpt.save(self.state)
+                    self.ckpt.wait()  # the process is about to exit
                     print(f"[preempt] SIGTERM: saved full state at epoch "
                           f"{epoch} -> {path}; resume with --resume")
                     return results
@@ -311,6 +312,7 @@ class Trainer:
                               else signal.SIG_DFL)
         results.append(self._validate_and_checkpoint(cfg.epoch_num))
         self.ckpt.save(self.state)
+        self.ckpt.wait()  # saves are async; finalize before the run returns
         return results
 
     def _validate_and_checkpoint(self, epoch: int) -> ValidationResult:

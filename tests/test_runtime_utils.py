@@ -32,6 +32,7 @@ def test_best_metric_carryover_from_resumed_run(tmp_path):
     mgr_old = CheckpointManager(old_run)
     assert mgr_old.save_best(state, 0.991) is not None
     mgr_old.save(state)  # the step checkpoint --resume will find
+    mgr_old.wait()  # async save: finalize before find_latest_checkpoint
 
     # An unrelated run of the same model with a higher best but no newer
     # checkpoint: must NOT become the inherited floor.

@@ -187,6 +187,30 @@ def test_sigterm_triggers_preempt_checkpoint(tmp_path, tiny_arrays):
     assert _signal.getsignal(_signal.SIGTERM) is before
 
 
+def test_async_save_survives_buffer_donation(tmp_path, tiny_arrays):
+    """save() snapshots to host before the background write, so the jitted
+    step donating (invalidating) the state buffers right after cannot corrupt
+    the checkpoint."""
+    tr = _mk_trainer(tmp_path, tiny_arrays)
+    tr.fit()
+    expect = jax.device_get(tr.state.params)
+    expect_step = int(jax.device_get(tr.state.step))
+    path = tr.ckpt.save(tr.state)  # returns with the write still in flight
+    # Immediately run donating steps on the same state.
+    batch = next(iter(tr.train_iter.epoch(0)))
+    placed = tr._place(batch)
+    for _ in range(3):
+        tr.state, _ = tr.train_step(tr.state, placed, np.float32(1e-3))
+    tr.ckpt.wait()
+
+    fresh = _mk_trainer(tmp_path / "r", tiny_arrays)
+    restored = fresh.ckpt.restore(fresh.state, path)
+    assert int(jax.device_get(restored.step)) == expect_step
+    for a, b in zip(jax.tree.leaves(expect),
+                    jax.tree.leaves(jax.device_get(restored.params))):
+        np.testing.assert_array_equal(a, b)
+
+
 def test_primary_gate_task_matches_reference(tmp_path, tiny_arrays):
     # The reference gates every trainer that predicts distance on *distance*
     # accuracy — incl. the multi-classifier (utils.py:329, 682-685, 716);
