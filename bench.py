@@ -166,17 +166,32 @@ def _child_sweep() -> None:
 
     on_accel = jax.default_backend() not in ("cpu",)
     measure = 20 if on_accel else 4
-    rows = []
+    configs = []
     for batch_size in (32, 256) if on_accel else (32,):
         for dtype in ("float32", "bfloat16"):
             for use_pallas in (False, True):
-                r = _measure_config(batch_size, dtype, use_pallas,
-                                    warmup=2, measure=measure)
-                rows.append(r)
-                print(f"sweep: bs={batch_size} {dtype} "
-                      f"pallas={use_pallas}: {r['value']} samples/s "
-                      f"({r['step_time_ms']} ms/step, "
-                      f"mfu={r.get('mfu', '-')})", file=sys.stderr)
+                configs.append((batch_size, dtype, use_pallas))
+    if on_accel:
+        # Scaling probe: does a larger batch push MFU past the bs=256 point?
+        configs.append((512, "bfloat16", False))
+    rows = []
+    for batch_size, dtype, use_pallas in configs:
+        # One config failing (e.g. the bs=512 probe OOMing HBM — the exact
+        # risk a scaling probe explores) must not discard the completed rows.
+        try:
+            r = _measure_config(batch_size, dtype, use_pallas,
+                                warmup=2, measure=measure)
+        except Exception as exc:  # noqa: BLE001 — record and continue
+            rows.append({"batch_size": batch_size, "compute_dtype": dtype,
+                         "use_pallas": use_pallas, "error": repr(exc)[:300]})
+            print(f"sweep: bs={batch_size} {dtype} pallas={use_pallas} "
+                  f"FAILED: {exc!r}", file=sys.stderr)
+            continue
+        rows.append(r)
+        print(f"sweep: bs={batch_size} {dtype} "
+              f"pallas={use_pallas}: {r['value']} samples/s "
+              f"({r['step_time_ms']} ms/step, "
+              f"mfu={r.get('mfu', '-')})", file=sys.stderr)
     print(_MARK + json.dumps(rows))
 
 
