@@ -1,0 +1,140 @@
+"""Streaming inference over a long DAS record — the third CLI surface.
+
+The reference can only evaluate pre-cut per-sample ``.mat`` windows
+(its field recordings are sliced offline, outside the repo; reference
+README.md:34-36, test.py:30-39).  This entry point takes a *continuous*
+``(channels, time)`` time-space matrix, sweeps it with the window grid of
+:mod:`dasmtl.data.windowing`, runs the restored model over every window with
+ONE compiled executable, and writes per-window predictions to CSV:
+
+    window_index, channel_origin, time_origin, weight,
+    pred_distance_m, pred_event   (columns present per model head)
+
+Multi-host runs shard the window index space per process (lockstep batch
+counts); with ``process_count > 1`` each host writes its own shard file
+(``<out>.p<index>.csv``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import sys
+from typing import Optional, Tuple
+
+import numpy as np
+
+EVENT_NAMES = ("striking", "excavating")
+
+
+def stream_predict(record: np.ndarray, model_path: str, model: str = "MTL",
+                   batch_size: int = 256,
+                   window: Optional[Tuple[int, int]] = None,
+                   stride: Optional[Tuple[int, int]] = None,
+                   out_csv: Optional[str] = None,
+                   process_index: int = 0, process_count: int = 1) -> list:
+    """Run the restored ``model`` over every window of ``record``.
+
+    Returns the prediction rows (and writes ``out_csv`` when given).  Library
+    entry — the CLI below is a thin wrapper.
+    """
+    import jax
+
+    from dasmtl.config import INPUT_HEIGHT, INPUT_WIDTH, Config
+    from dasmtl.data.windowing import plan_windows, window_batches
+    from dasmtl.main import build_state
+    from dasmtl.models.registry import get_model_spec
+    from dasmtl.train.checkpoint import restore_weights
+
+    window = window or (INPUT_HEIGHT, INPUT_WIDTH)
+    cfg = Config(model=model, batch_size=batch_size)
+    spec = get_model_spec(model)
+    state = build_state(cfg, spec, input_hw=window)
+    if model_path:
+        state = restore_weights(state, model_path)
+
+    plan = plan_windows(record.shape, window=window, stride=stride)
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+
+    @jax.jit
+    def forward(x):
+        return spec.decode(state.apply_fn(variables, x, train=False))
+
+    tasks = [t for t, _ in spec.report_tasks]
+    fieldnames = ["window_index", "channel_origin", "time_origin", "weight"]
+    fieldnames += [f for f, t in (("pred_distance_m", "distance"),
+                                  ("pred_event", "event")) if t in tasks]
+
+    rows = []
+    for batch in window_batches(record, batch_size, plan=plan,
+                                process_index=process_index,
+                                process_count=process_count):
+        preds = {k: np.asarray(v) for k, v in forward(batch["x"]).items()}
+        for j, idx in enumerate(batch["index"]):
+            if idx < 0:  # batch padding slot
+                continue
+            c0, t0 = plan.origin(int(idx))
+            row = {"window_index": int(idx), "channel_origin": c0,
+                   "time_origin": t0, "weight": float(batch["weight"][j])}
+            if "distance" in preds:
+                row["pred_distance_m"] = int(preds["distance"][j])
+            if "event" in preds:
+                e = int(preds["event"][j])
+                row["pred_event"] = EVENT_NAMES[e]
+            rows.append(row)
+    if out_csv:
+        if process_count > 1:  # per-host shard file — never overwrite peers
+            base, ext = os.path.splitext(out_csv)
+            out_csv = f"{base}.p{process_index}{ext or '.csv'}"
+        parent = os.path.dirname(os.path.abspath(out_csv))
+        os.makedirs(parent, exist_ok=True)
+        with open(out_csv, "w", newline="") as f:
+            writer = csv.DictWriter(f, fieldnames=fieldnames)
+            writer.writeheader()  # header even for an empty shard
+            writer.writerows(rows)
+    return rows
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="dasmtl streaming inference over a long DAS record")
+    p.add_argument("--record", type=str, required=True,
+                   help=".mat file holding the (channels, time) matrix")
+    p.add_argument("--mat_key", type=str, default="data")
+    p.add_argument("--model", type=str, default="MTL")
+    p.add_argument("--model_path", type=str, required=True,
+                   help="checkpoint directory to restore weights from")
+    p.add_argument("--batch_size", type=int, default=256)
+    p.add_argument("--stride_time", type=int, default=None,
+                   help="time-axis stride in samples (default: window width, "
+                        "i.e. non-overlapping)")
+    p.add_argument("--stride_channels", type=int, default=None)
+    p.add_argument("--out", type=str, default=None,
+                   help="output CSV (default: <record>.predictions.csv)")
+    p.add_argument("--device", type=str, default="auto",
+                   choices=["tpu", "cpu", "auto"],
+                   help="applied to JAX_PLATFORMS by the root stream.py "
+                        "wrapper before JAX loads")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from dasmtl.config import INPUT_HEIGHT, INPUT_WIDTH
+    from dasmtl.data import matio
+
+    record = matio.load_mat(args.record, key_list=(args.mat_key,))
+    stride = (args.stride_channels or INPUT_HEIGHT,
+              args.stride_time or INPUT_WIDTH)
+    out_csv = args.out or (args.record + ".predictions.csv")
+    rows = stream_predict(
+        np.asarray(record), args.model_path, model=args.model,
+        batch_size=args.batch_size, stride=stride, out_csv=out_csv,
+        process_index=jax.process_index(), process_count=jax.process_count())
+    print(f"streamed {len(rows)} windows from {record.shape} record "
+          f"-> {out_csv}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,27 @@
+"""Streaming-inference CLI — long-record prediction sweep.
+
+The reference evaluates only pre-cut per-sample windows (its recordings are
+sliced offline, reference README.md:34-36); this entry runs the restored
+model over a continuous (channels, time) record directly.  ``--device`` must
+be resolved before JAX initializes, so it is applied to ``JAX_PLATFORMS``
+here, before any dasmtl/jax import (same pattern as train.py/test.py).
+
+    python stream.py --record fiber.mat --model_path <run>/ckpts/best \\
+        --stride_time 125 --out predictions.csv
+"""
+
+import sys
+
+from train import _apply_device_flag
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    _apply_device_flag(argv)
+    from dasmtl.stream import main as stream_main
+
+    return stream_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
