@@ -28,6 +28,16 @@ import numpy as np
 EVENT_NAMES = ("striking", "excavating")
 
 
+def shard_csv_path(out_csv: str, process_index: int,
+                   process_count: int) -> str:
+    """The file one host actually writes: per-host ``<base>.p<i>.csv`` shard
+    names under multi-host (never overwrite peers), the path itself otherwise."""
+    if process_count <= 1:
+        return out_csv
+    base, ext = os.path.splitext(out_csv)
+    return f"{base}.p{process_index}{ext or '.csv'}"
+
+
 def stream_predict(record: np.ndarray, model_path: str, model: str = "MTL",
                    batch_size: int = 256,
                    window: Optional[Tuple[int, int]] = None,
@@ -84,9 +94,7 @@ def stream_predict(record: np.ndarray, model_path: str, model: str = "MTL",
                 row["pred_event"] = EVENT_NAMES[e]
             rows.append(row)
     if out_csv:
-        if process_count > 1:  # per-host shard file — never overwrite peers
-            base, ext = os.path.splitext(out_csv)
-            out_csv = f"{base}.p{process_index}{ext or '.csv'}"
+        out_csv = shard_csv_path(out_csv, process_index, process_count)
         parent = os.path.dirname(os.path.abspath(out_csv))
         os.makedirs(parent, exist_ok=True)
         with open(out_csv, "w", newline="") as f:
@@ -113,10 +121,13 @@ def main(argv=None) -> int:
     p.add_argument("--out", type=str, default=None,
                    help="output CSV (default: <record>.predictions.csv)")
     p.add_argument("--device", type=str, default="auto",
-                   choices=["tpu", "cpu", "auto"],
-                   help="applied to JAX_PLATFORMS by the root stream.py "
-                        "wrapper before JAX loads")
+                   choices=["tpu", "cpu", "auto"])
     args = p.parse_args(argv)
+    # Honor --device even when this module is the entry point (the root
+    # stream.py wrapper also pre-applies it before any import).
+    from dasmtl.utils.platform import apply_device
+
+    apply_device(args.device)
 
     import jax
 
@@ -127,14 +138,19 @@ def main(argv=None) -> int:
     stride = (args.stride_channels or INPUT_HEIGHT,
               args.stride_time or INPUT_WIDTH)
     out_csv = args.out or (args.record + ".predictions.csv")
+    pi, pc = jax.process_index(), jax.process_count()
     rows = stream_predict(
         np.asarray(record), args.model_path, model=args.model,
         batch_size=args.batch_size, stride=stride, out_csv=out_csv,
-        process_index=jax.process_index(), process_count=jax.process_count())
+        process_index=pi, process_count=pc)
     print(f"streamed {len(rows)} windows from {record.shape} record "
-          f"-> {out_csv}")
+          f"-> {shard_csv_path(out_csv, pi, pc)}")
     return 0
 
 
 if __name__ == "__main__":
+    # Direct file execution (`python dasmtl/stream.py`) puts dasmtl/ on
+    # sys.path, not the repo root — add the root so `import dasmtl` works.
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     sys.exit(main())

@@ -89,7 +89,7 @@ class Trainer:
 
     def __init__(self, cfg: Config, spec: ModelSpec, state: TrainState,
                  train_iter: BatchIterator, val_source, run_dir: str,
-                 mesh_plan: Optional[MeshPlan] = None):
+                 mesh_plan: Optional[MeshPlan] = None, eval_step=None):
         self.cfg = cfg
         self.spec = spec
         self.state = state
@@ -99,7 +99,10 @@ class Trainer:
         self.mesh_plan = mesh_plan
         self.train_step = make_train_step(spec, mesh_plan=mesh_plan,
                                           bn_sync=cfg.bn_sync)
-        self.eval_step = make_eval_step(spec)
+        # A caller evaluating the same spec repeatedly (e.g. the SNR
+        # robustness sweep) passes one jitted eval step so XLA compiles the
+        # identical computation once, not per Trainer.
+        self.eval_step = eval_step or make_eval_step(spec)
         self.metrics_dir = os.path.join(run_dir, "metrics")
         self.lines = MetricLines(self.metrics_dir)
         self.ckpt = CheckpointManager(run_dir, max_keep=cfg.ckpt_max_keep)

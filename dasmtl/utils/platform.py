@@ -35,6 +35,29 @@ def cpu_pinned_env(n_devices: Optional[int] = None,
     return env
 
 
+def apply_device(device: str) -> None:
+    """Apply a ``--device={tpu,cpu,auto}`` choice as robustly as possible from
+    inside a running process: set ``JAX_PLATFORMS``, and when jax is already
+    imported (sitecustomize does that in this container, latching the env at
+    import) also update the live ``jax.config`` — valid until backends have
+    initialized."""
+    import sys
+
+    if device == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    elif device == "tpu":
+        current = os.environ.get("JAX_PLATFORMS", "")
+        if not current or current == "cpu":
+            os.environ["JAX_PLATFORMS"] = "tpu"
+    else:
+        return
+    if "jax" in sys.modules:
+        import jax
+
+        jax.config.update("jax_platforms",
+                          os.environ.get("JAX_PLATFORMS") or None)
+
+
 def pin_cpu_in_process(n_devices: Optional[int] = None) -> bool:
     """Apply the pinning to ``os.environ``; returns False (no-op) when jax is
     already imported, because the platform choice is latched at first import."""
