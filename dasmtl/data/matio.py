@@ -28,5 +28,6 @@ def load_mat(file_path: str, key_list: Sequence[str] = ("data",)) -> np.ndarray:
     raise KeyError(f"{file_path}: none of {list(key_list)} found")
 
 
-def save_mat(file_path: str, array: np.ndarray, key: str = "data") -> None:
-    sio.savemat(file_path, {key: array})
+def save_mat(file_path: str, array: np.ndarray, key: str = "data",
+             do_compression: bool = False) -> None:
+    sio.savemat(file_path, {key: array}, do_compression=do_compression)

@@ -1,0 +1,103 @@
+"""Native C++ MAT loader vs scipy: data-path throughput measurement.
+
+The reference's whole data path is single-threaded ``scipy.io.loadmat``
+(reference dataset_preparation.py:263,312 + ``num_workers=0`` DataLoaders,
+utils.py:152-156).  This measures the framework's GIL-free multithreaded C++
+loader (native/dasmat.cpp) against the scipy fallback on the same synthetic
+tree and prints one JSON line per path — the evidence behind the loader row
+in BASELINE.md.
+
+    python scripts/bench_loader.py [--files 256] [--repeats 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--files", type=int, default=256)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--compressed", action="store_true",
+                    help="write zlib-compressed MAT files")
+    args = ap.parse_args()
+
+    import shutil
+
+    from dasmtl.data import matio, native
+
+    tmp = tempfile.mkdtemp(prefix="dasmtl_loaderbench_")
+    try:
+        return _run(args, tmp, matio, native)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _run(args, tmp, matio, native) -> int:
+    rng = np.random.default_rng(0)
+    paths = []
+    for i in range(args.files):
+        p = os.path.join(tmp, f"s{i:05d}.mat")
+        matio.save_mat(p, rng.normal(size=(100, 250)),
+                       do_compression=args.compressed)
+        paths.append(p)
+
+    def timed(fn):
+        best = None
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            out = fn()
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return out, best
+
+    results = {}
+    if native.available():
+        rows, cols = native.mat_dims(paths[0])
+        (batch, dt) = timed(lambda: native.load_many_f32(
+            paths, "data", rows, cols))
+        assert batch.shape == (args.files, rows, cols)
+        results["native"] = dt
+    else:
+        print("native loader unavailable; scipy only", file=sys.stderr)
+
+    def scipy_batch():
+        return np.stack([matio.load_mat(p) for p in paths])
+
+    (ref, dt) = timed(scipy_batch)
+    results["scipy"] = dt
+
+    if "native" in results:
+        # Parity while we're here.
+        np.testing.assert_allclose(batch, ref.astype(np.float32), rtol=1e-6)
+
+    for name, dt in results.items():
+        print(json.dumps({
+            "metric": f"mat_load_files_per_s_{name}",
+            "value": round(args.files / dt, 1),
+            "unit": "files/s",
+            "files": args.files,
+            "compressed": bool(args.compressed),
+            "batch_ms": round(dt * 1e3, 1),
+        }))
+    if "native" in results:
+        print(json.dumps({
+            "metric": "native_vs_scipy_speedup",
+            "value": round(results["scipy"] / results["native"], 2),
+            "unit": "x",
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
