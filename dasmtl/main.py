@@ -71,7 +71,16 @@ def make_mesh_plan(cfg: Config) -> Optional[MeshPlan]:
 def replicate_state(state: TrainState, plan: Optional[MeshPlan]) -> TrainState:
     if plan is None:
         return state
-    return jax.device_put(state, replicated_sharding(plan))
+    sharding = replicated_sharding(plan)
+    if all(d.process_index == jax.process_index()
+           for d in plan.mesh.devices.flat):
+        return jax.device_put(state, sharding)
+    # Multi-host mesh: device_put cannot place onto non-addressable devices;
+    # every process supplies its (identical, seed-deterministic) local copy
+    # and the global replicated arrays are assembled per host.
+    return jax.tree.map(
+        lambda leaf: jax.make_array_from_process_local_data(
+            sharding, np.asarray(leaf)), state)
 
 
 def build_sources(cfg: Config, is_test: bool,

@@ -1,0 +1,26 @@
+"""Shared batch fixture for the parallel/multi-host tests.
+
+One home for the deterministic synthetic batch builder (previously duplicated
+in test_parallel.py / test_bn_sync.py, and needed verbatim by BOTH sides of
+the 2-process multi-host test: the parent's single-process reference and the
+spawned children must construct the SAME global batch).
+"""
+
+import numpy as np
+
+HW = (52, 64)
+BATCH = 8  # multi-host test: global batch; each of the 2 processes feeds 4
+
+
+def make_batch(batch_size, seed=0, hw=HW):
+    rng = np.random.default_rng(seed)
+    return {
+        "x": rng.normal(size=(batch_size,) + hw + (1,)).astype(np.float32),
+        "distance": rng.integers(0, 16, size=(batch_size,)).astype(np.int32),
+        "event": rng.integers(0, 2, size=(batch_size,)).astype(np.int32),
+        "weight": np.ones((batch_size,), np.float32),
+    }
+
+
+def make_global_batch():
+    return make_batch(BATCH, seed=1234)

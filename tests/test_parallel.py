@@ -16,17 +16,7 @@ from dasmtl.parallel.mesh import (create_mesh, batch_sharding,
                                   replicated_sharding, shard_batch)
 from dasmtl.train.steps import make_train_step
 
-HW = (52, 64)
-
-
-def _batch(batch_size, seed=0):
-    rng = np.random.default_rng(seed)
-    return {
-        "x": rng.normal(size=(batch_size,) + HW + (1,)).astype(np.float32),
-        "distance": rng.integers(0, 16, size=(batch_size,)).astype(np.int32),
-        "event": rng.integers(0, 2, size=(batch_size,)).astype(np.int32),
-        "weight": np.ones((batch_size,), np.float32),
-    }
+from tests.multihost_common import HW, make_batch as _batch
 
 
 def test_eight_virtual_devices_present():
