@@ -48,8 +48,8 @@ _PEAK_BF16 = {"v6e": 918e12, "trillium": 918e12, "v5p": 459e12,
 
 
 def _measure_config(batch_size: int, dtype: str, use_pallas: bool,
-                    warmup: int, measure: int) -> dict:
-    """One compile+measure of the jitted MTL train step (jax already up)."""
+                    warmup: int, measure: int, model: str = "MTL") -> dict:
+    """One compile+measure of the jitted train step (jax already up)."""
     import jax
     import numpy as np
 
@@ -62,7 +62,7 @@ def _measure_config(batch_size: int, dtype: str, use_pallas: bool,
     device_kind = jax.devices()[0].device_kind
     on_accel = backend not in ("cpu",)
 
-    cfg = Config(model="MTL", batch_size=batch_size, compute_dtype=dtype,
+    cfg = Config(model=model, batch_size=batch_size, compute_dtype=dtype,
                  use_pallas=use_pallas)
     spec = get_model_spec(cfg.model)
     state = build_state(cfg, spec)
@@ -100,7 +100,9 @@ def _measure_config(batch_size: int, dtype: str, use_pallas: bool,
 
     samples_per_s = batch_size * measure / elapsed
     result = {
-        "metric": "mtl_train_samples_per_s",
+        "metric": ("mtl_train_samples_per_s" if model == "MTL"
+                   else f"{model}_train_samples_per_s"),
+        "model": model,
         "value": round(samples_per_s, 2),
         "unit": "samples/s",
         # The axon plugin IS the TPU tunnel; report any other backend as-is.
@@ -197,6 +199,35 @@ def _child_sweep() -> None:
     print(_MARK + json.dumps(rows))
 
 
+def _child_models() -> None:
+    """Every model family (the reference's four registry entries,
+    utils.py:85-98) through the same train+eval measurement — the evidence
+    that the whole model zoo, not just the flagship, holds up on TPU.
+    Run manually:  python bench.py --models"""
+    import jax
+
+    on_accel = jax.default_backend() not in ("cpu",)
+    measure = 20 if on_accel else 4
+    batch_size = 256 if on_accel else 8
+    dtype = "bfloat16" if on_accel else "float32"
+    rows = []
+    for model in ("MTL", "single_distance", "single_event",
+                  "multi_classifier"):
+        try:
+            r = _measure_config(batch_size, dtype, use_pallas=False,
+                                warmup=2, measure=measure, model=model)
+        except Exception as exc:  # noqa: BLE001 — record and continue
+            rows.append({"model": model, "batch_size": batch_size,
+                         "error": repr(exc)[:300]})
+            print(f"models: {model} FAILED: {exc!r}", file=sys.stderr)
+            continue
+        rows.append(r)
+        print(f"models: {model}: {r['value']} samples/s "
+              f"({r['step_time_ms']} ms/step, mfu={r.get('mfu', '-')}, "
+              f"eval={r.get('eval_samples_per_s', '-')})", file=sys.stderr)
+    print(_MARK + json.dumps(rows))
+
+
 def _run_child(env: dict, timeout: float, flag: str = "--child"):
     """One measurement attempt in a subprocess (``flag`` selects the child
     mode); returns (parsed BENCH_RESULT | None, diagnostics)."""
@@ -274,26 +305,31 @@ def main() -> int:
     return 0
 
 
-def sweep() -> int:
-    """Run the perf-lever sweep in a child on the best available platform."""
+def _multi_config(child_flag: str) -> int:
+    """Run a multi-row child (--child-sweep / --child-models) on the best
+    available platform and print its JSON row list."""
     from dasmtl.utils.platform import cpu_pinned_env
 
     for env, timeout in ((dict(os.environ), 1500), (cpu_pinned_env(), 1800)):
-        rows, diag = _run_child(env, timeout, flag="--child-sweep")
+        rows, diag = _run_child(env, timeout, flag=child_flag)
         print(diag, end="", file=sys.stderr)
         if rows is not None:
             print(json.dumps(rows))
             return 0
-        print("sweep: attempt failed", file=sys.stderr)
+        print(f"{child_flag}: attempt failed", file=sys.stderr)
     return 1
 
 
 if __name__ == "__main__":
     if "--child-sweep" in sys.argv:
         _child_sweep()
+    elif "--child-models" in sys.argv:
+        _child_models()
     elif "--child" in sys.argv:
         _child_measure()
     elif "--sweep" in sys.argv:
-        sys.exit(sweep())
+        sys.exit(_multi_config("--child-sweep"))
+    elif "--models" in sys.argv:
+        sys.exit(_multi_config("--child-models"))
     else:
         sys.exit(main())

@@ -95,6 +95,19 @@ class Config:
     # per-device batch equals the reference's 32 (utils.py:249-250).
     bn_sync: str = "global"
 
+    # ---- device-resident training data (new: TPU-native fast path) ----
+    # Keep the whole training set in device HBM and gather batches on-device
+    # inside a lax.scan over `steps_per_dispatch` fused train steps — no
+    # per-step host gather, H2D copy, or Python dispatch on the critical
+    # path.  The reference re-copies every batch host->device per step
+    # (utils.py:350-353).  "auto" enables it on accelerator backends for
+    # RAM-resident sources that fit `device_data_budget_mb`; BatchNorm must
+    # be `bn_sync="global"` (the per-replica shard_map path keeps the
+    # host pipeline).
+    device_data: str = "auto"  # auto | on | off
+    device_data_budget_mb: int = 1024
+    steps_per_dispatch: int = 8
+
     # ---- run outputs (reference utils.py:100-116) ----
     output_savedir: str = "./runs"
     model_path: Optional[str] = None  # checkpoint to restore
@@ -118,6 +131,10 @@ class Config:
             raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
         if self.bn_sync not in ("global", "per_replica"):
             raise ValueError(f"unknown bn_sync {self.bn_sync!r}")
+        if self.device_data not in ("auto", "on", "off"):
+            raise ValueError(f"unknown device_data {self.device_data!r}")
+        if self.steps_per_dispatch < 1:
+            raise ValueError("steps_per_dispatch must be >= 1")
 
     @property
     def decay_at_epoch0(self) -> bool:
@@ -197,6 +214,16 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
                    help="opt-in Gaussian noise SNR (dB) for robustness evals")
     p.add_argument("--prefetch_batches", type=int, default=d.prefetch_batches,
                    help="batch prefetch depth (0 disables the overlap thread)")
+    p.add_argument("--device_data", type=str, default=d.device_data,
+                   choices=["auto", "on", "off"],
+                   help="keep the training set in device HBM and gather "
+                        "batches on-device (scan-fused steps)")
+    p.add_argument("--device_data_budget_mb", type=int,
+                   default=d.device_data_budget_mb)
+    p.add_argument("--steps_per_dispatch", type=int,
+                   default=d.steps_per_dispatch,
+                   help="train steps fused per dispatch on the device-data "
+                        "path")
     p.add_argument("--use_pallas", action=argparse.BooleanOptionalAction,
                    default=d.use_pallas)
     p.add_argument("--resume", action=argparse.BooleanOptionalAction,

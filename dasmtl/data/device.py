@@ -1,0 +1,63 @@
+"""Device-resident training data.
+
+The DAS datasets are small by accelerator standards (the reference's field
+set is hundreds of (100, 250) float32 windows — tens of MB), while a TPU v5e
+carries 16 GB of HBM.  Keeping the *whole* training set on device and
+gathering batches inside the jitted computation removes the per-step host
+gather + host->device copy + Python dispatch entirely — the costs the
+reference pays every single step (``.cuda()`` per batch, utils.py:350-353;
+``num_workers=0`` synchronous loading, utils.py:152-156).
+
+:class:`DeviceDataset` owns the HBM copy; the batch gather itself lives in
+:func:`dasmtl.train.steps.make_scan_train_step`, which scans K fused train
+steps per dispatch over an index plan
+(:meth:`dasmtl.data.pipeline.BatchIterator.epoch_index_plan`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from dasmtl.data.sources import _SourceBase
+
+
+def resident_bytes(source: _SourceBase) -> Optional[int]:
+    """Size of the source's sample array if known without loading it.
+
+    RAM-backed sources (``RamSource``, ``ArraySource``) expose their
+    contiguous array; lazy ``DiskSource`` returns None — materializing it
+    just to measure would defeat its purpose, so ``device_data="auto"``
+    skips it (``"on"`` forces the load).
+    """
+    x = getattr(source, "x", None)
+    return None if x is None else int(x.nbytes)
+
+
+class DeviceDataset:
+    """The full training set as device arrays (replicated under a mesh)."""
+
+    def __init__(self, source: _SourceBase, mesh_plan=None):
+        n = len(source)
+        # RAM-backed sources already hold the contiguous array — reuse it
+        # instead of fancy-indexing a full host-RAM duplicate.
+        x = getattr(source, "x", None)
+        if x is None:
+            x = source.gather(np.arange(n))
+        host = {
+            "x": np.ascontiguousarray(x, dtype=np.float32),
+            "distance": np.asarray(source.distance, np.int32),
+            "event": np.asarray(source.event, np.int32),
+        }
+        self.n = n
+        self.nbytes = sum(a.nbytes for a in host.values())
+        if mesh_plan is not None and mesh_plan.n_devices > 1:
+            from dasmtl.parallel.mesh import replicated_sharding
+
+            sharding = replicated_sharding(mesh_plan)
+            self.data = {k: jax.device_put(v, sharding)
+                         for k, v in host.items()}
+        else:
+            self.data = jax.device_put(host)

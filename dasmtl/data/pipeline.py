@@ -126,17 +126,39 @@ class BatchIterator:
             return n // self.batch_size
         return math.ceil(n / self.batch_size)
 
+    def _epoch_order(self, epoch_idx: int) -> np.ndarray:
+        n = len(self.source)
+        if not self.shuffle:
+            return np.arange(n)
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, epoch_idx]))
+        return rng.permutation(n)
+
     def epoch(self, epoch_idx: int) -> Iterator[Batch]:
         n = len(self.source)
-        order = np.arange(n)
-        if self.shuffle:
-            rng = np.random.default_rng(
-                np.random.SeedSequence([self.seed, epoch_idx]))
-            order = rng.permutation(n)
+        order = self._epoch_order(epoch_idx)
         stop = (n // self.batch_size) * self.batch_size if self.drop_last else n
         for start in range(0, stop, self.batch_size):
             idx = order[start:start + self.batch_size]
             yield _make_batch(self.source, idx, self.batch_size)
+
+    def epoch_index_plan(self, epoch_idx: int):
+        """The epoch as a static-shape index plan: ``(idx [S, B] int32,
+        weight [S, B] float32)`` with the exact batch composition
+        :meth:`epoch` yields (same ``(seed, epoch)`` permutation, same
+        zero-weight padding on the ragged final batch).  Consumed by the
+        device-resident gather path
+        (:func:`dasmtl.train.steps.make_scan_train_step`)."""
+        n = len(self.source)
+        order = self._epoch_order(epoch_idx)
+        steps = self.steps_per_epoch()
+        idx = np.zeros((steps, self.batch_size), np.int32)
+        weight = np.zeros((steps, self.batch_size), np.float32)
+        for s in range(steps):
+            chunk = order[s * self.batch_size:(s + 1) * self.batch_size]
+            idx[s, :chunk.shape[0]] = chunk
+            weight[s, :chunk.shape[0]] = 1.0
+        return idx, weight
 
 
 def eval_batches(source: _SourceBase, batch_size: int) -> Iterator[Batch]:

@@ -44,6 +44,7 @@ import jax
 import numpy as np
 
 from dasmtl.config import Config, mixed_label
+from dasmtl.data.device import DeviceDataset, resident_bytes
 from dasmtl.data.pipeline import BatchIterator, eval_batches, prefetch
 from dasmtl.models.registry import ModelSpec
 from dasmtl.parallel.mesh import MeshPlan, shard_batch
@@ -51,7 +52,8 @@ from dasmtl.train import metrics as host_metrics
 from dasmtl.train.checkpoint import CheckpointManager
 from dasmtl.train.optim import stepped_lr
 from dasmtl.train.state import TrainState
-from dasmtl.train.steps import make_eval_step, make_train_step
+from dasmtl.train.steps import (make_eval_step, make_scan_train_step,
+                                make_train_step)
 
 
 class MetricLines:
@@ -120,6 +122,11 @@ class Trainer:
         self.eval_batch_size = cfg.batch_size * (
             mesh_plan.dp if mesh_plan else 1)
         self._preempted = False
+        # Device-resident fast path (lazily materialized at first train epoch
+        # so eval-only uses never touch HBM for the train set).
+        self._device_data: Optional[DeviceDataset] = None
+        self._scan_step = None
+        self._device_data_noticed = False  # once-per-run fallback notices
 
     def request_preempt(self) -> None:
         """Ask the running ``fit`` to stop at the next safe point and write a
@@ -207,7 +214,115 @@ class Trainer:
                                 primary_task=self.primary_task)
 
     # -- training ------------------------------------------------------------
+    def _use_device_data(self) -> bool:
+        """Device-resident path eligibility (see Config.device_data).
+
+        ``auto`` requires an accelerator backend (on CPU the host pipeline is
+        not the bottleneck and tests keep their per-step trace), a global-BN
+        step (the per-replica path is a ``shard_map`` over host-sharded
+        batches), and a RAM-backed source within the HBM budget.
+        """
+        cfg = self.cfg
+        if cfg.device_data == "off":
+            return False
+        if self._device_data is not None:
+            return True
+
+        def declined(reason: str) -> bool:
+            # Forced-on fallbacks are worth a (once-per-run) notice; "auto"
+            # declines silently.
+            if cfg.device_data == "on" and not self._device_data_noticed:
+                self._device_data_noticed = True
+                print(f"[device-data] disabled: {reason}")
+            return False
+
+        if cfg.bn_sync != "global":
+            return declined("bn_sync=per_replica keeps the shard_map host "
+                            "pipeline")
+        if jax.process_count() > 1:
+            # Each process holds only its file shard; a "replicated" HBM copy
+            # would be wrong (and device_put can't span non-addressable
+            # devices).  Multi-host keeps the per-host pipeline.
+            return declined("multi-process run keeps the per-host input "
+                            "pipeline")
+        source = self.train_iter.source
+        if getattr(source, "noise_snr_db", None) is not None and not hasattr(
+                source, "x"):
+            # A lazy source with SNR noise redraws it at every gather; one
+            # up-front gather would freeze a single noise realization and
+            # silently change training.  (RAM sources draw once at preload,
+            # so their device copy is identical to the host path.)
+            return declined("lazy source with per-gather noise "
+                            "(noise_snr_db) — the host pipeline redraws it")
+        if cfg.device_data == "auto":
+            if jax.default_backend() == "cpu":
+                return False
+            nbytes = resident_bytes(source)
+            if nbytes is None or nbytes > cfg.device_data_budget_mb * 2**20:
+                return False
+        return True
+
+    def _dispatch_k(self) -> int:
+        """Scan length per dispatch.  A ragged epoch tail (steps %
+        steps_per_dispatch != 0) would compile a second scan program; when a
+        divisor of steps_per_epoch is at least half the requested size, use
+        it instead — one XLA program, no tail."""
+        want = max(1, self.cfg.steps_per_dispatch)
+        steps = self.train_iter.steps_per_epoch()
+        if steps <= 0 or steps % want == 0:
+            return min(want, max(steps, 1))
+        best = max((d for d in range(1, want + 1) if steps % d == 0),
+                   default=1)
+        return best if best >= (want + 1) // 2 else want
+
+    def _train_epoch_device(self, epoch: int, lr: float) -> None:
+        """One epoch on the device-resident path: the training set lives in
+        HBM and each dispatch scans ``steps_per_dispatch`` fused train steps
+        (gather included) as one XLA computation.  Identical numerics to
+        :meth:`_train_epoch` (same index plan, same step body); metric
+        windows flush on dispatch boundaries, so the effective cadence is
+        ``log_every_steps`` rounded up to a dispatch multiple."""
+        if self._device_data is None:
+            self._device_data = DeviceDataset(self.train_iter.source,
+                                              self.mesh_plan)
+            self._scan_step = make_scan_train_step(self.spec, self.mesh_plan)
+            print(f"[device-data] training set resident on device: "
+                  f"n={self._device_data.n}, "
+                  f"{self._device_data.nbytes / 2**20:.1f} MiB, "
+                  f"{self._dispatch_k()} steps/dispatch")
+        idx, weight = self.train_iter.epoch_index_plan(epoch)
+        steps = idx.shape[0]
+        dispatch_k = self._dispatch_k()
+        window: Dict[str, Any] = {}
+        t0 = time.perf_counter()
+        lr_arr = np.float32(lr)
+        done = last_flush = 0
+        while done < steps and not self._preempted:
+            k = min(dispatch_k, steps - done)
+            self.state, stacked = self._scan_step(
+                self.state, self._device_data.data,
+                idx[done:done + k], weight[done:done + k], lr_arr)
+            # Per-step sums arrive stacked [k]; fold into the window without
+            # forcing a host sync.
+            for key, v in stacked.items():
+                window[key] = window.get(key, 0.0) + v.sum()
+            done += k
+            if done - last_flush >= self.cfg.log_every_steps:
+                self._flush_window(epoch, done - 1, window,
+                                   time.perf_counter() - t0)
+                window = {}
+                last_flush = done
+                t0 = time.perf_counter()
+        if window:
+            self._flush_window(epoch, done - 1, window,
+                               time.perf_counter() - t0)
+        if not self._preempted:
+            self.state = self.state.replace(epoch=self.state.epoch + 1)
+
     def _train_epoch(self, epoch: int, lr: float) -> None:
+        if self._use_device_data():
+            self._train_epoch_device(epoch, lr)
+            return
         window: Dict[str, float] = {}
         t0 = time.perf_counter()
         lr_arr = np.float32(lr)

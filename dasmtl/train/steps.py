@@ -76,37 +76,89 @@ def make_train_step(spec: ModelSpec, mesh_plan=None,
 
     def train_step(state: TrainState, batch: Batch,
                    lr: jax.Array) -> Tuple[TrainState, Dict[str, jax.Array]]:
-        step_rng = jax.random.fold_in(state.rng, state.step)
-
-        def loss_fn(params):
-            variables = {"params": params, "batch_stats": state.batch_stats}
-            rngs = {"dropout": step_rng} if spec.uses_dropout else None
-            outputs, mutated = state.apply_fn(
-                variables, batch["x"], train=True, mutable=["batch_stats"],
-                rngs=rngs)
-            loss, parts = spec.loss_fn(outputs, batch)
-            return loss, (parts, mutated["batch_stats"], outputs)
-
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (loss, (parts, new_batch_stats, outputs)), grads = grad_fn(state.params)
-        new_state = state.apply_updates(grads, lr).replace(
-            batch_stats=new_batch_stats)
-
-        preds = spec.decode(outputs)
-        labels = _batch_labels(batch)
-        weight = batch["weight"]
-        n = weight.sum()
-        # spec.loss_fn returns weighted means; convert to weighted sums
-        # (* n) so ragged final batches aggregate exactly on the host.
-        metrics = {"loss_sum": loss * n, "count": n}
-        for task in preds:
-            metrics[f"correct_{task}"] = _weighted_correct(
-                preds[task], labels[task], weight)
-        for k, v in parts.items():
-            metrics[f"loss_sum_{k}"] = v * n
-        return new_state, metrics
+        return _step_body(spec, state, batch, lr)
 
     return jax.jit(train_step, donate_argnums=(0,))
+
+
+def _step_body(spec: ModelSpec, state: TrainState, batch: Batch,
+               lr: jax.Array) -> Tuple[TrainState, Dict[str, jax.Array]]:
+    """One train step: forward + loss + backward + coupled-Adam update +
+    BN-stat update + prediction decode.  Shared by the per-step jit and the
+    scan-fused device-data path (identical trace → identical numerics)."""
+    step_rng = jax.random.fold_in(state.rng, state.step)
+
+    def loss_fn(params):
+        variables = {"params": params, "batch_stats": state.batch_stats}
+        rngs = {"dropout": step_rng} if spec.uses_dropout else None
+        outputs, mutated = state.apply_fn(
+            variables, batch["x"], train=True, mutable=["batch_stats"],
+            rngs=rngs)
+        loss, parts = spec.loss_fn(outputs, batch)
+        return loss, (parts, mutated["batch_stats"], outputs)
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    (loss, (parts, new_batch_stats, outputs)), grads = grad_fn(state.params)
+    new_state = state.apply_updates(grads, lr).replace(
+        batch_stats=new_batch_stats)
+
+    preds = spec.decode(outputs)
+    labels = _batch_labels(batch)
+    weight = batch["weight"]
+    n = weight.sum()
+    # spec.loss_fn returns weighted means; convert to weighted sums
+    # (* n) so ragged final batches aggregate exactly on the host.
+    metrics = {"loss_sum": loss * n, "count": n}
+    for task in preds:
+        metrics[f"correct_{task}"] = _weighted_correct(
+            preds[task], labels[task], weight)
+    for k, v in parts.items():
+        metrics[f"loss_sum_{k}"] = v * n
+    return new_state, metrics
+
+
+def make_scan_train_step(spec: ModelSpec, mesh_plan=None):
+    """Returns ``scan_step(state, data, idx, weight, lr) -> (state, stacked)``
+    — the device-resident fast path.
+
+    ``data`` is the whole training set living in HBM (``x [N,H,W,1]``,
+    ``distance [N]``, ``event [N]``); ``idx``/``weight`` are ``[K, B]`` batch
+    index/validity plans (:meth:`~dasmtl.data.pipeline.BatchIterator.
+    epoch_index_plan`).  One dispatch runs ``K`` complete train steps as a
+    single XLA computation via ``lax.scan`` — batch gather included — so the
+    host does no per-step work at all.  The reference pays a host->device copy
+    and a Python dispatch every step (utils.py:350-353).
+
+    Per-step metric sums come back stacked along a leading ``[K]`` axis, so
+    host-side windowing aggregates exactly as on the per-step path.  Padded
+    rows (``weight`` 0) are zeroed after the gather, making the computation
+    bit-identical to the host pipeline's zero-padded batches.
+    """
+    sharding = None
+    if mesh_plan is not None and mesh_plan.n_devices > 1:
+        from dasmtl.parallel.mesh import batch_sharding
+
+        sharding = batch_sharding(mesh_plan)
+
+    def scan_step(state: TrainState, data: Dict[str, jax.Array],
+                  idx: jax.Array, weight: jax.Array, lr: jax.Array):
+        def body(state, plan):
+            idx_k, w_k = plan
+            batch = {
+                "x": jnp.take(data["x"], idx_k, axis=0)
+                * w_k[:, None, None, None],
+                "distance": jnp.take(data["distance"], idx_k, axis=0),
+                "event": jnp.take(data["event"], idx_k, axis=0),
+                "weight": w_k,
+            }
+            if sharding is not None:
+                batch = {k: jax.lax.with_sharding_constraint(v, sharding[k])
+                         for k, v in batch.items()}
+            return _step_body(spec, state, batch, lr)
+
+        return jax.lax.scan(body, state, (idx, weight))
+
+    return jax.jit(scan_step, donate_argnums=(0,))
 
 
 def _make_per_replica_train_step(spec: ModelSpec, mesh_plan):

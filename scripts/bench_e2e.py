@@ -1,0 +1,101 @@
+"""End-to-end training throughput: the full Trainer epoch loop, data path
+included — host pipeline (gather + H2D + per-step dispatch, with prefetch)
+vs the device-resident scan path (dataset in HBM, fused multi-step
+dispatches).
+
+``bench.py`` measures the pure jitted step; this measures what a user's
+training run actually sustains, i.e. the number the reference's synchronous
+loader + eager loop (utils.py:152-156, 346-374) should be compared against.
+
+Run:  python scripts/bench_e2e.py [--n 4096] [--batch 256] [--dtype bfloat16]
+Emits one JSON line per path on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=4096,
+                    help="synthetic training examples")
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--dtype", type=str, default="bfloat16")
+    ap.add_argument("--epochs", type=int, default=3,
+                    help="timed epochs exclude the first (compile) epoch")
+    ap.add_argument("--steps_per_dispatch", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from dasmtl.config import Config
+    from dasmtl.data.pipeline import BatchIterator
+    from dasmtl.data.sources import ArraySource
+    from dasmtl.main import build_state
+    from dasmtl.models.registry import get_model_spec
+    from dasmtl.train.loop import Trainer
+
+    backend = jax.default_backend()
+    print(f"backend={backend} device={jax.devices()[0].device_kind} "
+          f"n={args.n} batch={args.batch} dtype={args.dtype}",
+          file=sys.stderr)
+
+    rng = np.random.default_rng(0)
+    source = ArraySource(
+        rng.normal(size=(args.n, 100, 250, 1)).astype(np.float32),
+        rng.integers(0, 16, size=(args.n,)).astype(np.int32),
+        rng.integers(0, 2, size=(args.n,)).astype(np.int32))
+    val = ArraySource(source.x[:args.batch], source.distance[:args.batch],
+                      source.event[:args.batch])
+
+    for path, device_data in (("host", "off"), ("device", "on")):
+        cfg = Config(model="MTL", batch_size=args.batch,
+                     compute_dtype=args.dtype, device_data=device_data,
+                     steps_per_dispatch=args.steps_per_dispatch,
+                     ckpt_every_epochs=0, val_every=10**9,
+                     log_every_steps=10**9)
+        spec = get_model_spec(cfg.model)
+        state = build_state(cfg, spec)
+        it = BatchIterator(source, cfg.batch_size, seed=cfg.seed,
+                           drop_last=True)
+        with tempfile.TemporaryDirectory() as run_dir:
+            trainer = Trainer(cfg, spec, state, it, val, run_dir)
+            epoch_s = []
+            with contextlib.redirect_stdout(sys.stderr):  # keep stdout JSON
+                for epoch in range(args.epochs):
+                    t0 = time.perf_counter()
+                    trainer._train_epoch(epoch, cfg.lr)
+                    jax.block_until_ready(trainer.state.params)
+                    epoch_s.append(time.perf_counter() - t0)
+        steps = it.steps_per_epoch()
+        timed = epoch_s[1:] or epoch_s
+        samples_per_s = steps * args.batch * len(timed) / sum(timed)
+        print(json.dumps({
+            "metric": f"e2e_train_samples_per_s_{path}",
+            "path": path,
+            "value": round(samples_per_s, 2),
+            "unit": "samples/s",
+            "backend": backend,
+            "batch_size": args.batch,
+            "compute_dtype": args.dtype,
+            "n_examples": args.n,
+            "steps_per_epoch": steps,
+            "epoch_s": [round(t, 3) for t in epoch_s],
+        }))
+        print(f"{path}: {samples_per_s:,.0f} samples/s "
+              f"(epochs {[f'{t:.2f}s' for t in epoch_s]})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,187 @@
+"""Device-resident data path: the scan-fused train steps with on-device batch
+gather (dasmtl/data/device.py + make_scan_train_step) must reproduce the host
+pipeline's numerics exactly — same (seed, epoch) batch composition, same
+zero-padded ragged batch, same per-step metric sums — while eliminating the
+per-step host work the reference pays (utils.py:350-353)."""
+
+import jax
+import numpy as np
+import pytest
+
+from dasmtl.config import Config
+from dasmtl.data.device import DeviceDataset, resident_bytes
+from dasmtl.data.pipeline import BatchIterator
+from dasmtl.data.sources import ArraySource, DiskSource
+from dasmtl.main import build_state
+from dasmtl.models.registry import get_model_spec
+from dasmtl.parallel.mesh import create_mesh, replicated_sharding
+from dasmtl.train.steps import make_scan_train_step, make_train_step
+
+from tests.multihost_common import HW
+
+
+def _source(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return ArraySource(
+        rng.normal(size=(n,) + HW + (1,)).astype(np.float32),
+        rng.integers(0, 16, size=(n,)).astype(np.int32),
+        rng.integers(0, 2, size=(n,)).astype(np.int32))
+
+
+def _run_host_path(state, it, epochs, lr):
+    step = make_train_step(get_model_spec("MTL"))
+    sums = []
+    for epoch in range(epochs):
+        total = {}
+        for batch in it.epoch(epoch):
+            state, m = step(state, jax.device_put(batch), lr)
+            for k, v in m.items():
+                total[k] = total.get(k, 0.0) + float(v)
+        sums.append(total)
+    return state, sums
+
+
+def _run_device_path(state, it, epochs, lr, k_per_dispatch, mesh_plan=None):
+    dd = DeviceDataset(it.source, mesh_plan)
+    scan_step = make_scan_train_step(get_model_spec("MTL"), mesh_plan)
+    sums = []
+    for epoch in range(epochs):
+        idx, weight = it.epoch_index_plan(epoch)
+        total = {}
+        done = 0
+        while done < idx.shape[0]:
+            k = min(k_per_dispatch, idx.shape[0] - done)
+            state, stacked = scan_step(state, dd.data,
+                                       idx[done:done + k],
+                                       weight[done:done + k], lr)
+            for key, v in stacked.items():
+                total[key] = total.get(key, 0.0) + float(np.sum(v))
+            done += k
+        sums.append(total)
+    return state, sums
+
+
+@pytest.mark.parametrize("n", [16, 14])  # divisible and ragged-final-batch
+def test_scan_path_matches_per_step_path(n):
+    """Same index plan + same step body => same training trajectory.
+
+    Tolerances: the scan and the per-step jit are two different XLA programs,
+    so conv reduction order differs at fp-noise level; Adam's ``m/sqrt(v)``
+    amplifies that on near-zero gradient entries (the same inherent effect
+    test_parallel.py documents for sharded-vs-single layouts).  Forward-pass
+    metrics of epoch 0 are compared tightly; end-of-trajectory params to
+    within a few update-magnitudes (lr=1e-3)."""
+    cfg = Config(model="MTL", batch_size=4)
+    spec = get_model_spec(cfg.model)
+    lr = np.float32(1e-3)
+    it = BatchIterator(_source(n), cfg.batch_size, seed=7)
+
+    s_host, m_host = _run_host_path(
+        build_state(cfg, spec, input_hw=HW), it, 2, lr)
+    s_dev, m_dev = _run_device_path(
+        build_state(cfg, spec, input_hw=HW), it, 2, lr, k_per_dispatch=2)
+
+    assert int(jax.device_get(s_dev.step)) == int(jax.device_get(s_host.step))
+    for ma, mb in zip(m_host, m_dev):
+        assert set(ma) == set(mb)
+    # Identical example counts and (integer) correct counts per epoch.
+    for ma, mb in zip(m_host, m_dev):
+        assert ma["count"] == mb["count"]
+    # Epoch-0 losses: trajectories have barely diverged.
+    np.testing.assert_allclose(m_host[0]["loss_sum"], m_dev[0]["loss_sum"],
+                               rtol=1e-3)
+    # Bound: 8 steps x worst-case per-step |update| ~ lr on a sign-flipped
+    # near-zero-gradient entry => ~1e-2 drift ceiling at lr=1e-3.
+    for a, b in zip(jax.tree.leaves(jax.device_get(s_host.params)),
+                    jax.tree.leaves(jax.device_get(s_dev.params))):
+        np.testing.assert_allclose(a, b, atol=1e-2)
+
+
+def test_first_step_metrics_match_tightly():
+    """Fresh state, one step each way: the metrics come from the forward
+    pass *before* any update, so they must agree to fp-noise level."""
+    cfg = Config(model="MTL", batch_size=4)
+    spec = get_model_spec(cfg.model)
+    lr = np.float32(1e-3)
+    it = BatchIterator(_source(8), cfg.batch_size, seed=7)
+
+    state = build_state(cfg, spec, input_hw=HW)
+    batch = next(iter(it.epoch(0)))
+    _, m_host = make_train_step(spec)(state, jax.device_put(batch), lr)
+
+    state2 = build_state(cfg, spec, input_hw=HW)
+    dd = DeviceDataset(it.source)
+    idx, weight = it.epoch_index_plan(0)
+    _, stacked = make_scan_train_step(spec)(state2, dd.data, idx[:1],
+                                            weight[:1], lr)
+    for key in m_host:
+        np.testing.assert_allclose(float(m_host[key]),
+                                   float(np.sum(stacked[key])), rtol=1e-5)
+
+
+def test_epoch_index_plan_matches_epoch_batches():
+    it = BatchIterator(_source(14), 4, seed=3)
+    idx, weight = it.epoch_index_plan(5)
+    batches = list(it.epoch(5))
+    assert idx.shape == (4, 4) and weight.shape == (4, 4)
+    for s, batch in enumerate(batches):
+        n_real = int(weight[s].sum())
+        np.testing.assert_array_equal(
+            it.source.x[idx[s][:n_real]], batch["x"][:n_real])
+        np.testing.assert_array_equal(batch["weight"], weight[s])
+        # Host path zero-pads; device path zeroes via the weight mask.
+        assert not batch["x"][n_real:].any()
+
+
+def test_scan_path_under_mesh_matches_single_device():
+    cfg = Config(model="MTL", batch_size=8)
+    spec = get_model_spec(cfg.model)
+    lr = np.float32(1e-3)
+    it = BatchIterator(_source(16), cfg.batch_size, seed=11)
+
+    s_single, _ = _run_device_path(
+        build_state(cfg, spec, input_hw=HW), it, 1, lr, k_per_dispatch=2)
+
+    plan = create_mesh(dp=4, sp=2)
+    state = jax.device_put(build_state(cfg, spec, input_hw=HW),
+                           replicated_sharding(plan))
+    with plan.mesh:
+        s_mesh, _ = _run_device_path(state, it, 1, lr, k_per_dispatch=2,
+                                     mesh_plan=plan)
+
+    for a, b in zip(jax.tree.leaves(jax.device_get(s_single.params)),
+                    jax.tree.leaves(jax.device_get(s_mesh.params))):
+        np.testing.assert_allclose(a, b, atol=3e-3)  # 2 Adam steps of noise
+
+
+def test_resident_bytes_known_only_for_ram_sources():
+    src = _source(4)
+    assert resident_bytes(src) == src.x.nbytes
+    assert resident_bytes(
+        DiskSource([])) is None
+
+
+def test_trainer_uses_device_path_when_forced(tmp_path):
+    from dasmtl.train.loop import Trainer
+
+    cfg_kwargs = dict(model="MTL", batch_size=4, epoch_num=2, val_every=5,
+                      ckpt_every_epochs=0, log_every_steps=2,
+                      prefetch_batches=0)
+    spec = get_model_spec("MTL")
+    src_train, src_val = _source(12, seed=1), _source(8, seed=2)
+
+    def run(device_data, out):
+        cfg = Config(device_data=device_data, **cfg_kwargs)
+        state = build_state(cfg, spec, input_hw=HW)
+        it = BatchIterator(src_train, cfg.batch_size, seed=cfg.seed)
+        tr = Trainer(cfg, spec, state, it, src_val, str(tmp_path / out))
+        tr.fit()
+        return tr
+
+    tr_dev = run("on", "dev")
+    assert tr_dev._device_data is not None  # fast path actually engaged
+    tr_host = run("off", "host")
+    assert tr_host._device_data is None
+    for a, b in zip(jax.tree.leaves(jax.device_get(tr_dev.state.params)),
+                    jax.tree.leaves(jax.device_get(tr_host.state.params))):
+        np.testing.assert_allclose(a, b, atol=5e-3)
