@@ -107,6 +107,11 @@ class Config:
     device_data: str = "auto"  # auto | on | off
     device_data_budget_mb: int = 1024
     steps_per_dispatch: int = 8
+    # Train EVERY cross-validation fold simultaneously in one vmapped
+    # computation (scan over steps x vmap over folds, shared HBM-resident
+    # dataset) instead of the reference's five separate --fold_index runs
+    # (dataset_preparation.py:157-166).  Single-process only.
+    cv_parallel: bool = False
 
     # ---- run outputs (reference utils.py:100-116) ----
     output_savedir: str = "./runs"
@@ -135,6 +140,9 @@ class Config:
             raise ValueError(f"unknown device_data {self.device_data!r}")
         if self.steps_per_dispatch < 1:
             raise ValueError("steps_per_dispatch must be >= 1")
+        if self.cv_parallel and self.fold_index is not None:
+            raise ValueError("cv_parallel trains every fold at once; "
+                             "--fold_index selects a single fold — pick one")
 
     @property
     def decay_at_epoch0(self) -> bool:
@@ -224,6 +232,10 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
                    default=d.steps_per_dispatch,
                    help="train steps fused per dispatch on the device-data "
                         "path")
+    p.add_argument("--cv_parallel", action=argparse.BooleanOptionalAction,
+                   default=d.cv_parallel,
+                   help="train all 5 CV folds simultaneously in one vmapped "
+                        "computation (vs one --fold_index run per fold)")
     p.add_argument("--use_pallas", action=argparse.BooleanOptionalAction,
                    default=d.use_pallas)
     p.add_argument("--resume", action=argparse.BooleanOptionalAction,

@@ -108,3 +108,17 @@ class ArraySource(_SourceBase):
 
     def gather(self, indices: np.ndarray) -> np.ndarray:
         return self.x[indices]
+
+
+class SubsetSource(_SourceBase):
+    """A view of another source through an index map (e.g. one CV fold's
+    validation examples inside the full-dataset source)."""
+
+    def __init__(self, base: _SourceBase, indices: np.ndarray):
+        self.base = base
+        self.indices = np.asarray(indices, np.int64)
+        self.distance = np.asarray(base.distance)[self.indices]
+        self.event = np.asarray(base.event)[self.indices]
+
+    def gather(self, indices: np.ndarray) -> np.ndarray:
+        return self.base.gather(self.indices[np.asarray(indices)])

@@ -88,6 +88,47 @@ def build_splits(striking_dir: str, excavating_dir: str, *,
     return DatasetSplits(train=train, val=val)
 
 
+@dataclasses.dataclass
+class CVSplits:
+    """All folds at once over one shared example list (for the vmapped
+    parallel-CV trainer): ``examples[train_idx[f]]`` is fold ``f``'s train
+    set, exactly the files single-fold ``build_splits(fold_index=f)`` would
+    select (same per-category ``KFold(5, shuffle, random_state)``)."""
+    examples: List[Example]
+    train_idx: List["np.ndarray"]  # per fold, indices into examples
+    val_idx: List["np.ndarray"]
+
+
+def build_cv_splits(striking_dir: str, excavating_dir: str, *,
+                    random_state: int = 1, n_folds: int = 5,
+                    mat_keys: Sequence[str] = ("data",)) -> CVSplits:
+    """Every fold of the reference's 5-fold CV protocol
+    (dataset_preparation.py:157-166) in one structure, sharing one example
+    list so the folds can train against a single device-resident dataset."""
+    import numpy as np
+
+    examples: List[Example] = []
+    train_idx: List[List[int]] = [[] for _ in range(n_folds)]
+    val_idx: List[List[int]] = [[] for _ in range(n_folds)]
+    for event_id, dir_path in ((EVENT_STRIKING, striking_dir),
+                               (EVENT_EXCAVATING, excavating_dir)):
+        collector = DataCollector(dir_path, mat_keys)
+        for category in collector.get_all_categories():
+            files = collector.files_by_category[category]
+            distance = distance_label_from_category(category)
+            base = len(examples)
+            examples.extend(Example(f, distance, event_id) for f in files)
+            kf = KFold(n_splits=n_folds, shuffle=True,
+                       random_state=random_state)
+            for f, (tr, va) in enumerate(kf.split(list(files))):
+                train_idx[f].extend(base + i for i in tr)
+                val_idx[f].extend(base + i for i in va)
+    return CVSplits(
+        examples=examples,
+        train_idx=[np.asarray(ix, np.int64) for ix in train_idx],
+        val_idx=[np.asarray(ix, np.int64) for ix in val_idx])
+
+
 def export_manifest_csv(examples: Sequence[Example], path: str) -> None:
     """Name/label manifest, equivalent of ``get_name_label_csv``
     (reference dataset_preparation.py:275-297)."""

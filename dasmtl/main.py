@@ -115,6 +115,49 @@ def build_sources(cfg: Config, is_test: bool,
     return train_source, val_source
 
 
+def _run_cv_parallel(cfg: Config, spec, run_dir: str) -> ValidationResult:
+    """All 5 folds of the reference CV protocol in one vmapped run
+    (dasmtl/train/cv.py).  Returns fold 0's final validation result; the
+    cross-fold summary is printed and recorded in metrics.jsonl."""
+    from dasmtl.data.splits import build_cv_splits
+    from dasmtl.train.cv import CVTrainer
+
+    if jax.process_count() > 1:
+        raise ValueError("cv_parallel is single-process: every process "
+                         "would redundantly train all folds and race on the "
+                         "run dir; use one --fold_index run per host instead")
+    if cfg.sp != 1 or cfg.dp not in (-1, 1):
+        raise ValueError("cv_parallel parallelizes over the fold axis on one "
+                         "device; --dp/--sp meshes are not supported with it")
+    if cfg.dp == -1 and len(jax.devices()) > 1:
+        print(f"[cv] note: running on 1 of {len(jax.devices())} visible "
+              "devices (folds are the parallel axis)")
+    cv = build_cv_splits(cfg.trainval_set_striking,
+                         cfg.trainval_set_excavating,
+                         random_state=cfg.random_state,
+                         mat_keys=(cfg.mat_key,))
+    full_source = RamSource(cv.examples, key=cfg.mat_key,
+                            noise_snr_db=cfg.noise_snr_db,
+                            noise_seed=cfg.seed, show_progress=True)
+    print(f"cv examples: {len(full_source)} files, "
+          f"{len(cv.train_idx)} folds")
+    trainer = CVTrainer(cfg, spec, full_source, cv.train_idx, cv.val_idx,
+                        run_dir)
+    if cfg.resume:
+        resumed_run = trainer.try_resume(cfg.output_savedir)
+        if resumed_run is not None:
+            epoch = int(np.asarray(
+                jax.device_get(trainer.states.epoch)).max())
+            print(f"resumed all folds at epoch {epoch} from {resumed_run}")
+        else:
+            print(f"--resume: no complete CV checkpoint set under "
+                  f"{cfg.output_savedir}; starting fresh")
+    reports = trainer.fit()
+    plot_metric_lines(trainer.metrics_dir)
+    print(f"run dir: {run_dir}")
+    return reports[-1][0].result
+
+
 def main_process(cfg: Config, is_test: bool = False,
                  ) -> ValidationResult:
     """End-to-end run (train or eval), returning the final validation result."""
@@ -128,6 +171,11 @@ def main_process(cfg: Config, is_test: bool = False,
             f.write(cfg.to_json())
 
         spec = get_model_spec(cfg.model)
+        if cfg.cv_parallel:
+            if is_test:
+                raise ValueError("cv_parallel is a training mode; evaluate "
+                                 "individual fold checkpoints with test.py")
+            return _run_cv_parallel(cfg, spec, run_dir)
         plan = make_mesh_plan(cfg)
         if plan is not None:
             print(f"mesh: dp={plan.dp} sp={plan.sp} "

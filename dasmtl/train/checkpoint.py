@@ -156,6 +156,18 @@ def restore_weights(state: TrainState, path: str) -> TrainState:
                          batch_stats=payload["batch_stats"])
 
 
+def latest_step_path(run_dir: str) -> Optional[str]:
+    """Newest ``step_<n>`` checkpoint under one run (or fold) directory."""
+    ckpt_root = os.path.join(run_dir, "ckpts")
+    if not os.path.isdir(ckpt_root):
+        return None
+    steps = [int(m.group(1)) for m in
+             (_STEP_RE.match(n) for n in os.listdir(ckpt_root)) if m]
+    if not steps:
+        return None
+    return os.path.join(ckpt_root, f"step_{max(steps)}")
+
+
 def find_latest_checkpoint(savedir: str,
                            model: Optional[str] = None) -> Optional[str]:
     """The newest ``step_<n>`` checkpoint across every run dir under
@@ -171,14 +183,9 @@ def find_latest_checkpoint(savedir: str,
     for run_name in os.listdir(savedir):
         if model is not None and f"model_type={model} " not in run_name + " ":
             continue
-        ckpt_root = os.path.join(savedir, run_name, "ckpts")
-        if not os.path.isdir(ckpt_root):
+        path = latest_step_path(os.path.join(savedir, run_name))
+        if path is None:
             continue
-        steps = [int(m.group(1)) for m in
-                 (_STEP_RE.match(n) for n in os.listdir(ckpt_root)) if m]
-        if not steps:
-            continue
-        path = os.path.join(ckpt_root, f"step_{max(steps)}")
         mtime = os.path.getmtime(path)
         if mtime > best_mtime:
             best, best_mtime = path, mtime

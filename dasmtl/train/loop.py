@@ -56,6 +56,19 @@ from dasmtl.train.steps import (make_eval_step, make_scan_train_step,
                                 make_train_step)
 
 
+def dispatch_len(want: int, steps_per_epoch: int) -> int:
+    """Scan length per dispatch for the scan-fused paths.  A ragged epoch
+    tail (steps % want != 0) would compile a second scan program; when a
+    divisor of steps_per_epoch is at least half the requested size, use it
+    instead — one XLA program, no tail."""
+    want = max(1, want)
+    steps = steps_per_epoch
+    if steps <= 0 or steps % want == 0:
+        return min(want, max(steps, 1))
+    best = max((d for d in range(1, want + 1) if steps % d == 0), default=1)
+    return best if best >= (want + 1) // 2 else want
+
+
 class MetricLines:
     """Append-only named metric lines persisted as ``.npy`` (the reference's
     ``trainLossLine``/``testAccLine`` artifacts, utils.py:299-304,392-396)."""
@@ -263,17 +276,8 @@ class Trainer:
         return True
 
     def _dispatch_k(self) -> int:
-        """Scan length per dispatch.  A ragged epoch tail (steps %
-        steps_per_dispatch != 0) would compile a second scan program; when a
-        divisor of steps_per_epoch is at least half the requested size, use
-        it instead — one XLA program, no tail."""
-        want = max(1, self.cfg.steps_per_dispatch)
-        steps = self.train_iter.steps_per_epoch()
-        if steps <= 0 or steps % want == 0:
-            return min(want, max(steps, 1))
-        best = max((d for d in range(1, want + 1) if steps % d == 0),
-                   default=1)
-        return best if best >= (want + 1) // 2 else want
+        return dispatch_len(self.cfg.steps_per_dispatch,
+                            self.train_iter.steps_per_epoch())
 
     def _train_epoch_device(self, epoch: int, lr: float) -> None:
         """One epoch on the device-resident path: the training set lives in

@@ -161,6 +161,54 @@ def make_scan_train_step(spec: ModelSpec, mesh_plan=None):
     return jax.jit(scan_step, donate_argnums=(0,))
 
 
+def make_cv_scan_train_step(spec: ModelSpec):
+    """Returns ``cv_step(states, data, idx, weight, lr) -> (states, stacked)``
+    — every cross-validation fold trained simultaneously.
+
+    ``states`` is a fold-stacked TrainState (every array leaf has a leading
+    ``[F]`` axis); ``idx``/``weight`` are ``[K, F, B]`` per-fold batch plans
+    into the shared device-resident dataset ``data``.  Each dispatch runs
+    ``K`` steps of all ``F`` folds as ONE XLA computation
+    (``scan`` over steps, ``vmap`` over folds): the XLA program sees
+    batch-of-folds convolutions — arithmetic intensity F× a single run —
+    so small-model CV costs barely more wall-clock than one run.  The
+    reference protocol requires five separate command invocations
+    (train.py --fold_index 0..4; dataset_preparation.py:157-166).
+
+    Fold train-set sizes can differ by one example, so the shorter folds'
+    plans are padded with all-zero-weight steps; a padded step must be a
+    true no-op (coupled weight decay and BN/Adam state would otherwise
+    drift), so the fold keeps its previous state wholesale whenever a step
+    carries no real examples.
+    """
+
+    def one_fold(state: TrainState, data: Dict[str, jax.Array],
+                 idx_k: jax.Array, w_k: jax.Array, lr: jax.Array):
+        batch = {
+            "x": jnp.take(data["x"], idx_k, axis=0)
+            * w_k[:, None, None, None],
+            "distance": jnp.take(data["distance"], idx_k, axis=0),
+            "event": jnp.take(data["event"], idx_k, axis=0),
+            "weight": w_k,
+        }
+        new_state, metrics = _step_body(spec, state, batch, lr)
+        has_real = w_k.sum() > 0
+        new_state = jax.tree.map(
+            lambda new, old: jnp.where(has_real, new, old), new_state, state)
+        return new_state, metrics
+
+    def cv_step(states: TrainState, data: Dict[str, jax.Array],
+                idx: jax.Array, weight: jax.Array, lr: jax.Array):
+        def body(states, plan):
+            idx_k, w_k = plan  # [F, B]
+            return jax.vmap(one_fold, in_axes=(0, None, 0, 0, None))(
+                states, data, idx_k, w_k, lr)
+
+        return jax.lax.scan(body, states, (idx, weight))
+
+    return jax.jit(cv_step, donate_argnums=(0,))
+
+
 def _make_per_replica_train_step(spec: ModelSpec, mesh_plan):
     """The ``bn_sync="per_replica"`` step: shard_map over the ``dp`` axis so
     BatchNorm sees only the device-local batch shard, with explicit psum
@@ -221,26 +269,52 @@ def _make_per_replica_train_step(spec: ModelSpec, mesh_plan):
     return jax.jit(mapped, donate_argnums=(0,))
 
 
+def _eval_body(spec: ModelSpec, state: TrainState,
+               batch: Batch) -> Dict[str, Any]:
+    variables = {"params": state.params,
+                 "batch_stats": state.batch_stats}
+    outputs = state.apply_fn(variables, batch["x"], train=False)
+    loss, parts = spec.loss_fn(outputs, batch)
+    preds = spec.decode(outputs)
+    weight = batch["weight"]
+    n = weight.sum()
+    return {
+        "preds": preds,
+        "weight": weight,
+        "count": n,
+        # Convert mean losses back to weighted sums for exact host-side
+        # aggregation across ragged final batches.
+        "loss_sum": loss * n,
+        **{f"loss_sum_{k}": v * n for k, v in parts.items()},
+    }
+
+
 def make_eval_step(spec: ModelSpec):
     """Returns ``eval_step(state, batch) -> out`` with per-example predictions
     (for host-side confusion matrices) and weighted loss sums."""
 
     def eval_step(state: TrainState, batch: Batch) -> Dict[str, Any]:
-        variables = {"params": state.params,
-                     "batch_stats": state.batch_stats}
-        outputs = state.apply_fn(variables, batch["x"], train=False)
-        loss, parts = spec.loss_fn(outputs, batch)
-        preds = spec.decode(outputs)
-        weight = batch["weight"]
-        n = weight.sum()
-        return {
-            "preds": preds,
-            "weight": weight,
-            "count": n,
-            # Convert mean losses back to weighted sums for exact host-side
-            # aggregation across ragged final batches.
-            "loss_sum": loss * n,
-            **{f"loss_sum_{k}": v * n for k, v in parts.items()},
-        }
+        return _eval_body(spec, state, batch)
 
     return jax.jit(eval_step)
+
+
+def make_gather_eval_step(spec: ModelSpec):
+    """``eval(state, data, idx, weight) -> out`` — the eval analogue of the
+    device-resident train path: the batch is gathered from the HBM-resident
+    dataset inside the jitted computation, so validation over already-resident
+    data does no host gather or H2D copy (used per fold by the parallel-CV
+    trainer)."""
+
+    def eval_gather(state: TrainState, data: Dict[str, jax.Array],
+                    idx: jax.Array, weight: jax.Array) -> Dict[str, Any]:
+        batch = {
+            "x": jnp.take(data["x"], idx, axis=0)
+            * weight[:, None, None, None],
+            "distance": jnp.take(data["distance"], idx, axis=0),
+            "event": jnp.take(data["event"], idx, axis=0),
+            "weight": weight,
+        }
+        return _eval_body(spec, state, batch)
+
+    return jax.jit(eval_gather)

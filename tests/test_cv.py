@@ -1,0 +1,160 @@
+"""Parallel cross-validation (dasmtl/train/cv.py): fold-stacked vmapped
+training must reproduce per-fold single runs, pad unequal folds with true
+no-op steps, and select exactly the files the single-fold split engine
+selects (reference 5-fold protocol, dataset_preparation.py:157-166)."""
+
+import jax
+import numpy as np
+
+from dasmtl.config import Config
+from dasmtl.data.pipeline import BatchIterator
+from dasmtl.data.sources import ArraySource, SubsetSource
+from dasmtl.main import build_state
+from dasmtl.models.registry import get_model_spec
+from dasmtl.train.cv import CVTrainer, slice_state
+from dasmtl.train.steps import make_train_step
+
+from tests.multihost_common import HW
+
+
+def _full_source(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return ArraySource(
+        rng.normal(size=(n,) + HW + (1,)).astype(np.float32),
+        rng.integers(0, 16, size=(n,)).astype(np.int32),
+        rng.integers(0, 2, size=(n,)).astype(np.int32))
+
+
+def _single_fold_run(cfg, spec, full, train_idx, epochs, lr):
+    """The sequential single-fold reference: host-path per-step training
+    over the fold's subset with the same (seed, epoch) shuffle."""
+    state = build_state(cfg, spec, input_hw=HW)
+    it = BatchIterator(SubsetSource(full, train_idx), cfg.batch_size,
+                       seed=cfg.seed)
+    step = make_train_step(spec)
+    for epoch in range(epochs):
+        for batch in it.epoch(epoch):
+            state, _ = step(state, jax.device_put(batch), np.float32(lr))
+    return state
+
+
+def test_cv_folds_match_single_fold_runs(tmp_path):
+    cfg = Config(model="MTL", batch_size=4, epoch_num=1, seed=3)
+    spec = get_model_spec(cfg.model)
+    full = _full_source(16)
+    folds = [(np.arange(0, 8), np.arange(8, 16)),
+             (np.arange(8, 16), np.arange(0, 8))]
+
+    tr = CVTrainer(cfg, spec, full, [f[0] for f in folds],
+                   [f[1] for f in folds], str(tmp_path))
+    tr._train_epoch(0, 1e-3)
+
+    for f, (train_idx, _) in enumerate(folds):
+        want = _single_fold_run(cfg, spec, full, train_idx, 1, 1e-3)
+        got = slice_state(tr.states, f)
+        assert int(jax.device_get(got.step)) == int(jax.device_get(want.step))
+        for a, b in zip(jax.tree.leaves(jax.device_get(want.params)),
+                        jax.tree.leaves(jax.device_get(got.params))):
+            np.testing.assert_allclose(a, b, atol=5e-3)
+
+
+def test_cv_unequal_folds_pad_with_noop_steps(tmp_path):
+    """Shorter folds' padded plan steps must leave the fold's state (step
+    counter included) untouched — coupled weight decay would otherwise
+    drift the parameters on example-free steps."""
+    cfg = Config(model="MTL", batch_size=4, epoch_num=1, seed=0)
+    spec = get_model_spec(cfg.model)
+    full = _full_source(18)
+    folds = [(np.arange(0, 8), np.arange(8, 10)),    # 2 steps
+             (np.arange(6, 18), np.arange(0, 6))]    # 3 steps
+    tr = CVTrainer(cfg, spec, full, [f[0] for f in folds],
+                   [f[1] for f in folds], str(tmp_path))
+    assert tr.steps_per_epoch == 3
+    tr._train_epoch(0, 1e-3)
+    steps = np.asarray(jax.device_get(tr.states.step))
+    np.testing.assert_array_equal(steps, [2, 3])
+    # And the short fold still matches its own single run exactly.
+    want = _single_fold_run(cfg, spec, full, folds[0][0], 1, 1e-3)
+    for a, b in zip(
+            jax.tree.leaves(jax.device_get(want.params)),
+            jax.tree.leaves(jax.device_get(slice_state(tr.states, 0).params))):
+        np.testing.assert_allclose(a, b, atol=5e-3)
+
+
+def test_cv_validate_reports_and_summary(tmp_path, capsys):
+    cfg = Config(model="MTL", batch_size=4, epoch_num=1, seed=0)
+    spec = get_model_spec(cfg.model)
+    full = _full_source(16)
+    tr = CVTrainer(cfg, spec, full, [np.arange(0, 8), np.arange(8, 16)],
+                   [np.arange(8, 16), np.arange(0, 8)], str(tmp_path))
+    reports = tr.validate(0)
+    assert len(reports) == 2
+    for rep in reports:
+        assert 0.0 <= rep.result.primary_accuracy <= 1.0
+        assert "mae_m" in rep.result.reports["distance"]
+    out = capsys.readouterr().out
+    assert "cv summary" in out and "acc mean=" in out
+
+
+def test_cv_preempt_saves_and_resumes_all_folds(tmp_path):
+    """Preemption mid-CV saves every fold in lockstep; try_resume restores
+    the pack (epoch counter un-advanced, per-fold steps kept)."""
+    cfg = Config(model="MTL", batch_size=4, epoch_num=3, seed=0,
+                 val_every=100, steps_per_dispatch=2)
+    spec = get_model_spec(cfg.model)
+    full = _full_source(16)
+    folds = ([np.arange(0, 8), np.arange(8, 16)],
+             [np.arange(8, 16), np.arange(0, 8)])
+    savedir = tmp_path / "runs"
+    run_a = savedir / "2026-01-01 model_type=MTL is_test=False"
+    run_a.mkdir(parents=True)
+
+    tr = CVTrainer(cfg, spec, full, folds[0], folds[1], str(run_a))
+    orig = tr.cv_step
+
+    def preempt_after_dispatch(*args):
+        out = orig(*args)
+        tr.request_preempt()
+        return out
+
+    tr.cv_step = preempt_after_dispatch
+    tr.fit()
+    steps = np.asarray(jax.device_get(tr.states.step))
+    np.testing.assert_array_equal(steps, [2, 2])  # one dispatch of 2 steps
+    assert np.asarray(jax.device_get(tr.states.epoch)).max() == 0
+
+    run_b = savedir / "2026-01-02 model_type=MTL is_test=False"
+    run_b.mkdir(parents=True)
+    fresh = CVTrainer(cfg, spec, full, folds[0], folds[1], str(run_b))
+    resumed_from = fresh.try_resume(str(savedir))
+    assert resumed_from == str(run_a)
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(fresh.states.step)), [2, 2])
+    assert np.asarray(jax.device_get(fresh.states.epoch)).max() == 0
+    for a, b in zip(jax.tree.leaves(jax.device_get(tr.states.params)),
+                    jax.tree.leaves(jax.device_get(fresh.states.params))):
+        np.testing.assert_array_equal(a, b)  # bit-exact round trip
+
+
+def test_build_cv_splits_matches_single_fold_engine(tmp_path):
+    """build_cv_splits fold f == build_splits(fold_index=f), file for file."""
+    from dasmtl.data.splits import build_cv_splits, build_splits
+    from dasmtl.data.synthetic import make_synthetic_dataset
+
+    make_synthetic_dataset(str(tmp_path), files_per_category=5,
+                           num_categories=4, shape=(20, 24))
+    striking = str(tmp_path / "striking_train")
+    excavating = str(tmp_path / "excavating_train")
+    cv = build_cv_splits(striking, excavating, random_state=1)
+    assert len(cv.train_idx) == 5
+    for f in range(5):
+        single = build_splits(striking, excavating, random_state=1,
+                              fold_index=f)
+        got_train = {cv.examples[i].path for i in cv.train_idx[f]}
+        got_val = {cv.examples[i].path for i in cv.val_idx[f]}
+        assert got_train == {ex.path for ex in single.train}
+        assert got_val == {ex.path for ex in single.val}
+        # Fold labels survive the index mapping.
+        for i in cv.train_idx[f][:3]:
+            ex = cv.examples[i]
+            assert ex.distance >= 0 and ex.event in (0, 1)

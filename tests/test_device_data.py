@@ -161,6 +161,38 @@ def test_resident_bytes_known_only_for_ram_sources():
         DiskSource([])) is None
 
 
+def test_device_path_preempts_at_dispatch_boundary(tmp_path):
+    """A preemption request lands between dispatches: the epoch stops with
+    the steps already dispatched counted, and the epoch counter is NOT
+    advanced (resume re-runs it from the deterministic shuffle)."""
+    from dasmtl.train.loop import Trainer
+
+    cfg = Config(model="MTL", batch_size=4, epoch_num=5, val_every=100,
+                 ckpt_every_epochs=0, log_every_steps=100,
+                 prefetch_batches=0, device_data="on", steps_per_dispatch=2)
+    spec = get_model_spec("MTL")
+    state = build_state(cfg, spec, input_hw=HW)
+    it = BatchIterator(_source(16, seed=1), cfg.batch_size, seed=cfg.seed)
+    tr = Trainer(cfg, spec, state, it, _source(8, seed=2), str(tmp_path))
+
+    tr._train_epoch(0, 1e-3)  # builds the device path; 4 steps, 2 dispatches
+    assert int(jax.device_get(tr.state.epoch)) == 1
+    assert int(jax.device_get(tr.state.step)) == 4
+
+    orig = tr._scan_step
+
+    def preempt_after_dispatch(*args):
+        out = orig(*args)
+        tr.request_preempt()
+        return out
+
+    tr._scan_step = preempt_after_dispatch
+    tr._train_epoch(1, 1e-3)
+    # One dispatch (2 steps) ran, then the loop stopped; epoch not advanced.
+    assert int(jax.device_get(tr.state.step)) == 6
+    assert int(jax.device_get(tr.state.epoch)) == 1
+
+
 def test_trainer_uses_device_path_when_forced(tmp_path):
     from dasmtl.train.loop import Trainer
 
