@@ -126,23 +126,37 @@ def _run_cv_parallel(cfg: Config, spec, run_dir: str) -> ValidationResult:
         raise ValueError("cv_parallel is single-process: every process "
                          "would redundantly train all folds and race on the "
                          "run dir; use one --fold_index run per host instead")
-    if cfg.sp != 1 or cfg.dp not in (-1, 1):
-        raise ValueError("cv_parallel parallelizes over the fold axis on one "
-                         "device; --dp/--sp meshes are not supported with it")
-    if cfg.dp == -1 and len(jax.devices()) > 1:
-        print(f"[cv] note: running on 1 of {len(jax.devices())} visible "
-              "devices (folds are the parallel axis)")
+    if cfg.sp != 1:
+        raise ValueError("cv_parallel has no spatial axis; --sp is not "
+                         "supported with it")
     cv = build_cv_splits(cfg.trainval_set_striking,
                          cfg.trainval_set_excavating,
                          random_state=cfg.random_state,
                          mat_keys=(cfg.mat_key,))
+    n_folds = len(cv.train_idx)
+    # The fold axis is the parallel axis: with a mesh it shards fold-wise
+    # over devices (no cross-fold communication).  --dp -1 auto-sizes to the
+    # fold count when enough devices exist; otherwise single device.
+    n_dev = len(jax.devices())
+    if cfg.dp == -1:
+        # Largest fold-count divisor the host can serve (5 folds on >=5
+        # devices -> one fold per device; fewer devices -> partial sharding).
+        dp = max(d for d in range(1, min(n_folds, n_dev) + 1)
+                 if n_folds % d == 0)
+    else:
+        dp = cfg.dp
+    if dp < 1 or (dp > 1 and n_folds % dp != 0):
+        raise ValueError(f"cv_parallel shards the {n_folds}-fold axis; "
+                         f"--dp {dp} must be a positive divisor of it")
+    plan = create_mesh(dp=dp, sp=1) if dp > 1 else None
+    if plan is not None:
+        print(f"[cv] fold axis sharded over {dp} devices")
     full_source = RamSource(cv.examples, key=cfg.mat_key,
                             noise_snr_db=cfg.noise_snr_db,
                             noise_seed=cfg.seed, show_progress=True)
-    print(f"cv examples: {len(full_source)} files, "
-          f"{len(cv.train_idx)} folds")
+    print(f"cv examples: {len(full_source)} files, {n_folds} folds")
     trainer = CVTrainer(cfg, spec, full_source, cv.train_idx, cv.val_idx,
-                        run_dir)
+                        run_dir, mesh_plan=plan)
     if cfg.resume:
         resumed_run = trainer.try_resume(cfg.output_savedir)
         if resumed_run is not None:

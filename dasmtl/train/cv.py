@@ -86,7 +86,8 @@ class CVTrainer:
     def __init__(self, cfg: Config, spec: ModelSpec, full_source: _SourceBase,
                  train_idx: Sequence[np.ndarray],
                  val_idx: Sequence[np.ndarray], run_dir: str,
-                 states: Optional[Sequence[TrainState]] = None):
+                 states: Optional[Sequence[TrainState]] = None,
+                 mesh_plan=None):
         from dasmtl.main import build_state
 
         if len(train_idx) != len(val_idx) or not train_idx:
@@ -97,12 +98,19 @@ class CVTrainer:
         self.n_folds = len(train_idx)
         self.train_idx = [np.asarray(ix) for ix in train_idx]
         self.val_sources = [SubsetSource(full_source, ix) for ix in val_idx]
-        self.device_data = DeviceDataset(full_source)
+        # Folds are embarrassingly parallel (no cross-fold communication);
+        # with a mesh the fold axis shards over devices — F folds on F chips
+        # cost one run's wall-clock per chip.  The dataset copy replicates.
+        if mesh_plan is not None and self.n_folds % mesh_plan.dp != 0:
+            raise ValueError(f"fold axis ({self.n_folds}) must divide over "
+                             f"the mesh (dp={mesh_plan.dp})")
+        self.mesh_plan = mesh_plan
+        self.device_data = DeviceDataset(full_source, mesh_plan)
         if states is None:
             states = [build_state(cfg, spec) for _ in range(self.n_folds)]
         self._template = states[0]  # shapes/statics for checkpoint restore
-        self.states = jax.device_put(stack_states(states))
-        self.cv_step = make_cv_scan_train_step(spec)
+        self.states = self._place_states(stack_states(states))
+        self.cv_step = make_cv_scan_train_step(spec, mesh_plan)
         self.eval_step = make_gather_eval_step(spec)
         self.iters = [BatchIterator(_IndexSpace(len(ix)), cfg.batch_size,
                                     seed=cfg.seed)
@@ -122,6 +130,24 @@ class CVTrainer:
 
     def request_preempt(self) -> None:
         self._preempted = True
+
+    # -- placement -----------------------------------------------------------
+    def _place_states(self, packed: TrainState) -> TrainState:
+        if self.mesh_plan is None:
+            return jax.device_put(packed)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        fold_sharded = NamedSharding(self.mesh_plan.mesh, P("dp"))
+        return jax.tree.map(lambda a: jax.device_put(a, fold_sharded), packed)
+
+    def _place_plan(self, arr: np.ndarray):
+        """idx/weight plans are [K, F, B]: shard the fold axis."""
+        if self.mesh_plan is None:
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(
+            arr, NamedSharding(self.mesh_plan.mesh, P(None, "dp", None)))
 
     # -- epoch plans ---------------------------------------------------------
     def _epoch_plan(self, epoch: int):
@@ -231,7 +257,8 @@ class CVTrainer:
             k = min(k_step, idx.shape[0] - done)
             self.states, stacked = self.cv_step(
                 self.states, self.device_data.data,
-                idx[done:done + k], weight[done:done + k], lr_arr)
+                self._place_plan(idx[done:done + k]),
+                self._place_plan(weight[done:done + k]), lr_arr)
             for key, v in stacked.items():  # [k, F] sums
                 window[key] = window.get(key, 0.0) + v.sum(axis=0)
             done += k
@@ -274,7 +301,7 @@ class CVTrainer:
             return None
         restored = [self.fold_ckpts[f].restore(self._template, best_paths[f])
                     for f in range(self.n_folds)]
-        self.states = jax.device_put(stack_states(restored))
+        self.states = self._place_states(stack_states(restored))
         for f in range(self.n_folds):
             self.fold_ckpts[f].seed_best(best_metric_on_disk(
                 os.path.join(best_run, f"fold{f}")))

@@ -161,7 +161,7 @@ def make_scan_train_step(spec: ModelSpec, mesh_plan=None):
     return jax.jit(scan_step, donate_argnums=(0,))
 
 
-def make_cv_scan_train_step(spec: ModelSpec):
+def make_cv_scan_train_step(spec: ModelSpec, mesh_plan=None):
     """Returns ``cv_step(states, data, idx, weight, lr) -> (states, stacked)``
     — every cross-validation fold trained simultaneously.
 
@@ -180,6 +180,14 @@ def make_cv_scan_train_step(spec: ModelSpec):
     true no-op (coupled weight decay and BN/Adam state would otherwise
     drift), so the fold keeps its previous state wholesale whenever a step
     carries no real examples.
+
+    With a ``mesh_plan`` the fold axis shards over devices via ``shard_map``
+    (each device scans its local folds; the dataset is replicated, and folds
+    need no collectives at all).  GSPMD alone can't partition the vmapped
+    program: vmapping fold-stacked conv kernels lowers to grouped
+    convolutions with ``feature_group_count = F``, whose merged feature axis
+    the partitioner cannot split fold-wise for general F; ``shard_map``
+    sidesteps the issue by slicing the fold axis before tracing.
     """
 
     def one_fold(state: TrainState, data: Dict[str, jax.Array],
@@ -206,7 +214,15 @@ def make_cv_scan_train_step(spec: ModelSpec):
 
         return jax.lax.scan(body, states, (idx, weight))
 
-    return jax.jit(cv_step, donate_argnums=(0,))
+    if mesh_plan is None or mesh_plan.n_devices == 1:
+        return jax.jit(cv_step, donate_argnums=(0,))
+
+    mapped = jax.shard_map(
+        cv_step, mesh=mesh_plan.mesh,
+        in_specs=(P("dp"), P(), P(None, "dp"), P(None, "dp"), P()),
+        out_specs=(P("dp"), P(None, "dp")),
+        check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0,))
 
 
 def _make_per_replica_train_step(spec: ModelSpec, mesh_plan):
