@@ -149,6 +149,48 @@ def shard_windows(plan: WindowPlan, process_index: int,
     return start, min(start + per, plan.n_windows)
 
 
+def _batch_ranges(plan: WindowPlan, batch_size: int, process_index: int,
+                  process_count: int) -> Iterator[Tuple[int, int]]:
+    """``(first_index, n_real)`` per batch — THE lockstep protocol shared by
+    the host and resident batch generators.  Every process yields the SAME
+    number of ranges (``ceil(ceil(n_windows / process_count) / batch_size)``,
+    trailing all-padding ranges where a host's share runs short): unequal
+    batch counts would deadlock a multi-host SPMD run."""
+    start, stop = shard_windows(plan, process_index, process_count)
+    max_share = math.ceil(plan.n_windows / process_count)
+    n_batches = math.ceil(max_share / batch_size) if plan.n_windows else 0
+    for bi in range(n_batches):
+        b0 = start + bi * batch_size
+        yield b0, max(0, min(batch_size, stop - b0))
+
+
+def window_index_batches(plan: WindowPlan, batch_size: int,
+                         process_index: int = 0, process_count: int = 1,
+                         ) -> Iterator[dict]:
+    """The index-space view of :func:`window_batches` — same batches, same
+    lockstep protocol (shared ``_batch_ranges``), but no window
+    materialization: yields ``{"index": [B] int64, "origin": [B, 2] int32,
+    "weight": [B]}`` for the device-resident streaming path, where the
+    record already lives in HBM and windows are sliced out inside the jitted
+    computation.  Requires the record to be at least window-sized (edge
+    clamping guarantees full windows, weight 1.0); smaller records use the
+    host path's zero-padding."""
+    if (plan.record_shape[0] < plan.window[0]
+            or plan.record_shape[1] < plan.window[1]):
+        raise ValueError("record smaller than the window — use the host "
+                         "path (window_batches), which zero-pads")
+    for b0, n in _batch_ranges(plan, batch_size, process_index,
+                               process_count):
+        index = np.full((batch_size,), -1, np.int64)
+        origin = np.zeros((batch_size, 2), np.int32)
+        weight = np.zeros((batch_size,), np.float32)
+        for j in range(n):
+            index[j] = b0 + j
+            origin[j] = plan.origin(b0 + j)
+            weight[j] = 1.0
+        yield {"index": index, "origin": origin, "weight": weight}
+
+
 def window_batches(record: np.ndarray, batch_size: int,
                    plan: Optional[WindowPlan] = None,
                    process_index: int = 0, process_count: int = 1,
@@ -169,13 +211,9 @@ def window_batches(record: np.ndarray, batch_size: int,
     """
     if plan is None:
         plan = plan_windows(record.shape)
-    start, stop = shard_windows(plan, process_index, process_count)
-    max_share = math.ceil(plan.n_windows / process_count)
-    n_batches = math.ceil(max_share / batch_size) if plan.n_windows else 0
     h, w = plan.window
-    for bi in range(n_batches):
-        b0 = start + bi * batch_size
-        n = max(0, min(batch_size, stop - b0))
+    for b0, n in _batch_ranges(plan, batch_size, process_index,
+                               process_count):
         x = np.zeros((batch_size, h, w, 1), np.float32)
         weight = np.zeros((batch_size,), np.float32)
         index = np.full((batch_size,), -1, np.int64)

@@ -43,16 +43,27 @@ def stream_predict(record: np.ndarray, model_path: str, model: str = "MTL",
                    window: Optional[Tuple[int, int]] = None,
                    stride: Optional[Tuple[int, int]] = None,
                    out_csv: Optional[str] = None,
-                   process_index: int = 0, process_count: int = 1) -> list:
+                   process_index: int = 0, process_count: int = 1,
+                   resident: str = "auto") -> list:
     """Run the restored ``model`` over every window of ``record``.
 
     Returns the prediction rows (and writes ``out_csv`` when given).  Library
     entry — the CLI below is a thin wrapper.
+
+    ``resident`` ("auto"|"on"|"off") selects the device-resident path: the
+    record is placed in HBM once and each batch's windows are sliced out
+    *inside* the jitted computation (``vmap`` of ``dynamic_slice``), so the
+    steady-state stream moves only window origins host->device instead of
+    re-uploading every window's pixels (stride overlap re-uploads them
+    multiplied).  "auto" uses it on accelerator backends whenever the record
+    is at least window-sized; records smaller than the window keep the
+    zero-padding host path.
     """
     import jax
 
     from dasmtl.config import INPUT_HEIGHT, INPUT_WIDTH, Config
-    from dasmtl.data.windowing import plan_windows, window_batches
+    from dasmtl.data.windowing import (plan_windows, window_batches,
+                                       window_index_batches)
     from dasmtl.main import build_state
     from dasmtl.models.registry import get_model_spec
     from dasmtl.train.checkpoint import restore_weights
@@ -67,20 +78,50 @@ def stream_predict(record: np.ndarray, model_path: str, model: str = "MTL",
     plan = plan_windows(record.shape, window=window, stride=stride)
     variables = {"params": state.params, "batch_stats": state.batch_stats}
 
+    if resident not in ("auto", "on", "off"):
+        raise ValueError(f"unknown resident mode {resident!r}")
+    fits = (record.shape[0] >= window[0] and record.shape[1] >= window[1])
+    use_resident = fits and (
+        resident == "on"
+        or (resident == "auto" and jax.default_backend() != "cpu"))
+
     @jax.jit
     def forward(x):
         return spec.decode(state.apply_fn(variables, x, train=False))
+
+    if use_resident:
+        record_dev = jax.device_put(np.asarray(record, np.float32))
+        h, w = plan.window
+
+        @jax.jit
+        def forward_resident(origin):
+            def slice_one(o):
+                return jax.lax.dynamic_slice(record_dev, (o[0], o[1]),
+                                             (h, w))
+            xs = jax.vmap(slice_one)(origin)[..., None]
+            return spec.decode(state.apply_fn(variables, xs, train=False))
 
     tasks = [t for t, _ in spec.report_tasks]
     fieldnames = ["window_index", "channel_origin", "time_origin", "weight"]
     fieldnames += [f for f, t in (("pred_distance_m", "distance"),
                                   ("pred_event", "event")) if t in tasks]
 
+    if use_resident:
+        batches = window_index_batches(plan, batch_size,
+                                       process_index=process_index,
+                                       process_count=process_count)
+    else:
+        batches = window_batches(record, batch_size, plan=plan,
+                                 process_index=process_index,
+                                 process_count=process_count)
     rows = []
-    for batch in window_batches(record, batch_size, plan=plan,
-                                process_index=process_index,
-                                process_count=process_count):
-        preds = {k: np.asarray(v) for k, v in forward(batch["x"]).items()}
+    for batch in batches:
+        if use_resident:
+            preds = {k: np.asarray(v) for k, v in
+                     forward_resident(batch["origin"]).items()}
+        else:
+            preds = {k: np.asarray(v) for k, v in
+                     forward(batch["x"]).items()}
         for j, idx in enumerate(batch["index"]):
             if idx < 0:  # batch padding slot
                 continue
@@ -120,6 +161,10 @@ def main(argv=None) -> int:
     p.add_argument("--stride_channels", type=int, default=None)
     p.add_argument("--out", type=str, default=None,
                    help="output CSV (default: <record>.predictions.csv)")
+    p.add_argument("--resident", type=str, default="auto",
+                   choices=["auto", "on", "off"],
+                   help="keep the record in device memory and slice windows "
+                        "inside the jitted computation")
     p.add_argument("--device", type=str, default="auto",
                    choices=["tpu", "cpu", "auto"])
     args = p.parse_args(argv)
@@ -142,7 +187,7 @@ def main(argv=None) -> int:
     rows = stream_predict(
         np.asarray(record), args.model_path, model=args.model,
         batch_size=args.batch_size, stride=stride, out_csv=out_csv,
-        process_index=pi, process_count=pc)
+        process_index=pi, process_count=pc, resident=args.resident)
     print(f"streamed {len(rows)} windows from {record.shape} record "
           f"-> {shard_csv_path(out_csv, pi, pc)}")
     return 0

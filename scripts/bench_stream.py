@@ -1,0 +1,78 @@
+"""Streaming-inference throughput: windows/s over a long synthetic record,
+host path (per-batch window assembly + H2D) vs device-resident path
+(record in HBM, windows sliced in-graph) — the measurement behind
+``stream.py --resident``.
+
+Run:  python scripts/bench_stream.py [--time_samples 120000] [--batch 256]
+Emits one JSON line per path on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--time_samples", type=int, default=120_000,
+                    help="record length (time axis); 100 channels fixed")
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--stride_time", type=int, default=125,
+                    help="overlapping stride (window 250) — the case where "
+                         "the host path re-uploads pixels stride-fold")
+    args = ap.parse_args()
+
+    # stream_predict builds fresh jitted closures per call, so the warm-up
+    # call can only warm the *persistent* compilation cache — enable it.
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dasmtl_jax_cache")
+
+    import jax
+    import numpy as np
+
+    from dasmtl.data.windowing import plan_windows
+    from dasmtl.stream import stream_predict
+
+    backend = jax.default_backend()
+    rec = np.random.default_rng(0).normal(
+        size=(100, args.time_samples)).astype(np.float32)
+    plan = plan_windows(rec.shape, stride=(100, args.stride_time))
+    print(f"backend={backend} record={rec.shape} windows={plan.n_windows} "
+          f"batch={args.batch}", file=sys.stderr)
+
+    for path, resident in (("host", "off"), ("resident", "on")):
+        with contextlib.redirect_stdout(sys.stderr):
+            # Warm-up on the SAME record: the resident program bakes the
+            # record shape into the sliced computation, so a shorter warm-up
+            # record would compile a different executable.
+            stream_predict(rec, "", batch_size=args.batch,
+                           stride=(100, args.stride_time),
+                           resident=resident)
+            t0 = time.perf_counter()
+            rows = stream_predict(rec, "", batch_size=args.batch,
+                                  stride=(100, args.stride_time),
+                                  resident=resident)
+            elapsed = time.perf_counter() - t0
+        print(json.dumps({
+            "metric": f"stream_windows_per_s_{path}",
+            "path": path,
+            "value": round(len(rows) / elapsed, 2),
+            "unit": "windows/s",
+            "backend": backend,
+            "batch_size": args.batch,
+            "n_windows": len(rows),
+            "elapsed_s": round(elapsed, 3),
+        }))
+        print(f"{path}: {len(rows) / elapsed:,.0f} windows/s",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
