@@ -152,10 +152,11 @@ def _run_cv_parallel(cfg: Config, spec, run_dir: str) -> ValidationResult:
     if plan is not None:
         print(f"[cv] fold axis sharded over {dp} devices")
     elif n_dev > 1:
-        # Prime fold counts on smaller hosts resolve to dp=1 — say so
-        # instead of silently idling the other chips.
+        # Say so instead of silently idling the other chips.
+        reason = ("--dp 1 requested" if cfg.dp == 1 else
+                  f"no divisor of {n_folds} folds fits {n_dev} devices")
         print(f"[cv] note: running on 1 of {n_dev} visible devices "
-              f"(no divisor of {n_folds} folds fits {n_dev} devices)")
+              f"({reason})")
     full_source = RamSource(cv.examples, key=cfg.mat_key,
                             noise_snr_db=cfg.noise_snr_db,
                             noise_seed=cfg.seed, show_progress=True)

@@ -85,43 +85,47 @@ def stream_predict(record: np.ndarray, model_path: str, model: str = "MTL",
         resident == "on"
         or (resident == "auto" and jax.default_backend() != "cpu"))
 
-    @jax.jit
-    def forward(x):
-        return spec.decode(state.apply_fn(variables, x, train=False))
-
     if use_resident:
-        record_dev = jax.device_put(np.asarray(record, np.float32))
+        # The record is a jit ARGUMENT (not a closed-over constant): the
+        # compiled program keys on shape/dtype, so streaming many same-shape
+        # records reuses one executable and the record isn't duplicated into
+        # the HLO as a literal.
         h, w = plan.window
 
         @jax.jit
-        def forward_resident(origin):
+        def forward_resident(rec, origin):
             def slice_one(o):
-                return jax.lax.dynamic_slice(record_dev, (o[0], o[1]),
-                                             (h, w))
+                return jax.lax.dynamic_slice(rec, (o[0], o[1]), (h, w))
             xs = jax.vmap(slice_one)(origin)[..., None]
             return spec.decode(state.apply_fn(variables, xs, train=False))
+
+        record_dev = jax.device_put(np.asarray(record, np.float32))
+        batches = window_index_batches(plan, batch_size,
+                                       process_index=process_index,
+                                       process_count=process_count)
+
+        def run(batch):
+            return forward_resident(record_dev, batch["origin"])
+    else:
+        @jax.jit
+        def forward(x):
+            return spec.decode(state.apply_fn(variables, x, train=False))
+
+        batches = window_batches(record, batch_size, plan=plan,
+                                 process_index=process_index,
+                                 process_count=process_count)
+
+        def run(batch):
+            return forward(batch["x"])
 
     tasks = [t for t, _ in spec.report_tasks]
     fieldnames = ["window_index", "channel_origin", "time_origin", "weight"]
     fieldnames += [f for f, t in (("pred_distance_m", "distance"),
                                   ("pred_event", "event")) if t in tasks]
 
-    if use_resident:
-        batches = window_index_batches(plan, batch_size,
-                                       process_index=process_index,
-                                       process_count=process_count)
-    else:
-        batches = window_batches(record, batch_size, plan=plan,
-                                 process_index=process_index,
-                                 process_count=process_count)
     rows = []
     for batch in batches:
-        if use_resident:
-            preds = {k: np.asarray(v) for k, v in
-                     forward_resident(batch["origin"]).items()}
-        else:
-            preds = {k: np.asarray(v) for k, v in
-                     forward(batch["x"]).items()}
+        preds = {k: np.asarray(v) for k, v in run(batch).items()}
         for j, idx in enumerate(batch["index"]):
             if idx < 0:  # batch padding slot
                 continue

@@ -1,0 +1,129 @@
+"""Parallel-CV efficiency: wall-clock of training ALL folds in one vmapped
+computation vs one fold alone (the reference protocol's per-fold cost,
+which it pays five times sequentially).
+
+On a TPU the 1.1M-param model under-fills the MXU, so the fold-batched
+program should cost far less than F× a single run — that ratio is the
+headline number for --cv_parallel.  On a 1-core CPU the compute is serial
+and the ratio approaches F (no idle width to exploit); run this on the chip.
+
+Run:  python scripts/bench_cv.py [--n 640] [--batch 32] [--folds 5]
+Emits one JSON line on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=640)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--folds", type=int, default=5)
+    ap.add_argument("--dtype", type=str, default="bfloat16")
+    ap.add_argument("--epochs", type=int, default=3,
+                    help="timed epochs exclude the first (compile) epoch")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from dasmtl.config import Config
+    from dasmtl.data.pipeline import BatchIterator
+    from dasmtl.data.sources import ArraySource, SubsetSource
+    from dasmtl.data.device import DeviceDataset
+    from dasmtl.main import build_state
+    from dasmtl.models.registry import get_model_spec
+    from dasmtl.train.cv import CVTrainer
+    from dasmtl.train.steps import make_scan_train_step
+
+    backend = jax.default_backend()
+    rng = np.random.default_rng(0)
+    full = ArraySource(
+        rng.normal(size=(args.n, 100, 250, 1)).astype(np.float32),
+        rng.integers(0, 16, size=(args.n,)).astype(np.int32),
+        rng.integers(0, 2, size=(args.n,)).astype(np.int32))
+    per = args.n // args.folds
+    folds = [(np.setdiff1d(np.arange(args.n),
+                           np.arange(f * per, (f + 1) * per)),
+              np.arange(f * per, (f + 1) * per))
+             for f in range(args.folds)]
+    cfg = Config(model="MTL", batch_size=args.batch,
+                 compute_dtype=args.dtype, steps_per_dispatch=8)
+    spec = get_model_spec(cfg.model)
+    print(f"backend={backend} n={args.n} folds={args.folds} "
+          f"batch={args.batch} dtype={args.dtype}", file=sys.stderr)
+
+    def timed_epochs(run_epoch):
+        times = []
+        for epoch in range(args.epochs):
+            t0 = time.perf_counter()
+            run_epoch(epoch)
+            times.append(time.perf_counter() - t0)
+        return times[1:] or times
+
+    # Single fold (fold 0), device-resident scan path — one run's cost.
+    state = build_state(cfg, spec)
+    src0 = SubsetSource(full, folds[0][0])
+    it0 = BatchIterator(src0, cfg.batch_size, seed=cfg.seed)
+    dd0 = DeviceDataset(src0)
+    scan_step = make_scan_train_step(spec)
+    holder = {"state": state}
+
+    def single_epoch(epoch):
+        idx, weight = it0.epoch_index_plan(epoch)
+        done = 0
+        while done < idx.shape[0]:
+            k = min(cfg.steps_per_dispatch, idx.shape[0] - done)
+            holder["state"], _ = scan_step(
+                holder["state"], dd0.data, idx[done:done + k],
+                weight[done:done + k], np.float32(1e-3))
+            done += k
+        jax.block_until_ready(holder["state"].params)
+
+    single_s = timed_epochs(single_epoch)
+
+    # All folds at once.
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as run_dir, \
+            contextlib.redirect_stdout(sys.stderr):
+        tr = CVTrainer(cfg, spec, full, [f[0] for f in folds],
+                       [f[1] for f in folds], run_dir)
+
+        def cv_epoch(epoch):
+            tr._train_epoch(epoch, 1e-3)
+            jax.block_until_ready(tr.states.params)
+
+        cv_s = timed_epochs(cv_epoch)
+
+    single = sum(single_s) / len(single_s)
+    cv = sum(cv_s) / len(cv_s)
+    print(json.dumps({
+        "metric": "cv_parallel_epoch_cost_vs_single_fold",
+        "value": round(cv / single, 3),
+        "unit": f"x one fold's epoch ({args.folds} folds trained)",
+        "backend": backend,
+        "single_fold_epoch_s": round(single, 3),
+        "cv_epoch_s": round(cv, 3),
+        "sequential_equivalent_s": round(single * args.folds, 3),
+        "speedup_vs_sequential": round(single * args.folds / cv, 2),
+        "batch_size": args.batch,
+        "compute_dtype": args.dtype,
+    }))
+    print(f"one fold {single:.2f}s/epoch; {args.folds} folds vmapped "
+          f"{cv:.2f}s/epoch -> {single * args.folds / cv:.2f}x vs "
+          "sequential", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
