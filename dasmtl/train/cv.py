@@ -39,7 +39,8 @@ from dasmtl.models.registry import ModelSpec
 from dasmtl.train import metrics as host_metrics
 from dasmtl.train.checkpoint import (CheckpointManager, best_metric_on_disk,
                                      latest_step_path)
-from dasmtl.train.loop import MetricLines, ValidationResult, dispatch_len
+from dasmtl.train.loop import (MetricLines, ValidationResult, dispatch_len,
+                               resident_eval_outputs)
 from dasmtl.train.optim import stepped_lr
 from dasmtl.train.state import TrainState
 from dasmtl.train.steps import make_cv_scan_train_step, make_gather_eval_step
@@ -172,26 +173,19 @@ class CVTrainer:
         only the tiny index/weight plans cross the host boundary)."""
         state = slice_state(self.states, fold)
         source = self.val_sources[fold]
-        full_idx = source.indices  # fold-local -> full-dataset mapping
-        B = self.cfg.batch_size
         all_preds: Dict[str, List[np.ndarray]] = {}
         all_weight: List[np.ndarray] = []
         labels: Dict[str, List[np.ndarray]] = {"distance": [], "event": []}
         loss_sum = count = 0.0
-        for start in range(0, len(source), B):
-            chunk = full_idx[start:start + B]
-            idx = np.zeros((B,), np.int32)
-            weight = np.zeros((B,), np.float32)
-            idx[:chunk.shape[0]] = chunk
-            weight[:chunk.shape[0]] = 1.0
-            labels["distance"].append(source.distance[start:start + B])
-            labels["event"].append(source.event[start:start + B])
-            out = jax.device_get(self.eval_step(
-                state, self.device_data.data, idx, weight))
+        for batch_labels, out in resident_eval_outputs(
+                self.eval_step, state, self.device_data.data,
+                source.indices, source.distance, source.event,
+                self.cfg.batch_size):
+            for k in labels:
+                labels[k].append(batch_labels[k])
             for task, preds in out["preds"].items():
-                all_preds.setdefault(
-                    task, []).append(np.asarray(preds)[:chunk.shape[0]])
-            all_weight.append(np.asarray(out["weight"])[:chunk.shape[0]])
+                all_preds.setdefault(task, []).append(np.asarray(preds))
+            all_weight.append(np.asarray(out["weight"]))
             loss_sum += float(out["loss_sum"])
             count += float(out["count"])
         weight = np.concatenate(all_weight)

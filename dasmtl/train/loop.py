@@ -56,6 +56,30 @@ from dasmtl.train.steps import (make_eval_step, make_scan_train_step,
                                 make_train_step)
 
 
+def resident_eval_outputs(gather_eval_step, state, data, indices: np.ndarray,
+                          distance: np.ndarray, event: np.ndarray,
+                          batch_size: int):
+    """Evaluate a view of an HBM-resident dataset: yields
+    ``(labels_batch, out)`` per padded batch of ``indices``, with the jitted
+    gather-eval output trimmed back to the real rows.  Shared by
+    Trainer.validate's resident path and the parallel-CV per-fold
+    validation."""
+    n = indices.shape[0]
+    for start in range(0, n, batch_size):
+        chunk = np.asarray(indices[start:start + batch_size])
+        k = chunk.shape[0]
+        idx = np.zeros((batch_size,), np.int32)
+        idx[:k] = chunk
+        weight = np.zeros((batch_size,), np.float32)
+        weight[:k] = 1.0
+        out = jax.device_get(gather_eval_step(state, data, idx, weight))
+        out["preds"] = {t: np.asarray(p)[:k]
+                        for t, p in out["preds"].items()}
+        out["weight"] = np.asarray(out["weight"])[:k]
+        yield ({"distance": distance[start:start + k],
+                "event": event[start:start + k]}, out)
+
+
 def dispatch_len(want: int, steps_per_epoch: int) -> int:
     """Scan length per dispatch for the scan-fused paths.  A ragged epoch
     tail (steps % want != 0) would compile a second scan program; when a
@@ -116,7 +140,10 @@ class Trainer:
                                           bn_sync=cfg.bn_sync)
         # A caller evaluating the same spec repeatedly (e.g. the SNR
         # robustness sweep) passes one jitted eval step so XLA compiles the
-        # identical computation once, not per Trainer.
+        # identical computation once, not per Trainer.  An external step also
+        # pins validation to the host pipeline — a per-Trainer resident path
+        # would recompile per Trainer and defeat that sharing.
+        self._external_eval_step = eval_step is not None
         self.eval_step = eval_step or make_eval_step(spec)
         self.metrics_dir = os.path.join(run_dir, "metrics")
         self.lines = MetricLines(self.metrics_dir)
@@ -140,6 +167,9 @@ class Trainer:
         self._device_data: Optional[DeviceDataset] = None
         self._scan_step = None
         self._device_data_noticed = False  # once-per-run fallback notices
+        self._val_device: Optional[DeviceDataset] = None
+        self._gather_eval_step = None
+        self._val_device_noticed = False
 
     def request_preempt(self) -> None:
         """Ask the running ``fit`` to stop at the next safe point and write a
@@ -165,6 +195,61 @@ class Trainer:
             f.write(json.dumps(record) + "\n")
 
     # -- validation ----------------------------------------------------------
+    def _use_device_val(self) -> bool:
+        """Resident-validation eligibility: same idea as the train-side
+        device-data path (the val set is even smaller), but never when an
+        external shared eval step was supplied (a per-Trainer gather step
+        would recompile per Trainer and defeat that sharing), under a mesh
+        (eval batches shard over dp), or multi-process."""
+        cfg = self.cfg
+        if (cfg.device_data == "off" or self._external_eval_step
+                or self.mesh_plan is not None or jax.process_count() > 1):
+            return False
+        if self._val_device is not None:
+            return True
+        if cfg.device_data == "auto" and jax.default_backend() == "cpu":
+            return False
+        nbytes = resident_bytes(self.val_source)
+        if nbytes is None:
+            if cfg.device_data == "on" and not self._val_device_noticed:
+                self._val_device_noticed = True
+                print("[device-data] validation stays on the host pipeline "
+                      "(lazy val source)")
+            return False
+        # One budget covers BOTH resident sets: the train copy (if placed,
+        # or about to be) already consumes part of it.
+        train_bytes = (self._device_data.nbytes if self._device_data
+                       else (resident_bytes(self.train_iter.source) or 0))
+        if nbytes + train_bytes > cfg.device_data_budget_mb * 2**20:
+            if cfg.device_data == "on" and not self._val_device_noticed:
+                self._val_device_noticed = True
+                print("[device-data] validation stays on the host pipeline "
+                      "(train + val sets exceed device_data_budget_mb)")
+            return False
+        return True
+
+    def _eval_outputs(self):
+        """Yield ``(labels_batch, numpy out)`` per eval batch — from the
+        resident path (batch gathered on device from the HBM-resident val
+        set) or the host pipeline, trimmed to real rows either way."""
+        if self._use_device_val():
+            from dasmtl.train.steps import make_gather_eval_step
+
+            if self._val_device is None:
+                self._val_device = DeviceDataset(self.val_source)
+                self._gather_eval_step = make_gather_eval_step(self.spec)
+            yield from resident_eval_outputs(
+                self._gather_eval_step, self.state, self._val_device.data,
+                np.arange(len(self.val_source)), self.val_source.distance,
+                self.val_source.event, self.eval_batch_size)
+            return
+        for batch in prefetch(eval_batches(self.val_source,
+                                           self.eval_batch_size),
+                              depth=self.cfg.prefetch_batches):
+            out = jax.device_get(self.eval_step(self.state,
+                                                self._place(batch)))
+            yield {k: batch[k] for k in ("distance", "event")}, out
+
     def validate(self, epoch: int) -> ValidationResult:
         """One full pass over the validation source; host-side sklearn-grade
         metrics per task head (reference utils.py:253-322)."""
@@ -176,13 +261,9 @@ class Trainer:
         labels: Dict[str, List[np.ndarray]] = {"distance": [], "event": []}
         loss_sum, count = 0.0, 0.0
         part_sums: Dict[str, float] = {}
-        for batch in prefetch(eval_batches(self.val_source,
-                                           self.eval_batch_size),
-                              depth=self.cfg.prefetch_batches):
+        for batch_labels, out in self._eval_outputs():
             for k in labels:
-                labels[k].append(batch[k])
-            out = self.eval_step(self.state, self._place(batch))
-            out = jax.device_get(out)
+                labels[k].append(batch_labels[k])
             for task, preds in out["preds"].items():
                 all_preds.setdefault(task, []).append(np.asarray(preds))
             all_weight.append(np.asarray(out["weight"]))

@@ -217,3 +217,34 @@ def test_trainer_uses_device_path_when_forced(tmp_path):
     for a, b in zip(jax.tree.leaves(jax.device_get(tr_dev.state.params)),
                     jax.tree.leaves(jax.device_get(tr_host.state.params))):
         np.testing.assert_allclose(a, b, atol=5e-3)
+
+
+def test_resident_validation_matches_host_path(tmp_path):
+    """Trainer.validate through the HBM-resident val set must reproduce the
+    host pipeline's metrics exactly (same predictions, same aggregation)."""
+    from dasmtl.train.loop import Trainer
+
+    spec = get_model_spec("MTL")
+    src_train, src_val = _source(8, seed=1), _source(10, seed=2)
+
+    def run(device_data):
+        cfg = Config(model="MTL", batch_size=4, epoch_num=1, val_every=1,
+                     ckpt_every_epochs=0, prefetch_batches=0,
+                     device_data=device_data)
+        state = build_state(cfg, spec, input_hw=HW)
+        it = BatchIterator(src_train, cfg.batch_size, seed=cfg.seed)
+        tr = Trainer(cfg, spec, state, it, src_val,
+                     str(tmp_path / device_data))
+        return tr, tr.validate(0)
+
+    tr_dev, dev = run("on")
+    assert tr_dev._val_device is not None  # resident path engaged
+    tr_host, host = run("off")
+    assert tr_host._val_device is None
+    np.testing.assert_allclose(dev.loss, host.loss, rtol=1e-6)
+    for task in ("distance", "event"):
+        assert (dev.reports[task]["accuracy"]
+                == host.reports[task]["accuracy"])
+        np.testing.assert_array_equal(
+            dev.reports[task]["confusion_matrix"],
+            host.reports[task]["confusion_matrix"])
