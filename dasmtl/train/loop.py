@@ -52,8 +52,8 @@ from dasmtl.train import metrics as host_metrics
 from dasmtl.train.checkpoint import CheckpointManager
 from dasmtl.train.optim import stepped_lr
 from dasmtl.train.state import TrainState
-from dasmtl.train.steps import (make_eval_step, make_scan_train_step,
-                                make_train_step)
+from dasmtl.train.steps import (make_eval_step, make_gather_eval_step,
+                                make_scan_train_step, make_train_step)
 
 
 def resident_eval_outputs(gather_eval_step, state, data, indices: np.ndarray,
@@ -218,8 +218,19 @@ class Trainer:
             return False
         # One budget covers BOTH resident sets: the train copy (if placed,
         # or about to be) already consumes part of it.
-        train_bytes = (self._device_data.nbytes if self._device_data
-                       else (resident_bytes(self.train_iter.source) or 0))
+        if self._device_data is not None:
+            train_bytes = self._device_data.nbytes
+        else:
+            known = resident_bytes(self.train_iter.source)
+            if known is None and cfg.device_data == "on":
+                # A lazy train source WILL be force-gathered later at an
+                # unknown size — can't budget against it; keep val on host.
+                if not self._val_device_noticed:
+                    self._val_device_noticed = True
+                    print("[device-data] validation stays on the host "
+                          "pipeline (train-set residency size unknown)")
+                return False
+            train_bytes = known or 0
         if nbytes + train_bytes > cfg.device_data_budget_mb * 2**20:
             if cfg.device_data == "on" and not self._val_device_noticed:
                 self._val_device_noticed = True
@@ -230,11 +241,9 @@ class Trainer:
 
     def _eval_outputs(self):
         """Yield ``(labels_batch, numpy out)`` per eval batch — from the
-        resident path (batch gathered on device from the HBM-resident val
-        set) or the host pipeline, trimmed to real rows either way."""
+        resident path (trimmed to real rows) or the host pipeline (padded
+        rows kept; consumers must mask by ``weight > 0``)."""
         if self._use_device_val():
-            from dasmtl.train.steps import make_gather_eval_step
-
             if self._val_device is None:
                 self._val_device = DeviceDataset(self.val_source)
                 self._gather_eval_step = make_gather_eval_step(self.spec)

@@ -1,0 +1,107 @@
+"""Environment diagnostics — ``python -m dasmtl.utils.doctor``.
+
+One page answering "why is my run slow / on the wrong device / using the
+scipy fallback?": JAX backend and devices, mesh capability, native-loader
+status, the resolved defaults of the perf-relevant flags, and library
+versions.  The reference has no equivalent (its only device handling is a
+silent CUDA-absent downgrade, utils.py:119-120).
+
+``--json`` emits a single machine-readable line instead of the report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+
+
+def collect() -> dict:
+    import jax
+
+    info: dict = {"python": sys.version.split()[0]}
+    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint",
+                "numpy", "scipy", "sklearn"):
+        try:
+            m = importlib.import_module(mod)
+            info.setdefault("versions", {})[mod] = getattr(
+                m, "__version__", "?")
+        except Exception:  # noqa: BLE001 — a missing optional dep is data
+            info.setdefault("versions", {})[mod] = None
+
+    try:
+        devices = jax.devices()
+        info["backend"] = jax.default_backend()
+        info["devices"] = [str(d) for d in devices]
+        info["device_kind"] = devices[0].device_kind if devices else None
+        info["process_count"] = jax.process_count()
+    except Exception as exc:  # noqa: BLE001 — backend init can fail/stall
+        info["backend"] = None
+        info["backend_error"] = repr(exc)[:300]
+
+    env = {k: v for k, v in os.environ.items()
+           if k in ("JAX_PLATFORMS", "XLA_FLAGS",
+                    "JAX_COMPILATION_CACHE_DIR")}
+    info["env"] = env
+
+    from dasmtl.data import native
+
+    info["native_loader"] = {
+        "available": native.available(),
+        "library": getattr(native, "_lib", None) is not None and "loaded"
+        or ("build-failed" if getattr(native, "_build_failed", False)
+            else "not-loaded"),
+    }
+
+    from dasmtl.config import Config
+
+    d = Config()
+    info["perf_defaults"] = {
+        "compute_dtype": d.compute_dtype,
+        "device_data": d.device_data,
+        "steps_per_dispatch": d.steps_per_dispatch,
+        "prefetch_batches": d.prefetch_batches,
+        "use_pallas": d.use_pallas,
+        "bn_sync": d.bn_sync,
+    }
+    return info
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="dasmtl environment doctor")
+    ap.add_argument("--json", action="store_true",
+                    help="one machine-readable JSON line")
+    args = ap.parse_args(argv)
+    info = collect()
+    if args.json:
+        print(json.dumps(info))
+        return 0
+    print("dasmtl doctor")
+    print(f"  python {info['python']}")
+    for mod, ver in info.get("versions", {}).items():
+        print(f"  {mod:<18} {ver or 'MISSING'}")
+    if info.get("backend"):
+        print(f"  backend: {info['backend']} "
+              f"({len(info.get('devices', []))} device(s), "
+              f"kind={info.get('device_kind')}, "
+              f"processes={info.get('process_count')})")
+        for d in info.get("devices", []):
+            print(f"    {d}")
+    else:
+        print(f"  backend: UNAVAILABLE — {info.get('backend_error')}")
+    if info["env"]:
+        for k, v in info["env"].items():
+            print(f"  env {k}={v}")
+    nl = info["native_loader"]
+    print(f"  native MAT loader: "
+          f"{'available' if nl['available'] else 'scipy fallback'} "
+          f"({nl['library']})")
+    print("  perf defaults: " + ", ".join(
+        f"{k}={v}" for k, v in info["perf_defaults"].items()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

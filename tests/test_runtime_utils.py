@@ -65,3 +65,13 @@ def test_seed_best_none_is_noop(tmp_path):
     spec = get_model_spec(cfg.model)
     state = build_state(cfg, spec, input_hw=(52, 64))
     assert mgr.save_best(state, 0.5) is not None
+
+
+def test_doctor_collects_environment():
+    from dasmtl.utils.doctor import collect
+
+    info = collect()
+    assert info["backend"] == "cpu"
+    assert info["versions"]["jax"]
+    assert isinstance(info["native_loader"]["available"], bool)
+    assert info["perf_defaults"]["device_data"] == "auto"
