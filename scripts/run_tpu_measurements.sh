@@ -1,0 +1,15 @@
+#!/bin/sh
+# Serial TPU measurement chain — run when the chip is reachable
+# (probe first:  timeout 60 python -c "import jax; print(jax.devices())").
+# Never run these concurrently (single chip, exclusive claim, 1-core host)
+# and never SIGKILL them mid-claim; each emits JSON on stdout.
+set -ex
+mkdir -p artifacts
+python bench.py                 > artifacts/bench_r02_tpu.json   2> artifacts/bench_r02_tpu.log
+python bench.py --sweep         > artifacts/sweep_r02.json       2> artifacts/sweep_r02.log
+python bench.py --models        > artifacts/models_bench_r02.json 2> artifacts/models_bench_r02.log
+python scripts/bench_e2e.py     > artifacts/e2e_bench_r02.json   2> artifacts/e2e_bench_r02.log
+python scripts/bench_stream.py  > artifacts/stream_bench_r02.json 2> artifacts/stream_bench_r02.log
+python scripts/bench_cv.py      > artifacts/cv_bench_r02.json    2> artifacts/cv_bench_r02.log
+python scripts/capture_trace.py --out artifacts/trace_r02        2> artifacts/trace_r02.log
+echo "all TPU measurements recorded under artifacts/"
