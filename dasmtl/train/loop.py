@@ -402,14 +402,12 @@ class Trainer:
                 window[key] = window.get(key, 0.0) + v.sum()
             done += k
             if done - last_flush >= self.cfg.log_every_steps:
-                self._flush_window(epoch, done - 1, window,
-                                   time.perf_counter() - t0)
+                self._flush_window(epoch, done - 1, window, t0)
                 window = {}
                 last_flush = done
                 t0 = time.perf_counter()
         if window:
-            self._flush_window(epoch, done - 1, window,
-                               time.perf_counter() - t0)
+            self._flush_window(epoch, done - 1, window, t0)
         if not self._preempted:
             self.state = self.state.replace(epoch=self.state.epoch + 1)
 
@@ -432,23 +430,24 @@ class Trainer:
             for k, v in step_metrics.items():
                 window[k] = window.get(k, 0.0) + v
             if (i + 1) % self.cfg.log_every_steps == 0:
-                self._flush_window(epoch, i, window,
-                                   time.perf_counter() - t0)
+                self._flush_window(epoch, i, window, t0)
                 window = {}
                 t0 = time.perf_counter()
             if self._preempted:
                 break
         if window:
-            self._flush_window(epoch, last_step, window,
-                               time.perf_counter() - t0)
+            self._flush_window(epoch, last_step, window, t0)
         if not self._preempted:
             # A preempted (partial) epoch keeps its counter so resume re-runs
             # the epoch from its shuffle-deterministic start.
             self.state = self.state.replace(epoch=self.state.epoch + 1)
 
     def _flush_window(self, epoch: int, step_in_epoch: int,
-                      window: Dict[str, float], elapsed: float) -> None:
+                      window: Dict[str, float], t0: float) -> None:
+        # Sync BEFORE reading the clock: the dispatches are asynchronous, so
+        # measuring at call time would report enqueue rate, not compute rate.
         window = {k: float(jax.device_get(v)) for k, v in window.items()}
+        elapsed = time.perf_counter() - t0
         n = max(window.get("count", 0.0), 1.0)
         # Weighted mean over the window's real examples (exact even when the
         # window includes the padded final batch).
