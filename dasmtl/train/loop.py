@@ -122,6 +122,17 @@ class ValidationResult:
     def primary_accuracy(self) -> float:
         return self.reports[self.primary_task]["accuracy"]
 
+    def to_record(self) -> Dict[str, float]:
+        """Flat metric record (one JSON-able dict) — the shared schema of the
+        eval tools (scripts/robustness_eval.py, scripts/cv_eval.py)."""
+        rec: Dict[str, float] = {"loss": self.loss}
+        for task, rep in self.reports.items():
+            rec[f"acc_{task}"] = rep["accuracy"]
+            rec[f"weighted_f1_{task}"] = rep["weighted_f1"]
+            if "mae_m" in rep:
+                rec[f"mae_m_{task}"] = rep["mae_m"]
+        return rec
+
 
 class Trainer:
     """Generic epoch-loop engine driving jitted train/eval steps."""

@@ -1,0 +1,121 @@
+"""Evaluate every fold of a --cv_parallel run on the test trees.
+
+Completes the CV protocol the reference leaves manual: after
+``train.py --cv_parallel`` writes per-fold checkpoints
+(``<run>/fold<k>/ckpts``), this evaluates each fold's best (or latest)
+checkpoint on the held-out test trees and prints one JSON line per fold plus
+a cross-fold summary (mean/std per metric) — the numbers a CV paper table
+reports.  The reference requires five ``test.py`` invocations and hand
+aggregation.
+
+    python scripts/cv_eval.py --cv_dir <run dir> \
+        --test_set_striking ... --test_set_excavating ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def discover_folds(cv_dir: str):
+    """(fold_index, checkpoint_path) per fold, preferring ``ckpts/best``."""
+    from dasmtl.train.checkpoint import latest_step_path
+
+    folds = []
+    for name in sorted(os.listdir(cv_dir)):
+        m = re.fullmatch(r"fold(\d+)", name)
+        if not m:
+            continue
+        fold_dir = os.path.join(cv_dir, name)
+        best = os.path.join(fold_dir, "ckpts", "best")
+        path = best if os.path.isdir(best) else latest_step_path(fold_dir)
+        if path:
+            folds.append((int(m.group(1)), path))
+    return sorted(folds)
+
+
+def cv_eval(cfg, cv_dir: str, out_dir: str):
+    import numpy as np
+
+    from dasmtl.data.pipeline import BatchIterator
+    from dasmtl.main import build_sources, build_state
+    from dasmtl.models.registry import get_model_spec
+    from dasmtl.train.checkpoint import restore_weights
+    from dasmtl.train.loop import Trainer
+    from dasmtl.train.steps import make_eval_step
+
+    folds = discover_folds(cv_dir)
+    if not folds:
+        raise FileNotFoundError(f"no fold<k> checkpoints under {cv_dir}")
+    spec = get_model_spec(cfg.model)
+    state = build_state(cfg, spec)
+    eval_step = make_eval_step(spec)  # one compile serves every fold
+    _, test_source = build_sources(cfg, is_test=True)
+
+    records = []
+    for fold, ckpt in folds:
+        fold_state = restore_weights(state, ckpt)
+        run_dir = os.path.join(out_dir, f"fold{fold}")
+        os.makedirs(run_dir, exist_ok=True)
+        trainer = Trainer(cfg, spec, fold_state,
+                          BatchIterator(test_source, cfg.batch_size,
+                                        seed=cfg.seed),
+                          test_source, run_dir, eval_step=eval_step)
+        record = {"fold": fold, "checkpoint": ckpt,
+                  **trainer.test().to_record()}
+        records.append(record)
+        print(json.dumps(record))
+
+    summary = {"kind": "cv_summary", "n_folds": len(records)}
+    for key in records[0]:
+        if key in ("fold", "checkpoint", "kind"):
+            continue
+        vals = [r[key] for r in records]
+        summary[f"{key}_mean"] = round(float(np.mean(vals)), 6)
+        summary[f"{key}_std"] = round(float(np.std(vals)), 6)
+    print(json.dumps(summary))
+    with open(os.path.join(out_dir, "cv_eval.jsonl"), "w") as f:
+        for r in records + [summary]:
+            f.write(json.dumps(r) + "\n")
+    return records, summary
+
+
+def main(argv=None) -> int:
+    from dasmtl.config import Config
+
+    d = Config()
+    p = argparse.ArgumentParser(
+        description="evaluate every fold of a --cv_parallel run")
+    p.add_argument("--cv_dir", type=str, required=True,
+                   help="the cv_parallel run dir containing fold<k>/")
+    p.add_argument("--model", type=str, default="MTL")
+    p.add_argument("--test_set_striking", type=str,
+                   default=d.test_set_striking)
+    p.add_argument("--test_set_excavating", type=str,
+                   default=d.test_set_excavating)
+    p.add_argument("--batch_size", type=int, default=d.batch_size)
+    p.add_argument("--out_dir", type=str, default=None,
+                   help="default: <cv_dir>/cv_eval")
+    p.add_argument("--device", type=str, default="auto",
+                   choices=["tpu", "cpu", "auto"])
+    args = p.parse_args(argv)
+
+    from dasmtl.utils.platform import apply_device
+
+    apply_device(args.device)
+    cfg = Config(model=args.model, batch_size=args.batch_size,
+                 test_set_striking=args.test_set_striking,
+                 test_set_excavating=args.test_set_excavating)
+    out_dir = args.out_dir or os.path.join(args.cv_dir, "cv_eval")
+    cv_eval(cfg, args.cv_dir, out_dir)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,13 +48,7 @@ def robustness_sweep(cfg, snrs, out_dir):
                           BatchIterator(val_source, point_cfg.batch_size,
                                         seed=point_cfg.seed),
                           val_source, run_dir, eval_step=eval_step)
-        res = trainer.test()
-        record = {"snr_db": snr, "loss": res.loss}
-        for task, rep in res.reports.items():
-            record[f"acc_{task}"] = rep["accuracy"]
-            record[f"weighted_f1_{task}"] = rep["weighted_f1"]
-            if "mae_m" in rep:
-                record[f"mae_m_{task}"] = rep["mae_m"]
+        record = {"snr_db": snr, **trainer.test().to_record()}
         results.append(record)
         print(json.dumps(record))
     return results

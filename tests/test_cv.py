@@ -198,3 +198,25 @@ def test_build_cv_splits_matches_single_fold_engine(tmp_path):
         for i in cv.train_idx[f][:3]:
             ex = cv.examples[i]
             assert ex.distance >= 0 and ex.event in (0, 1)
+
+
+def test_cv_eval_discovers_fold_checkpoints(tmp_path):
+    """scripts/cv_eval.py fold discovery prefers ckpts/best, falls back to
+    the newest step, skips foldless dirs."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts"))
+    from cv_eval import discover_folds
+
+    run = tmp_path / "run"
+    (run / "fold0" / "ckpts" / "best").mkdir(parents=True)
+    (run / "fold0" / "ckpts" / "step_4").mkdir()
+    (run / "fold1" / "ckpts" / "step_2").mkdir(parents=True)
+    (run / "fold1" / "ckpts" / "step_10").mkdir()
+    (run / "metrics").mkdir()
+    (run / "fold2").mkdir()  # no ckpts -> skipped
+    folds = discover_folds(str(run))
+    assert [f for f, _ in folds] == [0, 1]
+    assert folds[0][1].endswith("best")
+    assert folds[1][1].endswith("step_10")
