@@ -17,7 +17,6 @@ from __future__ import annotations
 import functools
 
 import jax
-import jax.numpy as jnp
 
 
 def _gate_reference(mask_logits: jax.Array, features: jax.Array) -> jax.Array:

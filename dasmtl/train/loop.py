@@ -38,7 +38,7 @@ import json
 import os
 import signal
 import time
-from typing import Any, Dict, Iterable, List, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
