@@ -190,6 +190,22 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--lr_decay_factor", type=float, default=d.lr_decay_factor)
     p.add_argument("--lr_decay_every", type=int, default=d.lr_decay_every)
     p.add_argument("--val_every", type=int, default=d.val_every)
+    p.add_argument("--lr_decay_at_epoch0", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="decay LR at epoch 0 too (default: resolve by model — "
+                        "the reference's split behavior)")
+    p.add_argument("--ckpt_acc_gate", type=float, default=None,
+                   help="accuracy gate for best-checkpoint saves (default: "
+                        "0.98, or 0.95 for multi_classifier)")
+    p.add_argument("--ckpt_every_epochs", type=int, default=d.ckpt_every_epochs,
+                   help="unconditional periodic checkpoint cadence (0 off)")
+    p.add_argument("--ckpt_max_keep", type=int, default=d.ckpt_max_keep)
+    p.add_argument("--mat_key", type=str, default=d.mat_key,
+                   help=".mat variable name holding the sample matrix")
+    p.add_argument("--log_every_steps", type=int, default=d.log_every_steps)
+    p.add_argument("--debug_nans", action=argparse.BooleanOptionalAction,
+                   default=d.debug_nans,
+                   help="raise on the first NaN-producing op (jax_debug_nans)")
     p.add_argument("--random_state", type=int, default=d.random_state)
     p.add_argument("--fold_index", type=int, default=None,
                    help="5-fold CV fold; omit for the holdout split")

@@ -24,16 +24,35 @@ import numpy as np
 from dasmtl.data.sources import _SourceBase
 
 
+def unwrap_source(source: _SourceBase) -> _SourceBase:
+    """Peel view wrappers (``SubsetSource``) down to the storage-owning
+    source — the object whose gather semantics (RAM copy vs lazy load,
+    per-gather noise) decide device-residency eligibility."""
+    while True:
+        base = getattr(source, "base", None)
+        if base is None:
+            return source
+        source = base
+
+
 def resident_bytes(source: _SourceBase) -> Optional[int]:
     """Size of the source's sample array if known without loading it.
 
     RAM-backed sources (``RamSource``, ``ArraySource``) expose their
-    contiguous array; lazy ``DiskSource`` returns None — materializing it
-    just to measure would defeat its purpose, so ``device_data="auto"``
-    skips it (``"on"`` forces the load).
+    contiguous array; views over them (``SubsetSource``) cost their row
+    count times the base's per-row size.  Lazy ``DiskSource`` returns
+    None — materializing it just to measure would defeat its purpose, so
+    ``device_data="auto"`` skips it (``"on"`` forces the load).
     """
     x = getattr(source, "x", None)
-    return None if x is None else int(x.nbytes)
+    if x is not None:
+        return int(x.nbytes)
+    base = getattr(source, "base", None)
+    if base is not None and len(base) > 0:
+        base_bytes = resident_bytes(base)
+        if base_bytes is not None:
+            return (base_bytes // len(base)) * len(source)
+    return None
 
 
 class DeviceDataset:

@@ -110,8 +110,15 @@ def build_sources(cfg: Config, is_test: bool,
                   noise_seed=cfg.seed)
     if cfg.dataset_ram:
         kwargs["show_progress"] = True
-    train_source = src_cls(splits.train, **kwargs)
     val_source = src_cls(splits.val, **kwargs)
+    if is_test:
+        # Test mode puts every test file in BOTH lists (reference
+        # dataset_preparation.py:139-147 builds an unused train DataLoader
+        # the same way); aliasing skips a second full preload of the
+        # identical file set — the train source is never iterated in test
+        # mode.
+        return val_source, val_source
+    train_source = src_cls(splits.train, **kwargs)
     return train_source, val_source
 
 

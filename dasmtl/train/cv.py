@@ -32,7 +32,7 @@ import jax
 import numpy as np
 
 from dasmtl.config import Config, mixed_label
-from dasmtl.data.device import DeviceDataset
+from dasmtl.data.device import DeviceDataset, resident_bytes, unwrap_source
 from dasmtl.data.pipeline import BatchIterator
 from dasmtl.data.sources import SubsetSource, _SourceBase
 from dasmtl.models.registry import ModelSpec
@@ -106,6 +106,29 @@ class CVTrainer:
             raise ValueError(f"fold axis ({self.n_folds}) must divide over "
                              f"the mesh (dp={mesh_plan.dp})")
         self.mesh_plan = mesh_plan
+        # The vmapped-fold step gathers batches from a shared HBM-resident
+        # dataset — residency is structural here, not an optimization the
+        # device_data flags can disable.  Reject contradictory settings
+        # instead of silently ignoring them (round-2 advisory).
+        if cfg.device_data == "off":
+            raise ValueError(
+                "cv_parallel trains all folds against a device-resident "
+                "dataset; device_data='off' is incompatible — drop the flag "
+                "or run per-fold with --fold_index")
+        inner = unwrap_source(full_source)
+        if getattr(inner, "noise_snr_db", None) is not None and not hasattr(
+                inner, "x"):
+            raise ValueError(
+                "cv_parallel would freeze a lazy source's per-gather SNR "
+                "noise into one realization; preload it (dataset_ram) so "
+                "the noise is drawn once, as the single-run path requires")
+        known = resident_bytes(full_source)
+        if known is not None and known > cfg.device_data_budget_mb * 2**20:
+            print(f"[cv] dataset ({known / 2**20:.1f} MiB) exceeds "
+                  f"device_data_budget_mb={cfg.device_data_budget_mb}; "
+                  "cv_parallel keeps it resident anyway — raise the budget "
+                  "flag to silence this, or split folds across --fold_index "
+                  "runs if HBM overflows")
         self.device_data = DeviceDataset(full_source, mesh_plan)
         if states is None:
             states = [build_state(cfg, spec) for _ in range(self.n_folds)]
@@ -288,6 +311,8 @@ class CVTrainer:
                      for f in range(self.n_folds)]
             if any(p is None for p in paths):
                 continue  # not a complete CV run of this fold count
+            if not self._split_config_matches(run_dir):
+                continue
             mtime = max(os.path.getmtime(p) for p in paths)
             if mtime > best_mtime:
                 best_run, best_mtime, best_paths = run_dir, mtime, paths
@@ -300,6 +325,35 @@ class CVTrainer:
             self.fold_ckpts[f].seed_best(best_metric_on_disk(
                 os.path.join(best_run, f"fold{f}")))
         return best_run
+
+    # Config fields that determine fold membership and per-example content:
+    # resuming across a change in any of them would silently continue fold
+    # states against different fold splits (round-2 advisory).
+    _SPLIT_KEYS = ("random_state", "seed", "test_rate",
+                   "trainval_set_striking", "trainval_set_excavating",
+                   "mat_key", "noise_snr_db")
+
+    def _split_config_matches(self, run_dir: str) -> bool:
+        """True when the candidate run's saved ``config.json`` agrees with
+        this run on every split-defining field.  Runs without a config.json
+        (programmatic CVTrainer use) can't be validated and are accepted."""
+        cfg_path = os.path.join(run_dir, "config.json")
+        if not os.path.exists(cfg_path):
+            return True
+        try:
+            with open(cfg_path) as f:
+                saved = json.load(f)
+        except (OSError, ValueError):
+            return True
+        mismatched = {
+            k: (saved[k], getattr(self.cfg, k)) for k in self._SPLIT_KEYS
+            if k in saved and saved[k] != getattr(self.cfg, k)}
+        if mismatched:
+            print(f"[cv resume] skipping {run_dir}: split config differs "
+                  + " ".join(f"{k}={was!r}->{now!r}"
+                             for k, (was, now) in mismatched.items()))
+            return False
+        return True
 
     def _save_all_folds(self) -> None:
         for f in range(self.n_folds):
@@ -340,6 +394,12 @@ class CVTrainer:
                     print(f"[cv preempt] saved all folds at epoch {epoch}; "
                           "resume with --resume")
                     return all_reports
+                # Same periodic-checkpoint contract as Trainer.fit
+                # (loop.py): a hard crash mid-CV-run loses at most
+                # ckpt_every_epochs epochs, not the whole run.
+                if cfg.ckpt_every_epochs and (
+                        epoch + 1) % cfg.ckpt_every_epochs == 0:
+                    self._save_all_folds()
         finally:
             if handler_installed:
                 signal.signal(signal.SIGTERM,

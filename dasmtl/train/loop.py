@@ -44,7 +44,7 @@ import jax
 import numpy as np
 
 from dasmtl.config import Config, mixed_label
-from dasmtl.data.device import DeviceDataset, resident_bytes
+from dasmtl.data.device import DeviceDataset, resident_bytes, unwrap_source
 from dasmtl.data.pipeline import BatchIterator, eval_batches, prefetch
 from dasmtl.models.registry import ModelSpec
 from dasmtl.parallel.mesh import MeshPlan, shard_batch
@@ -315,14 +315,32 @@ class Trainer:
                                  f"confusion_matrix_{task}.npy"),
                     rep["confusion_matrix"])
             self.lines.append(f"val_acc_{task}", rep["accuracy"])
+            # Full per-validation bundle, matching the reference's verbosity
+            # (utils.py:297-322 there prints the confusion matrix, per-class
+            # F1 and weighted precision/recall for every task every pass).
             print(f"[val epoch {epoch}] task={task} "
                   f"acc={rep['accuracy']:.4f} "
-                  f"weighted_f1={rep['weighted_f1']:.4f}"
+                  f"weighted_f1={rep['weighted_f1']:.4f} "
+                  f"weighted_precision={rep['weighted_precision']:.4f} "
+                  f"weighted_recall={rep['weighted_recall']:.4f}"
                   + (f" mae={rep['mae_m']:.3f}m" if "mae_m" in rep else ""))
+            with np.printoptions(linewidth=200, threshold=np.inf):
+                print(f"[val epoch {epoch}] task={task} per_class_f1="
+                      + np.array2string(rep["per_class_f1"], precision=3))
+                print(f"[val epoch {epoch}] task={task} confusion_matrix=\n"
+                      + np.array2string(rep["confusion_matrix"]))
         self.lines.append("val_loss", loss)
         self._log_jsonl({
             "kind": "val", "epoch": epoch, "loss": loss,
             **{f"acc_{t}": r["accuracy"] for t, r in reports.items()},
+            **{f"weighted_{k}_{t}": r[f"weighted_{k}"]
+               for t, r in reports.items()
+               for k in ("f1", "precision", "recall")},
+            **{f"per_class_f1_{t}": [round(float(v), 6)
+                                     for v in r["per_class_f1"]]
+               for t, r in reports.items()},
+            **{f"mae_m_{t}": r["mae_m"] for t, r in reports.items()
+               if "mae_m" in r},
         })
         return ValidationResult(epoch=epoch, loss=loss, reports=reports,
                                 primary_task=self.primary_task)
@@ -359,7 +377,7 @@ class Trainer:
             # devices).  Multi-host keeps the per-host pipeline.
             return declined("multi-process run keeps the per-host input "
                             "pipeline")
-        source = self.train_iter.source
+        source = unwrap_source(self.train_iter.source)
         if getattr(source, "noise_snr_db", None) is not None and not hasattr(
                 source, "x"):
             # A lazy source with SNR noise redraws it at every gather; one
@@ -371,7 +389,7 @@ class Trainer:
         if cfg.device_data == "auto":
             if jax.default_backend() == "cpu":
                 return False
-            nbytes = resident_bytes(source)
+            nbytes = resident_bytes(self.train_iter.source)
             if nbytes is None or nbytes > cfg.device_data_budget_mb * 2**20:
                 return False
         return True

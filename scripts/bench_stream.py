@@ -19,6 +19,56 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def latency(iters: int = 200) -> int:
+    """Small-batch per-dispatch inference latency (p50/p99) — the
+    deployment-facing number for an online detector watching a live fiber:
+    how long one freshly arrived window (or a small group) takes through the
+    compiled forward.  The reference only gestures at this with commented-out
+    per-sample timers (utils.py:258,294 there).  One JSON line per batch size."""
+    import jax
+    import numpy as np
+
+    from dasmtl.config import Config
+    from dasmtl.main import build_state
+    from dasmtl.models.registry import get_model_spec
+
+    backend = jax.default_backend()
+    cfg = Config(model="MTL")
+    spec = get_model_spec(cfg.model)
+    state = build_state(cfg, spec)
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+
+    @jax.jit
+    def forward(x):
+        return spec.decode(state.apply_fn(variables, x, train=False))
+
+    rng = np.random.default_rng(0)
+    for bs in (1, 8):
+        x = jax.device_put(
+            rng.normal(size=(bs, 100, 250, 1)).astype(np.float32))
+        out = forward(x)  # compile
+        jax.block_until_ready(out)
+        times = np.empty(iters)
+        for i in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(forward(x))
+            times[i] = time.perf_counter() - t0
+        p50, p99 = np.percentile(times, [50, 99]) * 1e3
+        print(json.dumps({
+            "metric": f"stream_latency_ms_b{bs}",
+            "value": round(float(p50), 3),
+            "unit": "ms",
+            "p50_ms": round(float(p50), 3),
+            "p99_ms": round(float(p99), 3),
+            "backend": "tpu" if backend == "axon" else backend,
+            "batch_size": bs,
+            "iters": iters,
+        }))
+        print(f"latency b{bs}: p50={p50:.3f} ms p99={p99:.3f} ms",
+              file=sys.stderr)
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--time_samples", type=int, default=120_000,
@@ -27,11 +77,16 @@ def main() -> int:
     ap.add_argument("--stride_time", type=int, default=125,
                     help="overlapping stride (window 250) — the case where "
                          "the host path re-uploads pixels stride-fold")
+    ap.add_argument("--latency", action="store_true",
+                    help="measure batch-1/8 per-dispatch latency (p50/p99) "
+                         "instead of throughput")
     args = ap.parse_args()
 
     # stream_predict builds fresh jitted closures per call, so the warm-up
     # call can only warm the *persistent* compilation cache — enable it.
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dasmtl_jax_cache")
+    if args.latency:
+        return latency()
 
     import jax
     import numpy as np

@@ -4,12 +4,14 @@
 # Never run these concurrently (single chip, exclusive claim, 1-core host)
 # and never SIGKILL them mid-claim; each emits JSON on stdout.
 set -ex
+R="${DASMTL_ROUND:-r03}"
 mkdir -p artifacts
-python bench.py                 > artifacts/bench_r02_tpu.json   2> artifacts/bench_r02_tpu.log
-python bench.py --sweep         > artifacts/sweep_r02.json       2> artifacts/sweep_r02.log
-python bench.py --models        > artifacts/models_bench_r02.json 2> artifacts/models_bench_r02.log
-python scripts/bench_e2e.py     > artifacts/e2e_bench_r02.json   2> artifacts/e2e_bench_r02.log
-python scripts/bench_stream.py  > artifacts/stream_bench_r02.json 2> artifacts/stream_bench_r02.log
-python scripts/bench_cv.py      > artifacts/cv_bench_r02.json    2> artifacts/cv_bench_r02.log
-python scripts/capture_trace.py --out artifacts/trace_r02        2> artifacts/trace_r02.log
+python bench.py                 > "artifacts/bench_${R}_tpu.json"   2> "artifacts/bench_${R}_tpu.log"
+python bench.py --sweep         > "artifacts/sweep_${R}.json"       2> "artifacts/sweep_${R}.log"
+python bench.py --models        > "artifacts/models_bench_${R}.json" 2> "artifacts/models_bench_${R}.log"
+python scripts/bench_e2e.py     > "artifacts/e2e_bench_${R}.json"   2> "artifacts/e2e_bench_${R}.log"
+python scripts/bench_stream.py  > "artifacts/stream_bench_${R}.json" 2> "artifacts/stream_bench_${R}.log"
+python scripts/bench_stream.py --latency > "artifacts/latency_${R}.json" 2> "artifacts/latency_${R}.log"
+python scripts/bench_cv.py      > "artifacts/cv_bench_${R}.json"    2> "artifacts/cv_bench_${R}.log"
+python scripts/capture_trace.py --out "artifacts/trace_${R}"        2> "artifacts/trace_${R}.log"
 echo "all TPU measurements recorded under artifacts/"

@@ -176,6 +176,82 @@ def test_cv_preempt_saves_and_resumes_all_folds(tmp_path):
         np.testing.assert_array_equal(a, b)  # bit-exact round trip
 
 
+def test_cv_rejects_contradictory_device_data_flags(tmp_path):
+    """cv_parallel's resident dataset is structural: device_data='off' and
+    lazy per-gather-noise sources must be rejected, not silently ignored."""
+    import pytest
+
+    cfg = Config(model="MTL", batch_size=4, device_data="off")
+    spec = get_model_spec(cfg.model)
+    full = _full_source(8)
+    folds = ([np.arange(0, 4)], [np.arange(4, 8)])
+    with pytest.raises(ValueError, match="device_data"):
+        CVTrainer(cfg, spec, full, folds[0], folds[1], str(tmp_path))
+
+    class _LazyNoisy(ArraySource):
+        noise_snr_db = 10.0
+
+        def __init__(self, base):
+            self.base_arrays = base
+            self.distance = base.distance
+            self.event = base.event
+
+        def gather(self, indices):
+            return self.base_arrays.gather(indices)
+
+    cfg2 = Config(model="MTL", batch_size=4)
+    with pytest.raises(ValueError, match="noise"):
+        CVTrainer(cfg2, spec, _LazyNoisy(full), folds[0], folds[1],
+                  str(tmp_path))
+
+
+def test_cv_resume_skips_mismatched_split_config(tmp_path):
+    """try_resume must not continue fold states from a run whose saved
+    config disagrees on split-defining fields (round-2 advisory: a changed
+    random_state silently resumes against different fold memberships)."""
+    cfg = Config(model="MTL", batch_size=4, epoch_num=1, seed=0,
+                 val_every=100, random_state=1)
+    spec = get_model_spec(cfg.model)
+    full = _full_source(16)
+    folds = ([np.arange(0, 8), np.arange(8, 16)],
+             [np.arange(8, 16), np.arange(0, 8)])
+    savedir = tmp_path / "runs"
+    run_a = savedir / "2026-01-01 model_type=MTL is_test=False"
+    run_a.mkdir(parents=True)
+    tr = CVTrainer(cfg, spec, full, folds[0], folds[1], str(run_a))
+    tr._save_all_folds()
+    (run_a / "config.json").write_text(cfg.to_json())
+
+    run_b = savedir / "2026-01-02 model_type=MTL is_test=False"
+    run_b.mkdir(parents=True)
+    cfg2 = Config(model="MTL", batch_size=4, epoch_num=1, seed=0,
+                  val_every=100, random_state=2)  # different fold membership
+    fresh = CVTrainer(cfg2, spec, full, folds[0], folds[1], str(run_b))
+    assert fresh.try_resume(str(savedir)) is None
+    # Same split config resumes fine.
+    same = CVTrainer(cfg, spec, full, folds[0], folds[1], str(run_b))
+    assert same.try_resume(str(savedir)) == str(run_a)
+
+
+def test_cv_periodic_checkpoints_every_epoch(tmp_path):
+    """cfg.ckpt_every_epochs applies to CV runs too: a hard crash mid-run
+    loses at most that many epochs (round-2 advisory)."""
+    import os
+
+    cfg = Config(model="MTL", batch_size=4, epoch_num=2, seed=0,
+                 val_every=100, ckpt_every_epochs=1)
+    spec = get_model_spec(cfg.model)
+    full = _full_source(8)
+    tr = CVTrainer(cfg, spec, full, [np.arange(0, 4)], [np.arange(4, 8)],
+                   str(tmp_path))
+    tr.fit()
+    ckpts = [d for d in os.listdir(tmp_path / "fold0" / "ckpts")
+             if d.startswith("step_")]
+    # Periodic saves after epochs 0 and 1 plus the end-of-run save (the
+    # last two coincide at the same step, so >= 2 distinct step dirs).
+    assert len(ckpts) >= 2
+
+
 def test_build_cv_splits_matches_single_fold_engine(tmp_path):
     """build_cv_splits fold f == build_splits(fold_index=f), file for file."""
     from dasmtl.data.splits import build_cv_splits, build_splits

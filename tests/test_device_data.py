@@ -155,10 +155,17 @@ def test_scan_path_under_mesh_matches_single_device():
 
 
 def test_resident_bytes_known_only_for_ram_sources():
+    from dasmtl.data.sources import SubsetSource
+
     src = _source(4)
     assert resident_bytes(src) == src.x.nbytes
     assert resident_bytes(
         DiskSource([])) is None
+    # Views over RAM sources are sized through their base (round-2
+    # advisory: SubsetSource silently lost device_data="auto" eligibility).
+    half = SubsetSource(src, np.arange(2))
+    assert resident_bytes(half) == src.x.nbytes // 2
+    assert resident_bytes(SubsetSource(DiskSource([]), np.arange(0))) is None
 
 
 def test_device_path_preempts_at_dispatch_boundary(tmp_path):

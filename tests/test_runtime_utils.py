@@ -12,6 +12,42 @@ from dasmtl.train.checkpoint import (CheckpointManager, best_metric_on_disk,
 from dasmtl.utils.rundir import make_run_dir
 
 
+def test_every_config_field_has_a_cli_flag():
+    """The CLI must expose every Config knob (round-3 regression: fields
+    like ckpt_every_epochs existed in the dataclass but not in argparse,
+    so documented flags errored out).  Inspects the raw parser namespace —
+    parse_train_args returns a Config, whose vars() always holds every
+    field regardless of argparse coverage."""
+    import argparse
+    import dataclasses
+
+    from dasmtl.config import _add_shared_args
+
+    fields = {f.name for f in dataclasses.fields(Config)}
+    p = argparse.ArgumentParser()
+    _add_shared_args(p)
+    exposed = set(vars(p.parse_args([])).keys())
+    assert fields == exposed, (
+        f"CLI/Config drift: missing flags {fields - exposed}, "
+        f"unknown args {exposed - fields}")
+
+
+def test_cli_overrides_parse_to_config_values():
+    from dasmtl.config import parse_train_args
+
+    cfg = parse_train_args([
+        "--ckpt_every_epochs", "2", "--ckpt_acc_gate", "0.5",
+        "--mat_key", "sig", "--log_every_steps", "7", "--debug_nans",
+        "--lr_decay_at_epoch0", "--ckpt_max_keep", "9"])
+    assert cfg.ckpt_every_epochs == 2
+    assert cfg.acc_gate == 0.5
+    assert cfg.mat_key == "sig"
+    assert cfg.log_every_steps == 7
+    assert cfg.debug_nans is True
+    assert cfg.decay_at_epoch0 is True
+    assert cfg.ckpt_max_keep == 9
+
+
 def test_run_dirs_unique_within_same_second(tmp_path):
     paths = {make_run_dir(str(tmp_path), "MTL", False) for _ in range(5)}
     assert len(paths) == 5

@@ -66,6 +66,25 @@ def test_stream_predict_multi_host_shards_cover_once(tmp_path):
     assert os.path.exists(str(tmp_path / "pred.p1.csv"))
     assert not os.path.exists(out)
 
+    # The merge tool reassembles one window_index-ordered CSV.
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts"))
+    from merge_stream_shards import merge_shards
+
+    n = merge_shards(out)
+    assert n == len(single)
+    with open(out) as f:
+        merged = list(csv.DictReader(f))
+    assert [int(r["window_index"]) for r in merged] == \
+        sorted(r["window_index"] for r in single)
+    # Duplicate indices (mixed runs) are rejected.
+    import shutil
+    import pytest
+    shutil.copy(str(tmp_path / "pred.p0.csv"), str(tmp_path / "pred.p2.csv"))
+    with pytest.raises(ValueError, match="multiple shards"):
+        merge_shards(out)
+
 
 def test_stream_predict_empty_shard_writes_header(tmp_path):
     ckpt = _checkpointed_state(tmp_path)

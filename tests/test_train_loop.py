@@ -54,6 +54,15 @@ def test_fit_decreases_loss_and_writes_artifacts(tmp_path, tiny_arrays):
         records = [json.loads(l) for l in f]
     assert any(r["kind"] == "train" for r in records)
     assert any(r["kind"] == "val" for r in records)
+    # Every validation record carries the full reference-verbosity bundle
+    # (utils.py:297-322 there): weighted P/R/F1 + per-class F1 per task,
+    # plus the distance MAE.
+    val_rec = next(r for r in records if r["kind"] == "val")
+    for task, n_classes in (("distance", 16), ("event", 2)):
+        for k in ("f1", "precision", "recall"):
+            assert isinstance(val_rec[f"weighted_{k}_{task}"], float)
+        assert len(val_rec[f"per_class_f1_{task}"]) == n_classes
+    assert isinstance(val_rec["mae_m_distance"], float)
     # Distance report carries the MAE view.
     assert "mae_m" in results[-1].reports["distance"]
     # Periodic checkpoints were written.
