@@ -125,6 +125,28 @@ batch = {k: jax.make_array_from_process_local_data(shardings[k], v[half])
          for k, v in host.items()}
 
 train_step = make_train_step(spec)
+# Compile barrier.  Two reasons: (a) two simultaneous compiles of the SAME
+# program thrash the 1-core host and can't share the persistent compilation
+# cache, so process 1 waits for process 0's compile; (b) XLA's CPU
+# collectives (Gloo) give the cross-process rendezvous only ~30s at the
+# first execute ("GetKeyValue() timed out"), so BOTH processes must finish
+# compiling before EITHER starts executing — hence the two-way file
+# handshake rather than a one-way head start.
+import os as _os
+import time as _time
+
+def _wait_for(path, seconds=240):
+    deadline = _time.time() + seconds
+    while not _os.path.exists(path):
+        assert _time.time() < deadline, f"barrier timeout on {path}"
+        _time.sleep(0.1)
+
+_m0, _m1 = out_npz + ".compiled0", out_npz + ".compiled1"
+if pid == 1:
+    _wait_for(_m0)
+train_step.lower(state, batch, np.float32(1e-3)).compile()
+open(_m1 if pid else _m0, "w").close()
+_wait_for(_m0 if pid else _m1)
 # TWO steps: step-2's loss is computed on step-1's updated params, so a wrong
 # cross-process gradient/BN reduction shows up at ~1e-3 relative there, while
 # mere reduction-order noise stays ~1e-6 (first-step Adam amplifies input

@@ -90,10 +90,12 @@ def test_checkpoint_resume_bitexact(tmp_path, tiny_arrays):
 def test_best_checkpoint_gated(tmp_path, tiny_arrays):
     # With an impossible gate no best checkpoint is written; with gate 0 the
     # first validation writes one (reference gate semantics, utils.py:329).
-    tr = _mk_trainer(tmp_path, tiny_arrays, ckpt_acc_gate=2.0)
+    # One epoch suffices: the gate check runs at the epoch-0 validation.
+    tr = _mk_trainer(tmp_path, tiny_arrays, ckpt_acc_gate=2.0, epoch_num=1)
     tr.fit()
     assert not os.path.exists(os.path.join(tr.ckpt.root, "best"))
-    tr2 = _mk_trainer(tmp_path / "gated", tiny_arrays, ckpt_acc_gate=0.0)
+    tr2 = _mk_trainer(tmp_path / "gated", tiny_arrays, ckpt_acc_gate=0.0,
+                      epoch_num=1)
     tr2.fit()
     assert os.path.exists(os.path.join(tr2.ckpt.root, "best"))
 
