@@ -129,6 +129,8 @@ class ValidationResult:
         for task, rep in self.reports.items():
             rec[f"acc_{task}"] = rep["accuracy"]
             rec[f"weighted_f1_{task}"] = rep["weighted_f1"]
+            rec[f"weighted_precision_{task}"] = rep["weighted_precision"]
+            rec[f"weighted_recall_{task}"] = rep["weighted_recall"]
             if "mae_m" in rep:
                 rec[f"mae_m_{task}"] = rep["mae_m"]
         return rec

@@ -45,11 +45,23 @@ def device_planes(profile):
     return out
 
 
+def _op_lines(plane):
+    """The event lines to sum.  Device planes nest hierarchy lines whose
+    events ENCLOSE the op events ("XLA Modules" spans its child "XLA Ops"),
+    so summing every line double-counts busy time by an integer factor —
+    prefer the op-level lines when the plane has them; host planes (one
+    line per thread, non-overlapping) sum everything."""
+    lines = list(plane.lines)
+    ops = [ln for ln in lines if "ops" in (ln.name or "").lower()]
+    return ops or lines
+
+
 def summarize_plane(plane, steps: int, top: int):
     per_op = defaultdict(float)
     span_start, span_end = None, 0.0
     busy_ns = 0.0
-    for line in plane.lines:
+    used_lines = _op_lines(plane)
+    for line in used_lines:
         for ev in line.events:
             dur = float(ev.duration_ns)
             busy_ns += dur
@@ -66,6 +78,7 @@ def summarize_plane(plane, steps: int, top: int):
     ranked = sorted(per_op.items(), key=lambda kv: -kv[1])[:top]
     return {
         "plane": plane.name,
+        "lines_summed": [ln.name for ln in used_lines],
         "wall_ms": round(wall_ns / 1e6, 3),
         "busy_ms": round(busy_ns / 1e6, 3),
         "busy_fraction_of_wall": round(busy_ns / max(wall_ns, 1.0), 4),

@@ -1,9 +1,10 @@
 """Capture a jax.profiler trace of the jitted MTL train step.
 
-Produces the trace artifact VERDICT.md round-1 item 3 asks for: a real
+Produces the trace artifact the round verdicts ask for: a real
 device-level profile of the flagship training step (the reference's whole
 inner loop, utils.py:346-374, as one XLA computation).  Output goes to
-``artifacts/trace_r02/`` (TensorBoard-loadable).
+``artifacts/trace_<round>/`` (TensorBoard-loadable; summarize it with
+``scripts/analyze_trace.py``).
 
 Run:  python scripts/capture_trace.py [--batch 256] [--dtype bfloat16]
 """
@@ -23,7 +24,11 @@ def main() -> int:
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--dtype", type=str, default="bfloat16")
     ap.add_argument("--steps", type=int, default=10)
-    ap.add_argument("--out", type=str, default="artifacts/trace_r02")
+    ap.add_argument("--out", type=str,
+                    default="artifacts/trace_"
+                            + os.environ.get("DASMTL_ROUND", "r03"),
+                    help="trace output dir (round-stamped like "
+                         "scripts/run_tpu_measurements.sh)")
     args = ap.parse_args()
 
     import jax

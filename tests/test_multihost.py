@@ -144,15 +144,19 @@ def _wait_for(path, seconds=240):
 _m0, _m1 = out_npz + ".compiled0", out_npz + ".compiled1"
 if pid == 1:
     _wait_for(_m0)
-train_step.lower(state, batch, np.float32(1e-3)).compile()
+# Keep the compiled executable and CALL it below: a discarded .compile()
+# would leave the post-barrier train_step(...) calls to re-trace and
+# re-compile through the jit path, silently re-introducing the unbarriered
+# compile unless the persistent disk cache happens to save it.
+compiled_step = train_step.lower(state, batch, np.float32(1e-3)).compile()
 open(_m1 if pid else _m0, "w").close()
 _wait_for(_m0 if pid else _m1)
 # TWO steps: step-2's loss is computed on step-1's updated params, so a wrong
 # cross-process gradient/BN reduction shows up at ~1e-3 relative there, while
 # mere reduction-order noise stays ~1e-6 (first-step Adam amplifies input
 # noise through m/sqrt(v) at v~0, so raw params are compared loosely).
-new_state, m1 = train_step(state, batch, np.float32(1e-3))
-new_state, m2 = train_step(new_state, batch, np.float32(1e-3))
+new_state, m1 = compiled_step(state, batch, np.float32(1e-3))
+new_state, m2 = compiled_step(new_state, batch, np.float32(1e-3))
 jax.block_until_ready(new_state.params)
 
 if pid == 0:
