@@ -25,10 +25,12 @@ def main() -> int:
     ap.add_argument("--dtype", type=str, default="bfloat16")
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--out", type=str,
-                    default="artifacts/trace_"
-                            + os.environ.get("DASMTL_ROUND", "r03"),
-                    help="trace output dir (round-stamped like "
-                         "scripts/run_tpu_measurements.sh)")
+                    default=("artifacts/trace_" + os.environ["DASMTL_ROUND"]
+                             if "DASMTL_ROUND" in os.environ
+                             else "artifacts/trace"),
+                    help="trace output dir; round-stamped only when "
+                         "DASMTL_ROUND is set (run_tpu_measurements.sh "
+                         "always passes --out explicitly)")
     args = ap.parse_args()
 
     import jax

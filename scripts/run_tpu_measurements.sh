@@ -14,4 +14,19 @@ python scripts/bench_stream.py  > "artifacts/stream_bench_${R}.json" 2> "artifac
 python scripts/bench_stream.py --latency > "artifacts/latency_${R}.json" 2> "artifacts/latency_${R}.log"
 python scripts/bench_cv.py      > "artifacts/cv_bench_${R}.json"    2> "artifacts/cv_bench_${R}.log"
 python scripts/capture_trace.py --out "artifacts/trace_${R}"        2> "artifacts/trace_${R}.log"
+# Pure post-processing (re-runnable offline from the saved trace): never
+# let it abort the remaining on-chip steps under set -e.
+python scripts/analyze_trace.py "artifacts/trace_${R}" > "artifacts/trace_${R}_summary.json" 2>> "artifacts/trace_${R}.log" || true
+# End-to-end ON-CHIP training evidence (not just the step microbench):
+# a short synthetic run through the real Trainer on the TPU device path.
+python - <<'PYEOF' 2> "artifacts/convergence_tpu_${R}.log"
+from dasmtl.data.synthetic import make_synthetic_dataset
+make_synthetic_dataset('/tmp/dastpu', files_per_category=6)
+PYEOF
+python train.py --model MTL --epoch_num 6 --batch_size 64 --val_every 2 \
+    --compute_dtype bfloat16 --ckpt_acc_gate 0.9 \
+    --trainVal_set_striking /tmp/dastpu/striking_train \
+    --trainVal_set_excavating /tmp/dastpu/excavating_train \
+    --output_savedir /tmp/dasruns_tpu >> "artifacts/convergence_tpu_${R}.log" 2>&1
+tail -5 "artifacts/convergence_tpu_${R}.log"
 echo "all TPU measurements recorded under artifacts/"

@@ -1,0 +1,83 @@
+"""Unit tests for scripts/merge_stream_shards.py: shard discovery, ordering,
+and the incomplete/mixed-shard-set refusals (multi-host streaming writes one
+``<base>.p<i>.csv`` per process — dasmtl/stream.py)."""
+
+import csv
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+from merge_stream_shards import find_shards, merge_shards  # noqa: E402
+
+FIELDS = ["window_index", "channel_origin", "time_origin", "weight",
+          "pred_distance_m", "pred_event"]
+
+
+def _write_shard(path, indices):
+    with open(path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=FIELDS)
+        w.writeheader()
+        for i in indices:
+            w.writerow({"window_index": i, "channel_origin": 0,
+                        "time_origin": i * 125, "weight": 1.0,
+                        "pred_distance_m": 5, "pred_event": "striking"})
+
+
+def test_merge_orders_and_counts(tmp_path):
+    base = str(tmp_path / "pred.csv")
+    _write_shard(str(tmp_path / "pred.p0.csv"), [1, 0, 2])
+    _write_shard(str(tmp_path / "pred.p1.csv"), [4, 3])
+    assert len(find_shards(base)) == 2
+    n = merge_shards(base, expect_shards=2)
+    assert n == 5
+    with open(base) as f:
+        got = [int(r["window_index"]) for r in csv.DictReader(f)]
+    assert got == [0, 1, 2, 3, 4]
+
+
+def test_merge_rejects_missing_middle_shard(tmp_path):
+    base = str(tmp_path / "pred.csv")
+    _write_shard(str(tmp_path / "pred.p0.csv"), [0, 1])
+    _write_shard(str(tmp_path / "pred.p2.csv"), [4, 5])
+    with pytest.raises(ValueError, match="not contiguous"):
+        merge_shards(base)
+
+
+def test_merge_rejects_missing_tail_shard_with_expect(tmp_path):
+    base = str(tmp_path / "pred.csv")
+    _write_shard(str(tmp_path / "pred.p0.csv"), [0, 1])
+    with pytest.raises(ValueError, match="missing"):
+        merge_shards(base, expect_shards=2)
+    # Without expect_shards the tail loss is undetectable by design — the
+    # indices are contiguous and the shard sequence starts at 0.
+    assert merge_shards(base) == 2
+
+
+def test_merge_rejects_window_gaps_and_duplicates(tmp_path):
+    base = str(tmp_path / "pred.csv")
+    _write_shard(str(tmp_path / "pred.p0.csv"), [0, 1])
+    _write_shard(str(tmp_path / "pred.p1.csv"), [3])  # window 2 lost
+    with pytest.raises(ValueError, match="missing from the shard set"):
+        merge_shards(base)
+    _write_shard(str(tmp_path / "pred.p1.csv"), [1, 2])  # 1 duplicated
+    with pytest.raises(ValueError, match="multiple shards"):
+        merge_shards(base)
+
+
+def test_merge_rejects_header_mismatch(tmp_path):
+    base = str(tmp_path / "pred.csv")
+    _write_shard(str(tmp_path / "pred.p0.csv"), [0])
+    with open(str(tmp_path / "pred.p1.csv"), "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=["window_index", "other"])
+        w.writeheader()
+        w.writerow({"window_index": 1, "other": "x"})
+    with pytest.raises(ValueError, match="header"):
+        merge_shards(base)
+
+
+def test_merge_requires_some_shards(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        merge_shards(str(tmp_path / "nothing.csv"))

@@ -1,0 +1,92 @@
+"""scripts/analyze_trace.py against a real jax.profiler capture: the
+summary must find the xplane, sum only op-level lines (device planes nest
+hierarchy lines whose events enclose the op events), and report a busy
+fraction that cannot exceed the wall span."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_analyze_trace_summarizes_capture(tmp_path):
+    f = jax.jit(lambda x: jnp.tanh(x @ x).sum())
+    x = jnp.ones((768, 768))  # big enough that dot time dominates tracing
+    f(x).block_until_ready()
+    jax.profiler.start_trace(str(tmp_path))
+    for _ in range(6):
+        f(x).block_until_ready()
+    jax.profiler.stop_trace()
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "analyze_trace.py"),
+         str(tmp_path), "--steps", "4", "--all_planes"],
+        capture_output=True, text=True, cwd=_REPO)
+    assert out.returncode == 0, out.stderr
+    rec = json.loads(out.stdout)
+    assert rec["metric"] == "trace_summary"
+    assert rec["devices"], "no planes summarized"
+    for dev in rec["devices"]:
+        assert dev["busy_ms"] > 0 and dev["wall_ms"] > 0
+        assert 0 <= dev["conv_dot_fraction_of_busy"] <= 1
+        assert dev["lines_summed"]
+    # The matmul-dominated capture must show dots prominent in some plane.
+    assert any(d["conv_dot_fraction_of_busy"] > 0.2 for d in rec["devices"])
+
+
+class _FakeEvent:
+    def __init__(self, name, start_ns, duration_ns):
+        self.name = name
+        self.start_ns = start_ns
+        self.duration_ns = duration_ns
+
+
+class _FakeLine:
+    def __init__(self, name, events):
+        self.name = name
+        self.events = events
+
+
+class _FakePlane:
+    def __init__(self, name, lines):
+        self.name = name
+        self.lines = lines
+
+
+def test_summarize_plane_sums_only_op_lines():
+    """Regression for the hierarchy double-count: device planes nest an
+    'XLA Modules' line whose single event ENCLOSES the 'XLA Ops' events;
+    summing both would report ~2x busy time and a diluted conv fraction."""
+    from analyze_trace import _op_lines, summarize_plane
+
+    ops = _FakeLine("XLA Ops", [
+        _FakeEvent("convolution.1", 0, 600),
+        _FakeEvent("fusion.2", 600, 400),
+    ])
+    modules = _FakeLine("XLA Modules", [_FakeEvent("jit_train_step", 0, 1000)])
+    plane = _FakePlane("/device:TPU:0", [modules, ops])
+
+    assert [ln.name for ln in _op_lines(plane)] == ["XLA Ops"]
+    summary = summarize_plane(plane, steps=1, top=5)
+    assert summary["lines_summed"] == ["XLA Ops"]
+    assert summary["busy_ms"] == 0.001  # 1000 ns of ops, NOT 2000 ns
+    assert summary["conv_dot_fraction_of_busy"] == 0.6
+    # A plane with no op-level line (host threads) falls back to all lines.
+    host = _FakePlane("/host:CPU", [
+        _FakeLine("python", [_FakeEvent("a", 0, 100)]),
+        _FakeLine("worker", [_FakeEvent("b", 50, 100)]),
+    ])
+    assert len(_op_lines(host)) == 2
+
+
+def test_analyze_trace_missing_dir_errors(tmp_path):
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "analyze_trace.py"),
+         str(tmp_path / "absent")],
+        capture_output=True, text=True, cwd=_REPO)
+    assert out.returncode != 0
