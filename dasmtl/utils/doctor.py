@@ -31,20 +31,68 @@ def collect() -> dict:
         except Exception:  # noqa: BLE001 — a missing optional dep is data
             info.setdefault("versions", {})[mod] = None
 
-    try:
-        devices = jax.devices()
-        info["backend"] = jax.default_backend()
-        info["devices"] = [str(d) for d in devices]
-        info["device_kind"] = devices[0].device_kind if devices else None
-        info["process_count"] = jax.process_count()
-    except Exception as exc:  # noqa: BLE001 — backend init can fail/stall
-        info["backend"] = None
-        info["backend_error"] = repr(exc)[:300]
-
     env = {k: v for k, v in os.environ.items()
-           if k in ("JAX_PLATFORMS", "XLA_FLAGS",
+           if k in ("JAX_PLATFORMS", "XLA_FLAGS", "PALLAS_AXON_POOL_IPS",
                     "JAX_COMPILATION_CACHE_DIR")}
     info["env"] = env
+
+    # TPU-tunnel reachability — probed BEFORE any backend init.  When the
+    # relay is configured but down, plugin init blocks indefinitely (an
+    # env JAX_PLATFORMS=cpu does not save a fresh process: the plugin's
+    # startup registration overrides it), so a doctor that called
+    # jax.devices() first would hang on exactly the environments it is
+    # meant to diagnose.
+    relay_ip = (os.environ.get("PALLAS_AXON_POOL_IPS") or "").split(",")[0]
+    if relay_ip:
+        import socket
+
+        s = socket.socket()
+        s.settimeout(2)
+        try:
+            s.connect((relay_ip, 8082))
+            info["tpu_tunnel"] = "reachable"
+        except OSError as exc:
+            info["tpu_tunnel"] = f"unreachable ({exc})"
+        finally:
+            s.close()
+    else:
+        info["tpu_tunnel"] = "not-configured"
+
+    tunnel_down = str(info["tpu_tunnel"]).startswith("unreachable")
+    platforms = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS")
+    axon_would_init = relay_ip and (not platforms or "axon" in platforms
+                                    or "tpu" in platforms)
+    if tunnel_down and axon_would_init:
+        info["backend"] = None
+        info["backend_error"] = (
+            "axon TPU tunnel unreachable — skipping backend init (it would "
+            "block); re-run with PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu "
+            "for CPU diagnostics")
+    else:
+        if relay_ip and info["tpu_tunnel"] == "reachable":
+            # Flush a breadcrumb BEFORE init: with the relay up but the
+            # exclusive chip claim held elsewhere, jax.devices() blocks —
+            # an operator must be able to tell that hang from tunnel-down.
+            print("tpu tunnel reachable; initializing backend (a hang "
+                  "here = stale exclusive claim — wait it out, never "
+                  "SIGKILL a claimed client)", file=sys.stderr, flush=True)
+        try:
+            devices = jax.devices()
+            info["backend"] = jax.default_backend()
+            info["devices"] = [str(d) for d in devices]
+            info["device_kind"] = devices[0].device_kind if devices else None
+            info["process_count"] = jax.process_count()
+        except Exception as exc:  # noqa: BLE001 — backend init can fail
+            info["backend"] = None
+            info["backend_error"] = repr(exc)[:300]
+
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if cache_dir:
+        try:
+            info["compilation_cache_entries"] = len(os.listdir(cache_dir))
+        except OSError as exc:
+            # A typo'd/absent dir must not masquerade as a cold cache.
+            info["compilation_cache_entries"] = f"unreadable ({exc})"
 
     from dasmtl.data import native
 
@@ -94,6 +142,11 @@ def main(argv=None) -> int:
     if info["env"]:
         for k, v in info["env"].items():
             print(f"  env {k}={v}")
+    print(f"  TPU tunnel: {info.get('tpu_tunnel')}")
+    if "compilation_cache_entries" in info:
+        n = info["compilation_cache_entries"]
+        print(f"  compilation cache: "
+              + (f"{n} entries" if isinstance(n, int) else str(n)))
     nl = info["native_loader"]
     print(f"  native MAT loader: "
           f"{'available' if nl['available'] else 'scipy fallback'} "
