@@ -69,7 +69,7 @@ def collect() -> dict:
             "block); re-run with PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu "
             "for CPU diagnostics")
     else:
-        if relay_ip and info["tpu_tunnel"] == "reachable":
+        if axon_would_init and info["tpu_tunnel"] == "reachable":
             # Flush a breadcrumb BEFORE init: with the relay up but the
             # exclusive chip claim held elsewhere, jax.devices() blocks —
             # an operator must be able to tell that hang from tunnel-down.

@@ -2,9 +2,10 @@
 
 The reference evaluates only pre-cut per-sample windows (its recordings are
 sliced offline, reference README.md:34-36); this entry runs the restored
-model over a continuous (channels, time) record directly.  ``--device`` must
-be resolved before JAX initializes, so it is applied to ``JAX_PLATFORMS``
-here, before any dasmtl/jax import (same pattern as train.py/test.py).
+model over a continuous (channels, time) record directly.  ``--device`` is
+resolved before any backend initializes, via the same
+``dasmtl.utils.platform.apply_device`` mechanism as train.py/test.py (env
+var + live jax.config re-pin for hosts that pre-import jax at startup).
 
     python stream.py --record fiber.mat --model_path <run>/ckpts/best \\
         --stride_time 125 --out predictions.csv

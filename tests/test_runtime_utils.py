@@ -48,6 +48,37 @@ def test_cli_overrides_parse_to_config_values():
     assert cfg.ckpt_max_keep == 9
 
 
+def test_device_cpu_flag_pins_backend_despite_preloaded_plugin():
+    """--device cpu must work on hosts whose interpreter startup pre-imports
+    jax with an accelerator plugin: the env var alone is latched at that
+    import, so the flag must also re-pin the live jax.config (round-3
+    regression: train.py only set JAX_PLATFORMS and hung on a dead-tunnel
+    host).  Subprocess: plugin env present, flag applied, backend must
+    resolve to cpu without touching the (possibly dead) tunnel."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let any preloaded plugin win the env
+    # Re-enable the tunnel plugin in the child (conftest empties it for the
+    # suite) so its jax preload registers the axon platform — the exact
+    # condition the fix targets.  Point it at a TEST-NET address, NOT the
+    # real relay: if the pin regresses, the blocked child gets killed by
+    # the timeout, and killing a client that holds a live claim wedges the
+    # shared chip (see docs/OPERATIONS.md); an unroutable endpoint can
+    # never hold a claim.  Elsewhere the var is inert and the test still
+    # checks env-free cpu pinning.
+    env["PALLAS_AXON_POOL_IPS"] = "203.0.113.1"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = ("import train; train._apply_device_flag(['--device', 'cpu']); "
+            "import jax; assert jax.default_backend() == 'cpu', "
+            "jax.default_backend(); print('pinned-cpu-ok')")
+    proc = subprocess.run([sys.executable, "-c", code], cwd=repo, env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "pinned-cpu-ok" in proc.stdout
+
+
 def test_run_dirs_unique_within_same_second(tmp_path):
     paths = {make_run_dir(str(tmp_path), "MTL", False) for _ in range(5)}
     assert len(paths) == 5

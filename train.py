@@ -3,11 +3,13 @@
 Same flag surface as the reference entry (reference train.py:7-26) plus the
 hyperparameters it hard-codes, with ``--device={tpu,cpu,auto}`` replacing the
 ``--GPU_device`` bool-trap flag (reference train.py:10,17 — ``type=bool`` makes
-any string truthy).  ``--device`` must be resolved before JAX initializes, so
-it is applied to ``JAX_PLATFORMS`` here, before any dasmtl/jax import.
+any string truthy).  ``--device`` must be resolved before JAX *initializes a
+backend*: it is applied here via ``dasmtl.utils.platform.apply_device``,
+which sets ``JAX_PLATFORMS`` and — because some hosts pre-import jax with an
+accelerator plugin at interpreter startup, latching the env — also re-pins
+the live ``jax.config``.  ``dasmtl.utils.platform`` itself imports no jax.
 """
 
-import os
 import sys
 
 
@@ -19,18 +21,15 @@ def _apply_device_flag(argv) -> None:
             value = arg.split("=", 1)[1]
         else:
             continue
-        if value == "cpu":
-            # Force CPU even when the environment pre-selects an accelerator
-            # platform (e.g. JAX_PLATFORMS=axon on tunneled-TPU hosts).
-            os.environ["JAX_PLATFORMS"] = "cpu"
-        elif value == "tpu":
-            current = os.environ.get("JAX_PLATFORMS", "")
-            if not current or current == "cpu":
-                # Honor the explicit flag even over a leftover cpu export
-                # (e.g. from a test-suite invocation); fails loudly on hosts
-                # without a TPU rather than silently training on CPU.  A
-                # non-cpu preset (tpu plugin platforms) is left as-is.
-                os.environ["JAX_PLATFORMS"] = "tpu"
+        # platform.apply_device sets JAX_PLATFORMS AND re-pins the live
+        # jax.config: on hosts whose interpreter startup pre-imports jax
+        # with an accelerator plugin (the tunneled-TPU containers), the env
+        # var alone is already latched and "--device cpu" would still
+        # initialize the plugin — which blocks indefinitely when the
+        # tunnel is down.  dasmtl.utils.platform imports no jax itself.
+        from dasmtl.utils.platform import apply_device
+
+        apply_device(value)
         return
 
 
