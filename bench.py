@@ -251,6 +251,22 @@ def _run_child(env: dict, timeout: float, flag: str = "--child"):
     return None, f"rc={proc.returncode}; tail:\n{tail}"
 
 
+def _tunnel_reachable() -> bool:
+    """Probe the TPU tunnel relay so the harness can skip doomed TPU
+    attempts instead of burning its budget on children blocked against a
+    dead relay — and instead of killing them, which on a live-claim client
+    would wedge the chip.  A reachable relay says nothing about the
+    exclusive claim; attempts still get timeouts."""
+    from dasmtl.utils.platform import tunnel_probe
+
+    status = tunnel_probe()
+    if status.startswith("unreachable"):
+        print(f"bench: TPU tunnel relay {status} — skipping TPU attempts",
+              file=sys.stderr)
+        return False
+    return True  # reachable, or no tunnel configured (let jax decide)
+
+
 def main() -> int:
     from dasmtl.utils.platform import cpu_pinned_env
 
@@ -260,7 +276,8 @@ def main() -> int:
         return _BUDGET_S - (time.monotonic() - t_start)
 
     result = None
-    for timeout, backoff in _TPU_ATTEMPTS:
+    attempts = _TPU_ATTEMPTS if _tunnel_reachable() else ()
+    for timeout, backoff in attempts:
         # Never let a TPU attempt eat the CPU fallback's minimum slice.
         timeout = min(timeout, remaining() - _CPU_MIN_TIMEOUT)
         if timeout <= 30:
@@ -314,7 +331,10 @@ def _multi_config(child_flag: str) -> int:
     available platform and print its JSON row list."""
     from dasmtl.utils.platform import cpu_pinned_env
 
-    for env, timeout in ((dict(os.environ), 1500), (cpu_pinned_env(), 1800)):
+    candidates = [(dict(os.environ), 1500), (cpu_pinned_env(), 1800)]
+    if not _tunnel_reachable():
+        candidates = candidates[1:]
+    for env, timeout in candidates:
         rows, diag = _run_child(env, timeout, flag=child_flag)
         print(diag, end="", file=sys.stderr)
         if rows is not None:

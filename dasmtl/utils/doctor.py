@@ -42,21 +42,10 @@ def collect() -> dict:
     # startup registration overrides it), so a doctor that called
     # jax.devices() first would hang on exactly the environments it is
     # meant to diagnose.
-    relay_ip = (os.environ.get("PALLAS_AXON_POOL_IPS") or "").split(",")[0]
-    if relay_ip:
-        import socket
+    from dasmtl.utils.platform import tunnel_probe
 
-        s = socket.socket()
-        s.settimeout(2)
-        try:
-            s.connect((relay_ip, 8082))
-            info["tpu_tunnel"] = "reachable"
-        except OSError as exc:
-            info["tpu_tunnel"] = f"unreachable ({exc})"
-        finally:
-            s.close()
-    else:
-        info["tpu_tunnel"] = "not-configured"
+    relay_ip = (os.environ.get("PALLAS_AXON_POOL_IPS") or "").split(",")[0]
+    info["tpu_tunnel"] = tunnel_probe()
 
     tunnel_down = str(info["tpu_tunnel"]).startswith("unreachable")
     platforms = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS")

@@ -58,6 +58,31 @@ def apply_device(device: str) -> None:
                           os.environ.get("JAX_PLATFORMS") or None)
 
 
+def tunnel_probe(port: int = 8082, timeout_s: float = 3.0) -> str:
+    """TCP-probe the TPU tunnel relay named by ``PALLAS_AXON_POOL_IPS``.
+
+    Returns ``"not-configured"`` (no relay in the environment),
+    ``"reachable"``, or ``"unreachable (<error>)"``.  The single home of
+    the relay address/port knowledge — the bench harness uses it to skip
+    doomed TPU attempts and the doctor to diagnose hangs; a reachable
+    relay says nothing about the exclusive chip claim.
+    """
+    relay_ip = (os.environ.get("PALLAS_AXON_POOL_IPS") or "").split(",")[0]
+    if not relay_ip:
+        return "not-configured"
+    import socket
+
+    s = socket.socket()
+    s.settimeout(timeout_s)
+    try:
+        s.connect((relay_ip, port))
+        return "reachable"
+    except OSError as exc:
+        return f"unreachable ({exc})"
+    finally:
+        s.close()
+
+
 def pin_cpu_in_process(n_devices: Optional[int] = None) -> bool:
     """Apply the pinning to ``os.environ``; returns False (no-op) when jax is
     already imported, because the platform choice is latched at first import."""
