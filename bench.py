@@ -36,11 +36,23 @@ _MARK = "BENCH_RESULT "
 # fallback always has at least _CPU_MIN_TIMEOUT left inside the budget — the
 # harness must emit its JSON line even when every TPU attempt stalls.
 _BUDGET_S = float(os.environ.get("DASMTL_BENCH_BUDGET_S", "540"))
-# Measured this session: a successful TPU child run takes ~180s end-to-end
-# (init ~30s + compile ~35s + model/state build + measure), so the first
-# attempt gets 300s headroom within the 540s budget.
-_TPU_ATTEMPTS = ((300, 0), (60, 10))  # (timeout_s, backoff_before_s)
+# Measured: a successful TPU child run takes ~180s end-to-end (init ~30s +
+# compile ~35s + model/state build + measure), so the first attempt gets 240s
+# headroom — sized so that within the 540s budget a first-attempt timeout
+# whose child dies promptly on TERM still leaves room for the 60s retry
+# (plus its grace) ahead of the CPU fallback's reserved slice; only when the
+# child also burns the full TERM grace is the retry skipped for the fallback.
+_TPU_ATTEMPTS = ((240, 0), (60, 10))  # (timeout_s, backoff_before_s)
 _CPU_MIN_TIMEOUT = 180
+# SIGTERM grace before SIGKILL on a timed-out child.  Sized to cover the
+# longest native-code stretch a CLAIM-HOLDING child can be inside (a cold
+# train-step compile is ~35s on this host) — CPython delivers the handler
+# only once native code returns, so 60s guarantees a child that owns the
+# chip claim exits via interpreter teardown, never SIGKILL.  A child that
+# burns the whole grace is necessarily still BLOCKED IN INIT (minutes-long
+# claim contention / dead tunnel upstream); it holds no granted claim, so
+# the final SIGKILL cannot wedge anything.
+_TERM_GRACE_S = 60
 
 # Peak dense bf16 FLOP/s by TPU generation (public spec sheets) for MFU.
 _PEAK_BF16 = {"v6e": 918e12, "trillium": 918e12, "v5p": 459e12,
@@ -236,18 +248,30 @@ def _run_child(env: dict, timeout: float, flag: str = "--child"):
     # back-to-back rounds) skips the ~35s train-step compile entirely.
     env = dict(env)
     env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dasmtl_jax_cache")
+    # Timeout handling must NOT SIGKILL the child (subprocess.run's behavior):
+    # a child killed -9 while holding the exclusive TPU-tunnel claim leaves the
+    # remote claim wedged, and every later client blocks on init until the
+    # remote lease expires — the exact failure that turned round-2's driver
+    # capture into a CPU fallback.  SIGTERM first, grace, then kill.
+    proc = subprocess.Popen(cmd, cwd=_REPO, env=env, text=True,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
     try:
-        proc = subprocess.run(cmd, cwd=_REPO, env=env, capture_output=True,
-                              text=True, timeout=timeout)
+        stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.communicate(timeout=_TERM_GRACE_S)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
         return None, f"timed out after {timeout}s"
-    for line in proc.stdout.splitlines():
+    for line in stdout.splitlines():
         if line.startswith(_MARK):
             try:
-                return json.loads(line[len(_MARK):]), proc.stderr[-2000:]
+                return json.loads(line[len(_MARK):]), stderr[-2000:]
             except json.JSONDecodeError as exc:
                 return None, f"bad result line: {exc}"
-    tail = (proc.stderr or proc.stdout or "")[-2000:]
+    tail = (stderr or stdout or "")[-2000:]
     return None, f"rc={proc.returncode}; tail:\n{tail}"
 
 
@@ -278,8 +302,10 @@ def main() -> int:
     result = None
     attempts = _TPU_ATTEMPTS if _tunnel_reachable() else ()
     for timeout, backoff in attempts:
-        # Never let a TPU attempt eat the CPU fallback's minimum slice.
-        timeout = min(timeout, remaining() - _CPU_MIN_TIMEOUT)
+        # Never let a TPU attempt eat the CPU fallback's minimum slice —
+        # including the TERM grace a timed-out attempt may consume on top of
+        # its timeout before the child dies.
+        timeout = min(timeout, remaining() - _CPU_MIN_TIMEOUT - _TERM_GRACE_S)
         if timeout <= 30:
             break
         if backoff:
@@ -345,6 +371,13 @@ def _multi_config(child_flag: str) -> int:
 
 
 if __name__ == "__main__":
+    if any(flag.startswith("--child") for flag in sys.argv[1:]):
+        # Orderly shutdown on the parent's timeout TERM: raise SystemExit so
+        # interpreter teardown (and the PJRT client's destructor) runs and the
+        # TPU-tunnel claim is released properly instead of by TCP teardown.
+        import signal
+
+        signal.signal(signal.SIGTERM, lambda *_: sys.exit(124))
     if "--child-sweep" in sys.argv:
         _child_sweep()
     elif "--child-models" in sys.argv:

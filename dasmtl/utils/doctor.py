@@ -44,13 +44,13 @@ def collect() -> dict:
     # meant to diagnose.
     from dasmtl.utils.platform import tunnel_probe
 
-    relay_ip = (os.environ.get("PALLAS_AXON_POOL_IPS") or "").split(",")[0]
     info["tpu_tunnel"] = tunnel_probe()
 
     tunnel_down = str(info["tpu_tunnel"]).startswith("unreachable")
+    tunnel_configured = info["tpu_tunnel"] != "not-configured"
     platforms = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS")
-    axon_would_init = relay_ip and (not platforms or "axon" in platforms
-                                    or "tpu" in platforms)
+    axon_would_init = tunnel_configured and (
+        not platforms or "axon" in platforms or "tpu" in platforms)
     if tunnel_down and axon_would_init:
         info["backend"] = None
         info["backend_error"] = (

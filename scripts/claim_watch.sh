@@ -1,0 +1,38 @@
+#!/bin/sh
+# Wait for the exclusive TPU-tunnel claim to become acquirable, then run the
+# full serial measurement chain (scripts/run_tpu_measurements.sh).
+#
+# Why this exists: the remote claim can stay held for a while after a client
+# dies mid-claim (round-2 postmortem — a SIGKILLed bench child wedged every
+# later attempt).  Instead of burning per-tool timeouts polling by hand, this
+# keeps ONE patient probe waiting; the moment `jax.devices()` succeeds, the
+# chain starts with a warm relay.  Probes are TERMed (never KILLed) so a
+# timed-out probe cannot itself wedge the claim it is waiting on.
+#
+# Usage:  DASMTL_ROUND=r03 setsid nohup sh scripts/claim_watch.sh &
+set -u
+R="${DASMTL_ROUND:-r03}"
+LOG="artifacts/claim_watch_${R}.log"
+mkdir -p artifacts
+i=0
+while true; do
+    i=$((i + 1))
+    echo "[claim_watch] probe #$i $(date -u +%H:%M:%S)" >> "$LOG"
+    # The probe installs a SIGTERM handler so a timed-out probe that DID get
+    # the claim tears down the PJRT client properly (a handler-less python
+    # dies at default disposition — no interpreter teardown).  A probe still
+    # blocked inside native init can't run the handler, so timeout -k follows
+    # up with KILL after 30s — harmless there, since an init-blocked probe
+    # holds no granted claim.
+    if timeout -k 30 -s TERM 600 python -c "import signal, sys
+signal.signal(signal.SIGTERM, lambda *_: sys.exit(1))
+import jax; jax.devices()" >> "$LOG" 2>&1
+    then
+        echo "[claim_watch] claim acquirable at $(date -u +%H:%M:%S); starting chain" >> "$LOG"
+        DASMTL_ROUND="$R" sh scripts/run_tpu_measurements.sh >> "artifacts/measure_chain_${R}.log" 2>&1
+        echo "[claim_watch] chain rc=$? at $(date -u +%H:%M:%S)" >> "$LOG"
+        exit 0
+    fi
+    echo "[claim_watch] probe blocked/failed; retrying in 30s" >> "$LOG"
+    sleep 30
+done
