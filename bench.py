@@ -255,22 +255,29 @@ def _run_child(env: dict, timeout: float, flag: str = "--child"):
     # capture into a CPU fallback.  SIGTERM first, grace, then kill.
     proc = subprocess.Popen(cmd, cwd=_REPO, env=env, text=True,
                             stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    timed_out = False
     try:
         stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
+        timed_out = True
         proc.terminate()
         try:
-            proc.communicate(timeout=_TERM_GRACE_S)
+            # Keep whatever the child flushed before dying: a child that
+            # finished measuring but stalled in claim teardown has already
+            # printed its BENCH_RESULT line — salvage it instead of burning
+            # the retry / CPU fallback on a number we have.
+            stdout, stderr = proc.communicate(timeout=_TERM_GRACE_S)
         except subprocess.TimeoutExpired:
             proc.kill()
-            proc.communicate()
-        return None, f"timed out after {timeout}s"
+            stdout, stderr = proc.communicate()
     for line in stdout.splitlines():
         if line.startswith(_MARK):
             try:
                 return json.loads(line[len(_MARK):]), stderr[-2000:]
             except json.JSONDecodeError as exc:
                 return None, f"bad result line: {exc}"
+    if timed_out:
+        return None, f"timed out after {timeout}s"
     tail = (stderr or stdout or "")[-2000:]
     return None, f"rc={proc.returncode}; tail:\n{tail}"
 
@@ -303,9 +310,10 @@ def main() -> int:
     attempts = _TPU_ATTEMPTS if _tunnel_reachable() else ()
     for timeout, backoff in attempts:
         # Never let a TPU attempt eat the CPU fallback's minimum slice —
-        # including the TERM grace a timed-out attempt may consume on top of
-        # its timeout before the child dies.
-        timeout = min(timeout, remaining() - _CPU_MIN_TIMEOUT - _TERM_GRACE_S)
+        # including the backoff sleep ahead of it and the TERM grace a
+        # timed-out attempt may consume on top of its timeout.
+        timeout = min(timeout, remaining() - backoff
+                      - _CPU_MIN_TIMEOUT - _TERM_GRACE_S)
         if timeout <= 30:
             break
         if backoff:

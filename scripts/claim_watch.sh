@@ -14,6 +14,15 @@ set -u
 R="${DASMTL_ROUND:-r03}"
 LOG="artifacts/claim_watch_${R}.log"
 mkdir -p artifacts
+# Single-instance lock: two watchers would both fire the measurement chain
+# into the exclusive single-chip claim.  mkdir is atomic; a stale lock from
+# a dead watcher is broken by hand (rmdir artifacts/.claim_watch.lock).
+LOCK="artifacts/.claim_watch.lock"
+if ! mkdir "$LOCK" 2>/dev/null; then
+    echo "[claim_watch] another instance holds $LOCK — exiting" >> "$LOG"
+    exit 1
+fi
+trap 'rmdir "$LOCK" 2>/dev/null' EXIT INT TERM
 i=0
 while true; do
     i=$((i + 1))
