@@ -43,13 +43,23 @@ def make_infer_fn(spec, state) -> Callable:
     decoded back to distance/event like the reference's ``hash_list``,
     utils.py:600 there) plus ``log_probs_<i>`` per model head.
     """
+    import jax
+
+    # Capture only what inference needs — NOT the TrainState, whose Adam
+    # moments (~2x params) would otherwise stay alive through tracing and
+    # serialization.
+    apply_fn = state.apply_fn
     variables = {"params": state.params, "batch_stats": state.batch_stats}
 
     def infer(x):
-        outputs = state.apply_fn(variables, x, train=False)
+        outputs = apply_fn(variables, x, train=False)
         out = dict(spec.decode(outputs))
         for i, head in enumerate(outputs):
-            out[f"log_probs_{i}"] = head
+            # Normalize every head to true log-probabilities: log_softmax is
+            # idempotent on heads that already emit them (TwoLevelNet), and
+            # converts the multi-classifier's raw Dense logits — so the
+            # artifact's "log_probs_<i>" contract holds for every model.
+            out[f"log_probs_{i}"] = jax.nn.log_softmax(head, axis=-1)
         return out
 
     return infer

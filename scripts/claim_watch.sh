@@ -22,7 +22,8 @@ if ! mkdir "$LOCK" 2>/dev/null; then
     echo "[claim_watch] another instance holds $LOCK — exiting" >> "$LOG"
     exit 1
 fi
-trap 'rmdir "$LOCK" 2>/dev/null' EXIT INT TERM
+trap 'rmdir "$LOCK" 2>/dev/null' EXIT
+trap 'rmdir "$LOCK" 2>/dev/null; exit 1' INT TERM
 i=0
 while true; do
     i=$((i + 1))

@@ -39,6 +39,13 @@ def test_export_roundtrip_symbolic_batch(tmp_path):
         for key in want:
             np.testing.assert_allclose(got[key], want[key],
                                        rtol=1e-5, atol=1e-5)
+        # The artifact's contract: every log_probs_<i> head is normalized
+        # log-probabilities (make_infer_fn log_softmaxes raw-logit heads —
+        # the multi-classifier's — and is a no-op on already-normalized
+        # ones; exp must sum to 1 regardless of model family).
+        for key in ("log_probs_0", "log_probs_1"):
+            np.testing.assert_allclose(np.exp(got[key]).sum(-1), 1.0,
+                                       rtol=1e-5)
 
 
 def test_export_decodes_every_task(tmp_path):

@@ -12,6 +12,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 from dasmtl.utils.platform import cpu_pinned_env
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -172,6 +174,10 @@ print(f"train multihost ok {pid}")
 """
 
 
+@pytest.mark.slow  # ~85s: two subprocess JAX imports + compiles + Gloo
+# rendezvous.  Driver-grade evidence, not an every-run invariant: the
+# in-process mesh equality test (test_parallel.py:38) and the 2-process
+# smoke above keep default-suite coverage of the same contract.
 def test_two_process_train_step_matches_single_process(tmp_path):
     import jax
     import numpy as np

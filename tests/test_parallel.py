@@ -85,6 +85,10 @@ def test_batch_sharding_layout():
     assert shard_shapes == {(4, HW[0] // 2, HW[1], 1)}
 
 
+@pytest.mark.slow  # ~52s: a fresh subprocess JAX import + three mesh
+# compiles.  The driver itself runs this entrypoint every round
+# (MULTICHIP_r*.json); default-suite coverage of the same paths stays via
+# the in-process mesh/bn_sync/cv tests and test_graft_entry_forward below.
 def test_dryrun_multichip_entrypoint():
     import __graft_entry__ as ge
 
