@@ -356,8 +356,47 @@ def main() -> int:
     # (TPU tunnel down/busy) compares against the CPU baseline, so its
     # vs_baseline ~1.0 says nothing about the TPU target (round-2 verdict).
     result["tpu_measured"] = result.get("backend") == "tpu"
+    # True provenance for artifact rows: checkout/untar rewrites file mtimes,
+    # so the measurement moment must ride inside the row itself.
+    result["measured_unix"] = round(time.time(), 1)
+    if not result["tpu_measured"]:
+        last = _last_recorded_tpu()
+        if last:
+            # The live TPU measurement failed (tunnel down at capture time),
+            # but the serial measurement chain recorded one earlier: point at
+            # it, clearly labeled as a replay of a recorded artifact — the
+            # top-level metric stays the live measurement.
+            result["last_tpu"] = last
     print(json.dumps(result))
     return 0
+
+
+def _last_recorded_tpu():
+    """Newest backend=="tpu" bench row under artifacts/ (written by
+    scripts/run_tpu_measurements.sh), with provenance, or None."""
+    import glob
+
+    best, best_ts = None, None
+    for path in glob.glob(os.path.join(_REPO, "artifacts", "bench_*_tpu.json")):
+        try:
+            with open(path) as f:
+                row = json.load(f)
+            # Prefer the in-row measurement timestamp: file mtimes are
+            # checkout-time after a clone, which would both misorder rounds
+            # and misstate provenance.
+            ts = float(row.get("measured_unix") or os.path.getmtime(path))
+        except (OSError, json.JSONDecodeError, TypeError, ValueError):
+            continue
+        if row.get("backend") != "tpu" or "value" not in row:
+            continue
+        if best is None or ts > best_ts:
+            best_ts = ts
+            best = {"value": row["value"], "unit": row.get("unit"),
+                    "step_time_ms": row.get("step_time_ms"),
+                    "mfu": row.get("mfu"),
+                    "source": os.path.relpath(path, _REPO),
+                    "recorded_unix": round(ts, 1)}
+    return best
 
 
 def _multi_config(child_flag: str) -> int:

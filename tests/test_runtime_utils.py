@@ -142,3 +142,39 @@ def test_doctor_collects_environment():
     assert info["versions"]["jax"]
     assert isinstance(info["native_loader"]["available"], bool)
     assert info["perf_defaults"]["device_data"] == "auto"
+
+
+def test_bench_last_recorded_tpu_picks_newest_tpu_row(tmp_path, monkeypatch):
+    """The driver-facing fallback JSON must point at the round's recorded
+    TPU artifact (chain output) — newest wins, CPU rows are ignored."""
+    import json
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    art = tmp_path / "artifacts"
+    art.mkdir()
+    # In-row measured_unix orders the rows (mtimes are checkout-time after a
+    # clone — both files get identical utimes here to prove mtime is unused).
+    (art / "bench_r02_tpu.json").write_text(json.dumps(
+        {"backend": "tpu", "value": 100000.0, "unit": "samples/s",
+         "measured_unix": 1000.0}))
+    (art / "bench_r03_tpu.json").write_text(json.dumps(
+        {"backend": "tpu", "value": 128510.0, "unit": "samples/s",
+         "step_time_ms": 1.992, "mfu": 0.81, "measured_unix": 2000.0}))
+    (art / "bench_r04_tpu.json").write_text(json.dumps(
+        {"backend": "cpu", "value": 17.0}))  # fallback row: must be ignored
+    for p in art.iterdir():
+        os.utime(p, (5000, 5000))
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+
+    last = bench._last_recorded_tpu()
+    assert last["value"] == 128510.0
+    assert last["mfu"] == 0.81
+    assert last["source"].endswith("bench_r03_tpu.json")
+
+    (art / "bench_r03_tpu.json").unlink()
+    (art / "bench_r02_tpu.json").unlink()
+    assert bench._last_recorded_tpu() is None
