@@ -240,10 +240,13 @@ def _child_models() -> None:
     print(_MARK + json.dumps(rows))
 
 
-def _run_child(env: dict, timeout: float, flag: str = "--child"):
+def _run_child(env: dict, timeout: float, flag: str = "--child",
+               cmd=None):
     """One measurement attempt in a subprocess (``flag`` selects the child
-    mode); returns (parsed BENCH_RESULT | None, diagnostics)."""
-    cmd = [sys.executable, os.path.abspath(__file__), flag]
+    mode); returns (parsed BENCH_RESULT | None, diagnostics).  ``cmd``
+    overrides the child argv (tests substitute a scripted stand-in)."""
+    if cmd is None:
+        cmd = [sys.executable, os.path.abspath(__file__), flag]
     # Persistent XLA compilation cache: a repeated harness run (driver retry,
     # back-to-back rounds) skips the ~35s train-step compile entirely.
     env = dict(env)

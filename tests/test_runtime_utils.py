@@ -178,3 +178,43 @@ def test_bench_last_recorded_tpu_picks_newest_tpu_row(tmp_path, monkeypatch):
     (art / "bench_r03_tpu.json").unlink()
     (art / "bench_r02_tpu.json").unlink()
     assert bench._last_recorded_tpu() is None
+
+
+def test_bench_run_child_salvages_result_from_stalled_child():
+    """Round-2 failure mode: a child that prints its BENCH_RESULT and then
+    stalls in claim teardown must NOT lose the result to the timeout path —
+    and must be TERMed (handler runs), never SIGKILLed while responsive."""
+    import sys as _sys
+
+    import bench
+
+    child = ("import signal, sys, time\n"
+             "def _term(*_):\n"
+             "    print('CHILD-TERMED-GRACEFULLY', file=sys.stderr,"
+             " flush=True)\n"
+             "    sys.exit(0)\n"
+             "signal.signal(signal.SIGTERM, _term)\n"
+             "print('BENCH_RESULT {\"backend\": \"tpu\", \"value\": 1.5}',"
+             " flush=True)\n"
+             "time.sleep(300)\n")
+    result, diag = bench._run_child(
+        dict(os.environ), timeout=2, cmd=[_sys.executable, "-c", child])
+    assert result == {"backend": "tpu", "value": 1.5}
+    # The marker proves the child died via its SIGTERM handler — a
+    # regression to immediate SIGKILL would still salvage the buffered
+    # result line, but could never produce this stderr line.
+    assert "CHILD-TERMED-GRACEFULLY" in diag
+
+
+def test_bench_run_child_times_out_silent_child():
+    import sys as _sys
+
+    import bench
+
+    child = ("import signal, sys, time\n"
+             "signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))\n"
+             "time.sleep(300)\n")
+    result, diag = bench._run_child(
+        dict(os.environ), timeout=2, cmd=[_sys.executable, "-c", child])
+    assert result is None
+    assert "timed out" in diag
