@@ -3,30 +3,80 @@
 # (probe first:  timeout 60 python -c "import jax; print(jax.devices())").
 # Never run these concurrently (single chip, exclusive claim, 1-core host)
 # and never SIGKILL them mid-claim; each emits JSON on stdout.
-set -ex
+#
+# Fault isolation: a step that fails (a TPU-only bug, an OOM probe, a
+# mid-step tunnel drop) must NOT abort the rest of the chain — tunnel
+# windows are too rare to waste.  Every step runs; failures are logged and
+# summarized at the end (nonzero exit if any step failed).  Artifacts are
+# written via tmp+mv so a failed re-run can never truncate a good artifact
+# recorded earlier in the round.
+set -x
 R="${DASMTL_ROUND:-r03}"
 mkdir -p artifacts
-python bench.py                 > "artifacts/bench_${R}_tpu.json"   2> "artifacts/bench_${R}_tpu.log"
-python bench.py --sweep         > "artifacts/sweep_${R}.json"       2> "artifacts/sweep_${R}.log"
-python bench.py --models        > "artifacts/models_bench_${R}.json" 2> "artifacts/models_bench_${R}.log"
-python scripts/bench_e2e.py     > "artifacts/e2e_bench_${R}.json"   2> "artifacts/e2e_bench_${R}.log"
-python scripts/bench_stream.py  > "artifacts/stream_bench_${R}.json" 2> "artifacts/stream_bench_${R}.log"
-python scripts/bench_stream.py --latency > "artifacts/latency_${R}.json" 2> "artifacts/latency_${R}.log"
-python scripts/bench_cv.py      > "artifacts/cv_bench_${R}.json"    2> "artifacts/cv_bench_${R}.log"
-python scripts/capture_trace.py --out "artifacts/trace_${R}"        2> "artifacts/trace_${R}.log"
-# Pure post-processing (re-runnable offline from the saved trace): never
-# let it abort the remaining on-chip steps under set -e.
-python scripts/analyze_trace.py "artifacts/trace_${R}" > "artifacts/trace_${R}_summary.json" 2>> "artifacts/trace_${R}.log" || true
+FAILLOG="artifacts/chain_failures_${R}.log"
+: > "$FAILLOG"
+
+fail() {  # fail <rc> <what>
+    echo "[chain] FAILED rc=$1 $2" | tee -a "$FAILLOG" >&2
+}
+
+step() {  # step <name> <cmd...> — stdout is the artifact artifacts/<name>.json
+    name="$1"; shift
+    "$@" > "artifacts/${name}.json.tmp" 2> "artifacts/${name}.log"
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
+        mv "artifacts/${name}.json.tmp" "artifacts/${name}.json"
+    else
+        rm -f "artifacts/${name}.json.tmp"
+        fail "$rc" "${name}: $*"
+    fi
+    return "$rc"
+}
+
+run_logged() {  # run_logged <name> <cmd...> — no JSON artifact, stderr to .log
+    name="$1"; shift
+    "$@" 2> "artifacts/${name}.log"
+    rc=$?
+    if [ "$rc" -ne 0 ]; then fail "$rc" "${name}: $*"; fi
+    return "$rc"
+}
+
+step "bench_${R}_tpu"    python bench.py
+step "sweep_${R}"        python bench.py --sweep
+step "models_bench_${R}" python bench.py --models
+step "e2e_bench_${R}"    python scripts/bench_e2e.py
+step "stream_bench_${R}" python scripts/bench_stream.py
+step "latency_${R}"      python scripts/bench_stream.py --latency
+step "cv_bench_${R}"     python scripts/bench_cv.py
+# Trace capture, then summary post-processing — only from a trace captured
+# intact this run (summarizing a partial/stale trace dir would record wrong
+# evidence), and through step() so a failed summarizer can't truncate a
+# previously recorded good summary.
+if run_logged "trace_${R}" python scripts/capture_trace.py --out "artifacts/trace_${R}"
+then
+    step "trace_${R}_summary" python scripts/analyze_trace.py "artifacts/trace_${R}"
+fi
 # End-to-end ON-CHIP training evidence (not just the step microbench):
 # a short synthetic run through the real Trainer on the TPU device path.
-python - <<'PYEOF' 2> "artifacts/convergence_tpu_${R}.log"
+# Skipped (and logged) if dataset generation fails — never train on stale
+# leftovers in /tmp.
+rm -rf /tmp/dastpu
+if run_logged "synthgen_${R}" python - <<'PYEOF'
 from dasmtl.data.synthetic import make_synthetic_dataset
 make_synthetic_dataset('/tmp/dastpu', files_per_category=6)
 PYEOF
-python train.py --model MTL --epoch_num 6 --batch_size 64 --val_every 2 \
-    --compute_dtype bfloat16 --ckpt_acc_gate 0.9 \
-    --trainVal_set_striking /tmp/dastpu/striking_train \
-    --trainVal_set_excavating /tmp/dastpu/excavating_train \
-    --output_savedir /tmp/dasruns_tpu >> "artifacts/convergence_tpu_${R}.log" 2>&1
-tail -5 "artifacts/convergence_tpu_${R}.log"
+then
+    python train.py --model MTL --epoch_num 6 --batch_size 64 --val_every 2 \
+        --compute_dtype bfloat16 --ckpt_acc_gate 0.9 \
+        --trainVal_set_striking /tmp/dastpu/striking_train \
+        --trainVal_set_excavating /tmp/dastpu/excavating_train \
+        --output_savedir /tmp/dasruns_tpu \
+        > "artifacts/convergence_tpu_${R}.log" 2>&1
+    rc=$?
+    if [ "$rc" -ne 0 ]; then fail "$rc" "on-chip convergence run"; fi
+    tail -5 "artifacts/convergence_tpu_${R}.log"
+fi
+if [ -s "$FAILLOG" ]; then
+    echo "chain finished WITH FAILURES:"; cat "$FAILLOG"; exit 1
+fi
 echo "all TPU measurements recorded under artifacts/"
