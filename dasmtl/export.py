@@ -66,13 +66,19 @@ def make_infer_fn(spec, state) -> Callable:
 
 
 def export_infer(spec, state, *, input_hw=(100, 250),
-                 platforms=("cpu", "tpu")):
+                 platforms=("cpu", "tpu"), disable_platform_check=False):
     """Serialize the inference function to StableHLO bytes.
 
     The batch dimension is exported symbolically (``jax.export.symbolic_shape``)
     so one artifact serves any batch size — the reference's fixed-batch
     DataLoader has no analogue of this.  Parameters ride inside the artifact
     as constants: the file is the whole model.
+
+    ``disable_platform_check`` drops the call-time platform-name match: a
+    PJRT *plugin* presenting a TPU under a different platform name (this
+    container's ``axon`` tunnel) executes tpu-lowered modules fine but would
+    fail the name check.  Off by default — the check is a real safety net on
+    normal hosts.
     """
     import jax
     import jax.numpy as jnp
@@ -82,8 +88,10 @@ def export_infer(spec, state, *, input_hw=(100, 250),
     (b,) = jax_export.symbolic_shape("b")
     x_spec = jax.ShapeDtypeStruct((b, h, w, 1), jnp.float32)
     infer = make_infer_fn(spec, state)
-    exported = jax_export.export(jax.jit(infer),
-                                 platforms=list(platforms))(x_spec)
+    checks = ([jax_export.DisabledSafetyCheck.platform()]
+              if disable_platform_check else [])
+    exported = jax_export.export(jax.jit(infer), platforms=list(platforms),
+                                 disabled_checks=checks)(x_spec)
     return exported.serialize()
 
 

@@ -1,0 +1,132 @@
+"""Deployment-artifact inference benchmark: the exported StableHLO artifact
+vs the in-framework jitted eval step.
+
+The exported artifact (dasmtl/export.py) is the deployment story — this
+measures what it costs to use it: batch throughput at the training batch
+size and small-batch latency (p50/p99), next to the same model run through
+the in-framework eval path.  The reference only gestures at this number
+with commented-out per-sample predict timers (utils.py:258,294 there).
+
+Run:  python scripts/bench_export.py [--batch 256] [--repeats 50]
+Emits one JSON line per row on stdout; progress on stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _percentiles(samples_s):
+    import numpy as np
+
+    arr = np.asarray(samples_s) * 1e3
+    return round(float(np.percentile(arr, 50)), 3), \
+        round(float(np.percentile(arr, 99)), 3)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", type=str, default="MTL")
+    ap.add_argument("--batch", type=int, default=256,
+                    help="throughput batch size")
+    ap.add_argument("--latency_batch", type=int, default=8,
+                    help="small-batch latency probe size")
+    ap.add_argument("--repeats", type=int, default=50,
+                    help="throughput timing iterations")
+    ap.add_argument("--latency_repeats", type=int, default=200,
+                    help="latency probe iterations (p99 needs the larger "
+                         "sample; matches bench_stream's fidelity)")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from dasmtl import export as dexport
+    from dasmtl.config import Config
+    from dasmtl.main import build_state
+    from dasmtl.models.registry import get_model_spec
+
+    raw_backend = jax.default_backend()
+    backend = "tpu" if raw_backend in ("tpu", "axon") else raw_backend
+    print(f"backend={backend} model={args.model}", file=sys.stderr)
+
+    cfg = Config(model=args.model)
+    spec = get_model_spec(cfg.model)
+    state = build_state(cfg, spec)
+
+    t0 = time.perf_counter()
+    # The axon tunnel presents the TPU under its own platform name, which
+    # would fail the artifact's call-time platform-name check even though
+    # the tpu-lowered module runs fine — disable the check there only.
+    blob = dexport.export_infer(
+        spec, state, disable_platform_check=raw_backend not in ("cpu", "tpu"))
+    export_s = time.perf_counter() - t0
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "model.stablehlo")
+        with open(path, "wb") as f:
+            f.write(blob)
+        exported_call = dexport.load_exported(path)
+    in_framework = jax.jit(dexport.make_infer_fn(spec, state))
+
+    rng = np.random.default_rng(0)
+
+    def timed(fn, batch_size, repeats):
+        # Device-resident input (matches bench_stream's latency probe):
+        # timing host->device transfer would measure the tunnel relay, not
+        # inference.
+        x = jax.device_put(
+            rng.normal(size=(batch_size, 100, 250, 1)).astype(np.float32))
+        out = fn(x)  # compile
+        jax.block_until_ready(out)
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = fn(x)
+            jax.block_until_ready(out)
+            times.append(time.perf_counter() - t0)
+        return times
+
+    rows = []
+    for name, fn in (("exported_artifact", exported_call),
+                     ("in_framework_eval", in_framework)):
+        times = timed(fn, args.batch, args.repeats)
+        thr = args.batch * len(times) / sum(times)
+        lat = timed(fn, args.latency_batch, args.latency_repeats)
+        p50, p99 = _percentiles(lat)
+        row = {
+            "metric": f"infer_samples_per_s_{name}",
+            "path": name,
+            "value": round(thr, 2),
+            "unit": "samples/s",
+            "backend": backend,
+            "model": args.model,
+            "batch_size": args.batch,
+            "latency_batch": args.latency_batch,
+            "latency_p50_ms": p50,
+            "latency_p99_ms": p99,
+            "measured_unix": round(time.time(), 1),
+        }
+        if name == "exported_artifact":
+            row["artifact_mb"] = round(len(blob) / 1e6, 2)
+            row["export_s"] = round(export_s, 1)
+        rows.append(row)
+        print(json.dumps(row))
+        print(f"{name}: {thr:,.0f} samples/s (batch {args.batch}); "
+              f"batch-{args.latency_batch} latency p50 {p50} ms / p99 {p99} ms",
+              file=sys.stderr)
+
+    ratio = rows[0]["value"] / rows[1]["value"] if rows[1]["value"] else 0
+    print(f"exported/in-framework throughput ratio: {ratio:.3f}",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

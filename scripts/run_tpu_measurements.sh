@@ -48,6 +48,7 @@ step "e2e_bench_${R}"    python scripts/bench_e2e.py
 step "stream_bench_${R}" python scripts/bench_stream.py
 step "latency_${R}"      python scripts/bench_stream.py --latency
 step "cv_bench_${R}"     python scripts/bench_cv.py
+step "export_bench_${R}" python scripts/bench_export.py
 # Trace capture, then summary post-processing — only from a trace captured
 # intact this run (summarizing a partial/stale trace dir would record wrong
 # evidence), and through step() so a failed summarizer can't truncate a
