@@ -12,7 +12,8 @@ code**:
     exported = jax.export.deserialize(path.read_bytes())
     out = exported.call(x)          # {'distance': [B], 'event': [B], ...}
 
-The artifact is lowered for BOTH ``cpu`` and ``tpu`` platforms, so a model
+The artifact is lowered for ``cpu``, ``tpu`` and the ``axon`` tunnel-plugin
+platforms, so a model
 exported on a CPU dev box serves unchanged on a TPU host (and vice versa).
 
 CLI::
@@ -66,7 +67,8 @@ def make_infer_fn(spec, state) -> Callable:
 
 
 def export_infer(spec, state, *, input_hw=(100, 250),
-                 platforms=("cpu", "tpu"), disable_platform_check=False):
+                 platforms=("cpu", "tpu", "axon"),
+                 disable_platform_check=False):
     """Serialize the inference function to StableHLO bytes.
 
     The batch dimension is exported symbolically (``jax.export.symbolic_shape``)
@@ -74,11 +76,13 @@ def export_infer(spec, state, *, input_hw=(100, 250),
     DataLoader has no analogue of this.  Parameters ride inside the artifact
     as constants: the file is the whole model.
 
-    ``disable_platform_check`` drops the call-time platform-name match: a
-    PJRT *plugin* presenting a TPU under a different platform name (this
-    container's ``axon`` tunnel) executes tpu-lowered modules fine but would
-    fail the name check.  Off by default — the check is a real safety net on
-    normal hosts.
+    Default platforms cover cpu, tpu AND this container's ``axon``
+    TPU-tunnel plugin (a PJRT plugin presents the chip under its own
+    platform name, which the artifact's call-time name check matches
+    literally — the model's ops lower identically for all three).  For a
+    plugin name not known at export time, ``disable_platform_check`` drops
+    the call-time match instead; off by default — the check is a real
+    safety net on normal hosts.
     """
     import jax
     import jax.numpy as jnp
@@ -123,7 +127,7 @@ def main(argv=None) -> int:
     ap.add_argument("--device", type=str, default="auto",
                     choices=("auto", "tpu", "cpu"),
                     help="platform to trace on (the artifact itself is "
-                         "lowered for cpu AND tpu regardless)")
+                         "lowered for cpu/tpu/axon regardless)")
     ap.add_argument("--compute_dtype", type=str, default="float32",
                     help="activation dtype baked into the artifact")
     args = ap.parse_args(argv)
@@ -148,7 +152,7 @@ def main(argv=None) -> int:
     with open(args.out, "wb") as f:
         f.write(blob)
     print(f"exported {args.model} inference ({len(blob)/1e6:.2f} MB, "
-          f"symbolic batch, platforms cpu+tpu) -> {args.out}")
+          f"symbolic batch, platforms cpu+tpu+axon) -> {args.out}")
     return 0
 
 

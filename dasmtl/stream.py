@@ -28,6 +28,15 @@ import numpy as np
 EVENT_NAMES = ("striking", "excavating")
 
 
+def _resolve_stride(stride, window):
+    """Per-axis ``None``/0 stride components fall back to the window size
+    (non-overlapping) — the window itself may only be known late (from an
+    exported artifact's input spec)."""
+    if stride is None:
+        return None
+    return (stride[0] or window[0], stride[1] or window[1])
+
+
 def shard_csv_path(out_csv: str, process_index: int,
                    process_count: int) -> str:
     """The file one host actually writes: per-host ``<base>.p<i>.csv`` shard
@@ -38,13 +47,15 @@ def shard_csv_path(out_csv: str, process_index: int,
     return f"{base}.p{process_index}{ext or '.csv'}"
 
 
-def stream_predict(record: np.ndarray, model_path: str, model: str = "MTL",
+def stream_predict(record: np.ndarray, model_path: Optional[str],
+                   model: str = "MTL",
                    batch_size: int = 256,
                    window: Optional[Tuple[int, int]] = None,
                    stride: Optional[Tuple[int, int]] = None,
                    out_csv: Optional[str] = None,
                    process_index: int = 0, process_count: int = 1,
-                   resident: str = "auto") -> list:
+                   resident: str = "auto",
+                   exported_path: Optional[str] = None) -> list:
     """Run the restored ``model`` over every window of ``record``.
 
     Returns the prediction rows (and writes ``out_csv`` when given).  Library
@@ -58,28 +69,74 @@ def stream_predict(record: np.ndarray, model_path: str, model: str = "MTL",
     multiplied).  "auto" uses it on accelerator backends whenever the record
     is at least window-sized; records smaller than the window keep the
     zero-padding host path.
+
+    ``exported_path`` streams from a self-contained StableHLO artifact
+    (:mod:`dasmtl.export`) instead of a checkpoint: no model rebuild, no
+    weight restore — the artifact IS the compiled model, and its input
+    shape dictates the window.  The artifact's computation is fixed at
+    export time, so the in-graph slicing path is unavailable
+    (``resident="on"`` is rejected; host windowing is used).
     """
     import jax
 
     from dasmtl.config import INPUT_HEIGHT, INPUT_WIDTH, Config
     from dasmtl.data.windowing import (plan_windows, window_batches,
                                        window_index_batches)
-    from dasmtl.main import build_state
     from dasmtl.models.registry import get_model_spec
+
+    if resident not in ("auto", "on", "off"):
+        raise ValueError(f"unknown resident mode {resident!r}")
+    spec = get_model_spec(model)
+
+    if exported_path is not None:
+        if model_path:
+            raise ValueError("pass either exported_path or model_path, "
+                             "not both")
+        if resident == "on":
+            raise ValueError(
+                "resident='on' needs in-graph window slicing, which a "
+                "fixed exported computation cannot provide — stream from a "
+                "checkpoint for the resident path")
+        from jax import export as jax_export
+
+        with open(exported_path, "rb") as f:
+            exported = jax_export.deserialize(bytearray(f.read()))
+        # The artifact's (b, h, w, 1) input spec dictates the window grid.
+        _, ah, aw, _ = exported.in_avals[0].shape
+        window = (int(ah), int(aw))
+        artifact_call = exported.call
+
+        plan = plan_windows(record.shape, window=window,
+                            stride=_resolve_stride(stride, window))
+
+        def forward_artifact(x):
+            out = artifact_call(x)
+            return {k: v for k, v in out.items()
+                    if not k.startswith("log_probs_")}
+
+        batches = window_batches(record, batch_size, plan=plan,
+                                 process_index=process_index,
+                                 process_count=process_count)
+
+        def run(batch):
+            return forward_artifact(batch["x"])
+
+        return _emit(spec, plan, batches, run, out_csv,
+                     process_index, process_count)
+
+    from dasmtl.main import build_state
     from dasmtl.train.checkpoint import restore_weights
 
     window = window or (INPUT_HEIGHT, INPUT_WIDTH)
     cfg = Config(model=model, batch_size=batch_size)
-    spec = get_model_spec(model)
     state = build_state(cfg, spec, input_hw=window)
     if model_path:
         state = restore_weights(state, model_path)
 
-    plan = plan_windows(record.shape, window=window, stride=stride)
+    plan = plan_windows(record.shape, window=window,
+                        stride=_resolve_stride(stride, window))
     variables = {"params": state.params, "batch_stats": state.batch_stats}
 
-    if resident not in ("auto", "on", "off"):
-        raise ValueError(f"unknown resident mode {resident!r}")
     fits = (record.shape[0] >= window[0] and record.shape[1] >= window[1])
     use_resident = fits and (
         resident == "on"
@@ -118,6 +175,14 @@ def stream_predict(record: np.ndarray, model_path: str, model: str = "MTL",
         def run(batch):
             return forward(batch["x"])
 
+    return _emit(spec, plan, batches, run, out_csv,
+                 process_index, process_count)
+
+
+def _emit(spec, plan, batches, run, out_csv,
+          process_index, process_count) -> list:
+    """Collect per-window prediction rows from ``run`` over ``batches``
+    (skipping padding slots) and optionally write the CSV shard."""
     tasks = [t for t, _ in spec.report_tasks]
     fieldnames = ["window_index", "channel_origin", "time_origin", "weight"]
     fieldnames += [f for f, t in (("pred_distance_m", "distance"),
@@ -156,8 +221,13 @@ def main(argv=None) -> int:
                    help=".mat file holding the (channels, time) matrix")
     p.add_argument("--mat_key", type=str, default="data")
     p.add_argument("--model", type=str, default="MTL")
-    p.add_argument("--model_path", type=str, required=True,
+    p.add_argument("--model_path", type=str, default=None,
                    help="checkpoint directory to restore weights from")
+    p.add_argument("--exported", type=str, default=None,
+                   help="stream from a self-contained StableHLO artifact "
+                        "(python -m dasmtl.export) instead of a checkpoint; "
+                        "--model must still name the artifact's model family "
+                        "for the CSV columns")
     p.add_argument("--batch_size", type=int, default=256)
     p.add_argument("--stride_time", type=int, default=None,
                    help="time-axis stride in samples (default: window width, "
@@ -172,6 +242,8 @@ def main(argv=None) -> int:
     p.add_argument("--device", type=str, default="auto",
                    choices=["tpu", "cpu", "auto"])
     args = p.parse_args(argv)
+    if bool(args.model_path) == bool(args.exported):
+        p.error("exactly one of --model_path / --exported is required")
     # Honor --device even when this module is the entry point (the root
     # stream.py wrapper also pre-applies it before any import).
     from dasmtl.utils.platform import apply_device
@@ -184,14 +256,20 @@ def main(argv=None) -> int:
     from dasmtl.data import matio
 
     record = matio.load_mat(args.record, key_list=(args.mat_key,))
-    stride = (args.stride_channels or INPUT_HEIGHT,
-              args.stride_time or INPUT_WIDTH)
+    # Unspecified stride axes default to the ACTUAL window (non-overlapping),
+    # which for --exported comes from the artifact's input spec — hardcoding
+    # INPUT_HEIGHT/WIDTH here would lay a small-window artifact's grid with
+    # gaps.  stream_predict resolves per-axis None against its window.
+    stride = None
+    if args.stride_channels or args.stride_time:
+        stride = (args.stride_channels, args.stride_time)
     out_csv = args.out or (args.record + ".predictions.csv")
     pi, pc = jax.process_index(), jax.process_count()
     rows = stream_predict(
         np.asarray(record), args.model_path, model=args.model,
         batch_size=args.batch_size, stride=stride, out_csv=out_csv,
-        process_index=pi, process_count=pc, resident=args.resident)
+        process_index=pi, process_count=pc, resident=args.resident,
+        exported_path=args.exported)
     print(f"streamed {len(rows)} windows from {record.shape} record "
           f"-> {shard_csv_path(out_csv, pi, pc)}")
     return 0

@@ -62,11 +62,12 @@ def main() -> int:
     state = build_state(cfg, spec)
 
     t0 = time.perf_counter()
-    # The axon tunnel presents the TPU under its own platform name, which
-    # would fail the artifact's call-time platform-name check even though
-    # the tpu-lowered module runs fine — disable the check there only.
+    # Unlisted plugin platform names (anything beyond the default
+    # cpu/tpu/axon set) would fail the artifact's call-time name check —
+    # drop the check for those hosts only.
     blob = dexport.export_infer(
-        spec, state, disable_platform_check=raw_backend not in ("cpu", "tpu"))
+        spec, state,
+        disable_platform_check=raw_backend not in ("cpu", "tpu", "axon"))
     export_s = time.perf_counter() - t0
     with tempfile.TemporaryDirectory() as d:
         path = os.path.join(d, "model.stablehlo")

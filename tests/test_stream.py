@@ -140,3 +140,36 @@ def test_window_index_batches_match_window_batches():
             if i >= 0:
                 np.testing.assert_array_equal(ib["origin"][j],
                                               plan.origin(int(i)))
+
+
+def test_stream_from_exported_artifact_matches_checkpoint(tmp_path):
+    """--exported must yield exactly the rows the checkpoint path yields:
+    same windows, same predictions (the artifact bakes the same weights),
+    with the window grid dictated by the artifact's input spec."""
+    import pytest
+
+    from dasmtl import export as dexport
+
+    cfg = Config(model="MTL", batch_size=4)
+    spec = get_model_spec("MTL")
+    state = build_state(cfg, spec, input_hw=HW)
+    mgr = CheckpointManager(str(tmp_path / "run"))
+    ckpt = mgr.save(state)
+    mgr.wait()
+
+    blob = dexport.export_infer(spec, state, input_hw=HW)
+    artifact = tmp_path / "mtl.stablehlo"
+    artifact.write_bytes(blob)
+
+    rec = np.random.default_rng(2).normal(size=(52, 64 * 3 + 7))
+    want = stream_predict(rec, ckpt, model="MTL", batch_size=4, window=HW,
+                          stride=(52, 32))
+    got = stream_predict(rec, None, model="MTL", batch_size=4,
+                         stride=(52, 32), exported_path=str(artifact))
+    assert got == want
+
+    with pytest.raises(ValueError, match="resident"):
+        stream_predict(rec, None, model="MTL", exported_path=str(artifact),
+                       resident="on")
+    with pytest.raises(ValueError, match="not both"):
+        stream_predict(rec, ckpt, model="MTL", exported_path=str(artifact))
