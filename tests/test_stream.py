@@ -4,6 +4,7 @@ import csv
 import os
 
 import numpy as np
+import pytest
 
 from dasmtl.config import Config
 from dasmtl.data.windowing import plan_windows
@@ -142,34 +143,54 @@ def test_window_index_batches_match_window_batches():
                                               plan.origin(int(i)))
 
 
-def test_stream_from_exported_artifact_matches_checkpoint(tmp_path):
-    """--exported must yield exactly the rows the checkpoint path yields:
-    same windows, same predictions (the artifact bakes the same weights),
-    with the window grid dictated by the artifact's input spec."""
-    import pytest
-
+@pytest.fixture(scope="module")
+def mtl_artifact(tmp_path_factory):
+    """One (checkpoint, exported-artifact) pair shared by the artifact
+    tests — the state build and StableHLO export are the expensive parts,
+    and build_state is deterministic so the artifact and checkpoint hold
+    identical weights."""
     from dasmtl import export as dexport
 
     cfg = Config(model="MTL", batch_size=4)
     spec = get_model_spec("MTL")
     state = build_state(cfg, spec, input_hw=HW)
-    mgr = CheckpointManager(str(tmp_path / "run"))
+    root = tmp_path_factory.mktemp("artifact")
+    mgr = CheckpointManager(str(root / "run"))
     ckpt = mgr.save(state)
     mgr.wait()
+    artifact = root / "mtl.stablehlo"
+    artifact.write_bytes(dexport.export_infer(spec, state, input_hw=HW))
+    return ckpt, str(artifact)
 
-    blob = dexport.export_infer(spec, state, input_hw=HW)
-    artifact = tmp_path / "mtl.stablehlo"
-    artifact.write_bytes(blob)
+
+def test_stream_from_exported_artifact_matches_checkpoint(mtl_artifact):
+    """--exported must yield exactly the rows the checkpoint path yields:
+    same windows, same predictions (the artifact bakes the same weights),
+    with the window grid dictated by the artifact's input spec."""
+    ckpt, artifact = mtl_artifact
 
     rec = np.random.default_rng(2).normal(size=(52, 64 * 3 + 7))
     want = stream_predict(rec, ckpt, model="MTL", batch_size=4, window=HW,
                           stride=(52, 32))
     got = stream_predict(rec, None, model="MTL", batch_size=4,
-                         stride=(52, 32), exported_path=str(artifact))
+                         stride=(52, 32), exported_path=artifact)
     assert got == want
 
     with pytest.raises(ValueError, match="resident"):
-        stream_predict(rec, None, model="MTL", exported_path=str(artifact),
+        stream_predict(rec, None, model="MTL", exported_path=artifact,
                        resident="on")
     with pytest.raises(ValueError, match="not both"):
-        stream_predict(rec, ckpt, model="MTL", exported_path=str(artifact))
+        stream_predict(rec, ckpt, model="MTL", exported_path=artifact)
+
+
+def test_stream_exported_default_stride_is_artifact_window(mtl_artifact):
+    """With no stride given, the grid must default to the ARTIFACT's window
+    (non-overlapping) — not the framework's (100, 250) input size, which
+    would leave coverage gaps for small-window artifacts."""
+    _, artifact = mtl_artifact
+
+    rec = np.random.default_rng(3).normal(size=(52, 64 * 3))
+    rows = stream_predict(rec, None, model="MTL", batch_size=4,
+                          exported_path=artifact)
+    assert len(rows) == 3  # non-overlapping full coverage at stride=window
+    assert sorted(r["time_origin"] for r in rows) == [0, 64, 128]
