@@ -1,0 +1,42 @@
+"""Installable console entry points (``pip install dasmtl`` →
+``dasmtl-train`` / ``dasmtl-test`` / ``dasmtl-stream`` / ``dasmtl-export`` /
+``dasmtl-doctor``).
+
+These are the same surfaces as the repo-root ``train.py``/``test.py``/
+``stream.py`` wrappers (reference parity: reference train.py:5-43,
+test.py:5-39), packaged so an installed framework needs no checkout.
+``--device`` is applied from raw argv before anything imports jax — see
+:func:`dasmtl.utils.platform.apply_device_flag`.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from dasmtl.utils.platform import apply_device_flag
+
+
+def train_main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    apply_device_flag(argv)
+    from dasmtl.config import parse_train_args
+    from dasmtl.main import main_process
+
+    main_process(parse_train_args(argv), is_test=False)
+
+
+def test_main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    apply_device_flag(argv)
+    from dasmtl.config import parse_test_args
+    from dasmtl.main import main_process
+
+    main_process(parse_test_args(argv), is_test=True)
+
+
+def stream_main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    apply_device_flag(argv)
+    from dasmtl.stream import main
+
+    return main(argv)

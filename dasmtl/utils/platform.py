@@ -58,6 +58,23 @@ def apply_device(device: str) -> None:
                           os.environ.get("JAX_PLATFORMS") or None)
 
 
+def apply_device_flag(argv) -> None:
+    """Scan raw ``argv`` for ``--device``/``--device=`` and apply it BEFORE
+    any jax backend initializes — argparse runs too late on hosts whose
+    interpreter startup pre-imports jax with an accelerator plugin (the
+    tunneled-TPU containers), where a blocked plugin init would hang the
+    process before the parsed flag could take effect."""
+    for i, arg in enumerate(argv):
+        if arg == "--device" and i + 1 < len(argv):
+            value = argv[i + 1]
+        elif arg.startswith("--device="):
+            value = arg.split("=", 1)[1]
+        else:
+            continue
+        apply_device(value)
+        return
+
+
 def tunnel_probe(port: int = 8082, timeout_s: float = 3.0) -> str:
     """TCP-probe the TPU tunnel relay named by ``PALLAS_AXON_POOL_IPS``.
 

@@ -13,16 +13,7 @@ var + live jax.config re-pin for hosts that pre-import jax at startup).
 
 import sys
 
-from train import _apply_device_flag
-
-
-def main(argv=None) -> int:
-    argv = list(sys.argv[1:] if argv is None else argv)
-    _apply_device_flag(argv)
-    from dasmtl.stream import main as stream_main
-
-    return stream_main(argv)
-
+from dasmtl.cli import stream_main as main
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -7,20 +7,7 @@ reference's Windows-ism default path ``'E:./dataset/striking_test'``
 (test.py:23) is replaced by a portable default.
 """
 
-import sys
-
-from train import _apply_device_flag
-
-
-def main(argv=None) -> None:
-    argv = list(sys.argv[1:] if argv is None else argv)
-    _apply_device_flag(argv)
-    from dasmtl.config import parse_test_args
-    from dasmtl.main import main_process
-
-    cfg = parse_test_args(argv)
-    main_process(cfg, is_test=True)
-
+from dasmtl.cli import test_main as main
 
 if __name__ == "__main__":
     main()

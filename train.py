@@ -10,38 +10,8 @@ accelerator plugin at interpreter startup, latching the env — also re-pins
 the live ``jax.config``.  ``dasmtl.utils.platform`` itself imports no jax.
 """
 
-import sys
-
-
-def _apply_device_flag(argv) -> None:
-    for i, arg in enumerate(argv):
-        if arg == "--device" and i + 1 < len(argv):
-            value = argv[i + 1]
-        elif arg.startswith("--device="):
-            value = arg.split("=", 1)[1]
-        else:
-            continue
-        # platform.apply_device sets JAX_PLATFORMS AND re-pins the live
-        # jax.config: on hosts whose interpreter startup pre-imports jax
-        # with an accelerator plugin (the tunneled-TPU containers), the env
-        # var alone is already latched and "--device cpu" would still
-        # initialize the plugin — which blocks indefinitely when the
-        # tunnel is down.  dasmtl.utils.platform imports no jax itself.
-        from dasmtl.utils.platform import apply_device
-
-        apply_device(value)
-        return
-
-
-def main(argv=None) -> None:
-    argv = list(sys.argv[1:] if argv is None else argv)
-    _apply_device_flag(argv)
-    from dasmtl.config import parse_train_args
-    from dasmtl.main import main_process
-
-    cfg = parse_train_args(argv)
-    main_process(cfg, is_test=False)
-
+from dasmtl.cli import train_main as main
+from dasmtl.utils.platform import apply_device_flag as _apply_device_flag  # noqa: F401 — back-compat import surface (tests/test_runtime_utils.py)
 
 if __name__ == "__main__":
     main()
