@@ -69,6 +69,7 @@ def _measure_config(batch_size: int, dtype: str, use_pallas: bool,
     from dasmtl.main import build_state
     from dasmtl.models.registry import get_model_spec
     from dasmtl.train.steps import make_train_step
+    from dasmtl.utils.platform import normalize_backend
 
     backend = jax.default_backend()
     device_kind = jax.devices()[0].device_kind
@@ -117,8 +118,7 @@ def _measure_config(batch_size: int, dtype: str, use_pallas: bool,
         "model": model,
         "value": round(samples_per_s, 2),
         "unit": "samples/s",
-        # The axon plugin IS the TPU tunnel; report any other backend as-is.
-        "backend": "tpu" if backend in ("tpu", "axon") else backend,
+        "backend": normalize_backend(backend),
         "device_kind": device_kind,
         "batch_size": batch_size,
         "compute_dtype": dtype,
@@ -339,20 +339,7 @@ def main() -> int:
             }))
             return 1
 
-    baseline = None
-    try:
-        with open(os.path.join(_REPO, "BASELINE.json")) as f:
-            published = json.load(f).get("published", {})
-        # Compare like with like: a CPU-fallback run (TPU tunnel busy) is
-        # measured against the recorded CPU number, not the 128k-samples/s
-        # TPU figure — backend is reported alongside either way.  Unknown
-        # backends get no baseline (vs_baseline 1.0) rather than a wrong one.
-        key = {"tpu": "mtl_train_samples_per_s",
-               "cpu": "mtl_train_samples_per_s_cpu"}.get(
-            result.get("backend"))
-        baseline = published.get(key) if key else None
-    except (OSError, json.JSONDecodeError):
-        pass
+    baseline = published_baseline(result.get("backend"))
     result["vs_baseline"] = (round(result["value"] / baseline, 4)
                              if baseline else 1.0)
     # Unmissable marker for readers skimming the JSON: a CPU-fallback capture
@@ -372,6 +359,25 @@ def main() -> int:
             result["last_tpu"] = last
     print(json.dumps(result))
     return 0
+
+
+def published_baseline(backend):
+    """The BASELINE.json ``published`` figure to compare a run against.
+
+    Compare like with like: a CPU-fallback run (TPU tunnel busy) is measured
+    against the recorded CPU number, not the 128k-samples/s TPU figure —
+    backend is reported alongside either way.  Unknown backends get None
+    (vs_baseline 1.0) rather than a wrong one.  Shared with the incremental
+    harvester (scripts/harvest_tpu.py) so the driver headline and harvested
+    artifacts can never disagree on the comparison."""
+    try:
+        with open(os.path.join(_REPO, "BASELINE.json")) as f:
+            published = json.load(f).get("published", {})
+    except (OSError, json.JSONDecodeError):
+        return None
+    key = {"tpu": "mtl_train_samples_per_s",
+           "cpu": "mtl_train_samples_per_s_cpu"}.get(backend)
+    return published.get(key) if key else None
 
 
 def _last_recorded_tpu():

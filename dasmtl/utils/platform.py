@@ -75,6 +75,14 @@ def apply_device_flag(argv) -> None:
         return
 
 
+def normalize_backend(raw: str) -> str:
+    """Canonical backend name for reported rows: the ``axon`` plugin IS the
+    TPU tunnel, so measurements taken on it are TPU evidence.  The single
+    home of that alias — every bench/measurement row and the harvester's
+    TPU-evidence check (``harvest_tpu.artifact_done``) must agree on it."""
+    return "tpu" if raw in ("tpu", "axon") else raw
+
+
 def tunnel_probe(port: int = 8082, timeout_s: float = 3.0) -> str:
     """TCP-probe the TPU tunnel relay named by ``PALLAS_AXON_POOL_IPS``.
 

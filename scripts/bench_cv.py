@@ -45,7 +45,9 @@ def main() -> int:
     from dasmtl.train.cv import CVTrainer
     from dasmtl.train.steps import make_scan_train_step
 
-    backend = jax.default_backend()
+    from dasmtl.utils.platform import normalize_backend
+
+    backend = normalize_backend(jax.default_backend())
     rng = np.random.default_rng(0)
     full = ArraySource(
         rng.normal(size=(args.n, 100, 250, 1)).astype(np.float32),

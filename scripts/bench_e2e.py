@@ -45,7 +45,9 @@ def main() -> int:
     from dasmtl.models.registry import get_model_spec
     from dasmtl.train.loop import Trainer
 
-    backend = jax.default_backend()
+    from dasmtl.utils.platform import normalize_backend
+
+    backend = normalize_backend(jax.default_backend())
     print(f"backend={backend} device={jax.devices()[0].device_kind} "
           f"n={args.n} batch={args.batch} dtype={args.dtype}",
           file=sys.stderr)

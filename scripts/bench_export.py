@@ -54,7 +54,9 @@ def main() -> int:
     from dasmtl.models.registry import get_model_spec
 
     raw_backend = jax.default_backend()
-    backend = "tpu" if raw_backend in ("tpu", "axon") else raw_backend
+    from dasmtl.utils.platform import normalize_backend
+
+    backend = normalize_backend(raw_backend)
     print(f"backend={backend} model={args.model}", file=sys.stderr)
 
     cfg = Config(model=args.model)

@@ -32,7 +32,9 @@ def latency(iters: int = 200) -> int:
     from dasmtl.main import build_state
     from dasmtl.models.registry import get_model_spec
 
-    backend = jax.default_backend()
+    from dasmtl.utils.platform import normalize_backend
+
+    backend = normalize_backend(jax.default_backend())
     cfg = Config(model="MTL")
     spec = get_model_spec(cfg.model)
     state = build_state(cfg, spec)
@@ -60,7 +62,7 @@ def latency(iters: int = 200) -> int:
             "unit": "ms",
             "p50_ms": round(float(p50), 3),
             "p99_ms": round(float(p99), 3),
-            "backend": "tpu" if backend == "axon" else backend,
+            "backend": backend,
             "batch_size": bs,
             "iters": iters,
         }))
@@ -94,7 +96,9 @@ def main() -> int:
     from dasmtl.data.windowing import plan_windows
     from dasmtl.stream import stream_predict
 
-    backend = jax.default_backend()
+    from dasmtl.utils.platform import normalize_backend
+
+    backend = normalize_backend(jax.default_backend())
     rec = np.random.default_rng(0).normal(
         size=(100, args.time_samples)).astype(np.float32)
     plan = plan_windows(rec.shape, stride=(100, args.stride_time))

@@ -1,0 +1,144 @@
+"""Patient claim-watcher around ``harvest_tpu.py``.
+
+Loops: spawn the worker; watch its heartbeat; a worker stale for
+``--stale_s`` is blocked against a dead tunnel (the round-3 failure mode:
+windows last ~1 minute, then every client blocks in a raw TCP read that
+Python signal handlers cannot interrupt) — TERM it, grace, KILL, retry.
+Exits when every stage's artifact exists, when ``artifacts/harvest_stop``
+appears, or at the wall deadline (so it can never contend with the driver's
+own end-of-round ``bench.py`` run).
+
+Kill-safety: a worker blocked in client init holds no chip claim; a worker
+that stalls mid-measure has lost its remote end (the claim dies with the
+orchestrator).  A *live* worker never goes stale — it heartbeats after
+every completed measurement.
+
+Run:  nohup python scripts/harvest_supervisor.py >> artifacts/harvest_supervisor.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# Must match the worker's dir (harvest_tpu.py honors the same override),
+# or a live worker beating elsewhere would be killed as stale every cycle.
+ART = os.environ.get("DASMTL_ART_DIR", os.path.join(_REPO, "artifacts"))
+HEARTBEAT = os.path.join(ART, "harvest_heartbeat")
+STOP = os.path.join(ART, "harvest_stop")
+
+
+def log(msg: str) -> None:
+    print(f"[supervisor {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def heartbeat_state() -> tuple:
+    """(age_s, allowance_s): how long since the worker last made progress,
+    and the extra beat-free stretch its current stage declared legitimate
+    (harvest_tpu.STAGE_ALLOW_S — long single-measurement stages)."""
+    try:
+        age = time.time() - os.path.getmtime(HEARTBEAT)
+    except OSError:
+        return 0.0, 0.0
+    allow = 0.0
+    try:
+        with open(HEARTBEAT) as f:
+            allow = float(json.load(f).get("allow_s", 0.0))
+    except (OSError, ValueError, json.JSONDecodeError, AttributeError):
+        pass
+    return age, allow
+
+
+def all_done() -> bool:
+    from harvest_tpu import STAGES, artifact_done
+
+    return all(artifact_done(f) for _, f, _ in STAGES)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stale_s", type=float, default=480,
+                    help="heartbeat age that counts as a dead worker. Beats "
+                         "happen between measurements, not inside them, so "
+                         "this must exceed the longest legitimate beat-free "
+                         "stretch (a cold-compile-heavy stage like e2e or "
+                         "export over the tunnel). A false-positive kill is "
+                         "cheap — completed stages/configs persist and the "
+                         "persistent XLA compile cache banks even a killed "
+                         "attempt's compiles — so erring low only costs a "
+                         "retry, while erring high delays dead-tunnel "
+                         "detection.")
+    ap.add_argument("--retry_s", type=float, default=60)
+    ap.add_argument("--deadline_h", type=float, default=9.0,
+                    help="hard stop so the supervisor can never contend "
+                         "with the driver's end-of-round bench run")
+    ap.add_argument("--term_grace_s", type=float, default=60)
+    args = ap.parse_args()
+
+    os.makedirs(ART, exist_ok=True)
+    sys.path.insert(0, os.path.join(_REPO, "scripts"))
+    deadline = time.time() + args.deadline_h * 3600
+    worker_cmd = [sys.executable,
+                  os.path.join(_REPO, "scripts", "harvest_tpu.py")]
+    attempt = 0
+    while time.time() < deadline:
+        if os.path.exists(STOP):
+            log("stop file present — exiting")
+            return 0
+        if all_done():
+            log("all artifacts captured — exiting")
+            return 0
+        attempt += 1
+        log(f"attempt #{attempt}: spawning worker")
+        # Fresh heartbeat so this attempt's staleness clock starts now.
+        with open(HEARTBEAT, "w") as f:
+            json.dump({"t": time.time()}, f)
+        proc = subprocess.Popen(worker_cmd, cwd=_REPO)
+
+        def reap(why: str) -> None:
+            log(f"{why} — TERM worker")
+            proc.terminate()
+            try:
+                proc.wait(timeout=args.term_grace_s)
+            except subprocess.TimeoutExpired:
+                log("worker ignored TERM (blocked in native read) — KILL")
+                proc.kill()
+                proc.wait()
+
+        while proc.poll() is None:
+            time.sleep(15)
+            if os.path.exists(STOP):
+                reap("stop file present")
+                return 0
+            if time.time() > deadline:
+                # The deadline exists so nothing of ours can contend with
+                # the driver's end-of-round bench — that includes a still-
+                # running worker, which must die with the supervisor.
+                reap("deadline reached")
+                log("deadline reached — exiting")
+                return 0
+            age, allow = heartbeat_state()
+            if age > max(args.stale_s, allow):
+                reap(f"worker stale ({age:.0f}s, budget "
+                     f"{max(args.stale_s, allow):.0f}s)")
+                break
+        rc = proc.poll()
+        log(f"worker exited rc={rc}")
+        if rc == 0 and all_done():
+            log("harvest complete")
+            return 0
+        time.sleep(args.retry_s)
+    log("deadline reached — exiting")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,152 @@
+"""Harvester plumbing (scripts/harvest_tpu.py) — the pure logic that decides
+what a tunnel window re-captures.  No jax: the measurement stages themselves
+are exercised on the chip by the supervisor, not here."""
+
+import importlib
+import json
+import os
+import sys
+
+import pytest
+
+_SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+
+
+@pytest.fixture
+def harvest(tmp_path, monkeypatch):
+    """Import harvest_tpu with its artifact dir pointed at a scratch dir."""
+    monkeypatch.setenv("DASMTL_ART_DIR", str(tmp_path))
+    monkeypatch.syspath_prepend(_SCRIPTS)
+    sys.modules.pop("harvest_tpu", None)
+    mod = importlib.import_module("harvest_tpu")
+    yield mod
+    sys.modules.pop("harvest_tpu", None)
+
+
+def test_artifact_done_missing_and_invalid(harvest, tmp_path):
+    assert not harvest.artifact_done("nope.json")
+    (tmp_path / "bad.json").write_text("{truncated")
+    assert not harvest.artifact_done("bad.json")
+    (tmp_path / "empty.json").write_text("[]")
+    assert not harvest.artifact_done("empty.json")
+
+
+def test_artifact_done_cpu_rows_stay_pending(harvest, tmp_path):
+    """A CPU-fallback leftover must not satisfy a stage — a live window
+    has to supersede it with TPU evidence."""
+    (tmp_path / "cpu.json").write_text(json.dumps(
+        {"metric": "x", "value": 1.0, "backend": "cpu"}))
+    assert not harvest.artifact_done("cpu.json")
+    (tmp_path / "mixed.json").write_text(json.dumps([
+        {"value": 1.0, "backend": "tpu"},
+        {"value": 2.0, "backend": "cpu"}]))
+    assert not harvest.artifact_done("mixed.json")
+
+
+def test_artifact_done_tpu_rows_count(harvest, tmp_path):
+    (tmp_path / "tpu.json").write_text(json.dumps(
+        {"metric": "x", "value": 1.0, "backend": "tpu"}))
+    assert harvest.artifact_done("tpu.json")
+
+
+def test_artifact_done_error_rows_retry_then_settle(harvest, tmp_path):
+    """A fresh error row keeps the stage pending (one retry); an error row
+    that exhausted its retries is accepted as a real failing-config
+    finding, so an OOMing batch-512 probe can't pin the stage forever."""
+    (tmp_path / "sweep.json").write_text(json.dumps([
+        {"value": 1.0, "backend": "tpu"},
+        {"batch_size": 512, "error": "OOM", "attempts": 1}]))
+    assert not harvest.artifact_done("sweep.json")
+    (tmp_path / "sweep.json").write_text(json.dumps([
+        {"value": 1.0, "backend": "tpu"},
+        {"batch_size": 512, "error": "OOM",
+         "attempts": harvest.MAX_ATTEMPTS}]))
+    assert harvest.artifact_done("sweep.json")
+    # An all-error artifact with fresh errors is NOT done — a stage that
+    # captured zero TPU evidence must re-run.
+    (tmp_path / "err.json").write_text(json.dumps([
+        {"batch_size": 32, "error": "boom", "attempts": 1}]))
+    assert not harvest.artifact_done("err.json")
+
+
+def test_write_artifact_atomic(harvest, tmp_path):
+    harvest.write_artifact("a.json", {"backend": "tpu", "value": 3})
+    assert json.loads((tmp_path / "a.json").read_text())["value"] == 3
+    assert not (tmp_path / "a.json.tmp").exists()
+
+
+def test_capture_main_collects_json_lines(harvest, capsys):
+    def fake_main():
+        print(json.dumps({"metric": "m", "value": 1}))
+        print("diagnostic", file=sys.stderr)
+        print(json.dumps({"metric": "m2", "value": 2}))
+        return 0
+
+    rows = harvest._capture_main(fake_main, ["fake"])
+    assert [r["metric"] for r in rows] == ["m", "m2"]
+    # stdout was captured, not leaked into the harvester's own stdout
+    assert "metric" not in capsys.readouterr().out
+
+
+def test_capture_main_raises_on_nonzero_rc(harvest):
+    with pytest.raises(RuntimeError):
+        harvest._capture_main(lambda: 2, ["fake"])
+
+
+def test_settled_rows_resume_protocol(harvest, tmp_path):
+    """A mid-sweep tunnel death must leave exactly the missing/failed
+    configs to re-measure: TPU success rows and retry-exhausted errors are
+    kept, fresh error rows and CPU smoke rows are re-attempted, a missing
+    partial falls back to the final artifact, neither means fresh start."""
+    keys = ("batch_size", "compute_dtype", "use_pallas")
+    assert harvest._settled_rows("none.partial.json", "none.json",
+                                 keys) == []
+    rows = [
+        {"batch_size": 256, "compute_dtype": "bfloat16",
+         "use_pallas": False, "backend": "tpu", "value": 9.0},
+        {"batch_size": 512, "compute_dtype": "bfloat16",
+         "use_pallas": False, "error": "OOM", "attempts": 1},
+        {"batch_size": 64, "compute_dtype": "bfloat16",
+         "use_pallas": False, "error": "OOM",
+         "attempts": harvest.MAX_ATTEMPTS},
+        {"batch_size": 32, "compute_dtype": "float32",
+         "use_pallas": False, "backend": "cpu", "value": 1.0},
+    ]
+    (tmp_path / "s.partial.json").write_text(json.dumps(rows))
+    kept = harvest._settled_rows("s.partial.json", "s.json", keys)
+    assert sorted(r["batch_size"] for r in kept) == [64, 256]
+    # The fresh error row's attempt count carries into the retry.
+    attempts = harvest._prior_attempts("s.partial.json", "s.json", keys)
+    assert attempts == {(512, "bfloat16", False): 1}
+    # No partial -> the promoted final artifact seeds the same way.
+    (tmp_path / "s.partial.json").rename(tmp_path / "s.json")
+    assert sorted(r["batch_size"] for r in
+                  harvest._settled_rows("s.partial.json", "s.json", keys)
+                  ) == [64, 256]
+
+
+def test_heartbeat_allowance_roundtrip(harvest, tmp_path, monkeypatch):
+    """A long stage's allowance must survive mid-stage beats and be read
+    back by the supervisor's staleness check."""
+    import harvest_supervisor
+
+    monkeypatch.setattr(harvest_supervisor, "HEARTBEAT", harvest.HEARTBEAT)
+    harvest.set_stage_allowance(harvest.STAGE_ALLOW_S["e2e"])
+    try:
+        harvest.beat()
+    finally:
+        harvest.set_stage_allowance(None)
+    age, allow = harvest_supervisor.heartbeat_state()
+    assert age < 5 and allow == harvest.STAGE_ALLOW_S["e2e"]
+    harvest.beat()  # allowance cleared -> back to the default budget
+    _, allow = harvest_supervisor.heartbeat_state()
+    assert allow == 0.0
+
+
+def test_stage_table_covers_the_chain(harvest):
+    """Every artifact the serial chain produced must have a harvester
+    stage, so a short tunnel window can stand in for the whole chain."""
+    names = {n for n, _, _ in harvest.STAGES}
+    assert {"bench", "sweep", "models", "latency", "trace", "export",
+            "stream", "e2e", "cv", "convergence"} <= names
