@@ -370,19 +370,29 @@ def published_baseline(backend):
     (vs_baseline 1.0) rather than a wrong one.  Shared with the incremental
     harvester (scripts/harvest_tpu.py) so the driver headline and harvested
     artifacts can never disagree on the comparison."""
-    try:
-        with open(os.path.join(_REPO, "BASELINE.json")) as f:
-            published = json.load(f).get("published", {})
-    except (OSError, json.JSONDecodeError):
-        return None
     key = {"tpu": "mtl_train_samples_per_s",
            "cpu": "mtl_train_samples_per_s_cpu"}.get(backend)
-    return published.get(key) if key else None
+    return _read_published().get(key) if key else None
+
+
+def _read_published() -> dict:
+    """BASELINE.json's ``published`` block ({} when absent/corrupt) — the
+    single reader for both the baseline comparison and the last-known-TPU
+    fallback."""
+    try:
+        with open(os.path.join(_REPO, "BASELINE.json")) as f:
+            return json.load(f).get("published", {})
+    except (OSError, json.JSONDecodeError):
+        return {}
 
 
 def _last_recorded_tpu():
-    """Newest backend=="tpu" bench row under artifacts/ (written by
-    scripts/run_tpu_measurements.sh), with provenance, or None."""
+    """Newest backend=="tpu" bench row under artifacts/ (written by the
+    measurement chain or the incremental harvester), with provenance;
+    falls back to BASELINE.json's ``published`` TPU entry (an earlier
+    round's live measurement) so a tunnel-down round still records the
+    best-known TPU evidence rather than nothing; None only when neither
+    exists."""
     import glob
 
     best, best_ts = None, None
@@ -405,7 +415,21 @@ def _last_recorded_tpu():
                     "mfu": row.get("mfu"),
                     "source": os.path.relpath(path, _REPO),
                     "recorded_unix": round(ts, 1)}
-    return best
+    if best is not None:
+        return best
+    published = _read_published()
+    value = published.get("mtl_train_samples_per_s")
+    if value is None:
+        return None
+    meta = published.get("mtl_train_samples_per_s_meta", {})
+    return {"value": value, "unit": "samples/s",
+            "step_time_ms": meta.get("step_time_ms"),
+            "mfu": meta.get("mfu"),
+            "source": "BASELINE.json published "
+                      f"({meta.get('measured', 'earlier round')})",
+            # Schema-consistent with artifact-sourced rows; the published
+            # block records a human-readable date, not a unix stamp.
+            "recorded_unix": None}
 
 
 def _multi_config(child_flag: str) -> int:

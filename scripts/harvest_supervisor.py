@@ -85,6 +85,13 @@ def main() -> int:
 
     os.makedirs(ART, exist_ok=True)
     sys.path.insert(0, os.path.join(_REPO, "scripts"))
+    if os.path.exists(STOP):
+        # Consume a stale stop request (it's gitignored, so invisible in
+        # git status): launching the supervisor IS the request to arm; a
+        # leftover file from a previous stop must not silently disarm the
+        # round's harvest.
+        log("consuming stale stop file from a previous stop")
+        os.remove(STOP)
     deadline = time.time() + args.deadline_h * 3600
     worker_cmd = [sys.executable,
                   os.path.join(_REPO, "scripts", "harvest_tpu.py")]

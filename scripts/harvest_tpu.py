@@ -54,6 +54,11 @@ JSONL = os.path.join(ART, f"harvest_{ROUND}.jsonl")
 # batch-512 probe, say) rather than a transient to chase forever.
 MAX_ATTEMPTS = 2
 
+# Set by main() from --force: incremental stages drop their resume seed and
+# re-measure every config (the flag would otherwise only re-run stages whose
+# artifact is missing-or-pending, silently skipping settled configs).
+FORCE = False
+
 
 # Longest legitimately beat-free stretch per stage: the single-measurement
 # stages (a full Trainer epoch loop, the export round-trip) can spend many
@@ -164,13 +169,19 @@ def _vs_baseline(value: float, backend: str) -> float:
     return round(value / base, 4) if base else 1.0
 
 
-def _settled_rows(partial_filename: str, final_filename: str,
-                  keys: tuple) -> list[dict]:
-    """Rows a previous window already settled for an incremental stage
-    (identified by ``keys``): TPU successes and retry-exhausted errors.
-    CPU smoke rows and first-attempt error rows are NOT returned, so they
-    get re-measured.  The partial (an interrupted run) supersedes the
-    final (which may hold retriable error rows from an earlier window)."""
+def _stage_progress(partial_filename: str, final_filename: str,
+                    keys: tuple) -> tuple:
+    """``(settled_rows, pending_errors)`` from a previous window, for an
+    incremental stage whose configs are identified by ``keys``.
+
+    ``settled_rows``: TPU successes and retry-exhausted errors, kept
+    verbatim.  ``pending_errors``: config key -> its error row for errors
+    that still have a retry left — carried so a retry increments the
+    attempt count rather than resetting it, and so rows not yet
+    reattempted when a window dies aren't silently dropped from the next
+    partial.  CPU smoke rows are in neither (fully re-measured).  The
+    partial (an interrupted run) supersedes the final (which may hold
+    retriable error rows from an earlier window)."""
     rows = None
     for name in (partial_filename, final_filename):
         try:
@@ -180,29 +191,50 @@ def _settled_rows(partial_filename: str, final_filename: str,
         except (OSError, json.JSONDecodeError):
             continue
     if not isinstance(rows, list):
-        return []
-    return [r for r in rows
-            if _row_settled(r) and all(k in r for k in keys)]
+        return [], {}
+    rows = [r for r in rows
+            if isinstance(r, dict) and all(k in r for k in keys)]
+    settled = [r for r in rows if _row_settled(r)]
+    pending = {tuple(r[k] for k in keys): r
+               for r in rows if "error" in r and not _row_settled(r)}
+    return settled, pending
 
 
-def _prior_attempts(partial_filename: str, final_filename: str,
-                    keys: tuple) -> dict:
-    """Failed-attempt counts of the PENDING error rows from a previous
-    window, keyed by config, so a retry increments rather than resets."""
-    rows = None
-    for name in (partial_filename, final_filename):
-        try:
-            with open(os.path.join(ART, name)) as f:
-                rows = json.load(f)
-            break
-        except (OSError, json.JSONDecodeError):
+def _run_incremental(configs: list, keys: tuple, partial: str, final: str,
+                     measure, describe) -> list[dict]:
+    """Shared engine of stage_sweep/stage_models: measure every config not
+    yet settled, preserving prior progress, flushing the partial after
+    every config, and promoting to the final artifact BEFORE removing the
+    partial (a kill between those two steps must never lose settled
+    rows)."""
+    rows, pending = ([], {}) if FORCE else _stage_progress(partial, final,
+                                                           keys)
+    done = {tuple(r[k] for k in keys) for r in rows}
+    for config in configs:
+        key = tuple(config)
+        if key in done:
             continue
-    if not isinstance(rows, list):
-        return {}
-    return {tuple(r[k] for k in keys): r.get("attempts", 1)
-            for r in rows
-            if isinstance(r, dict) and "error" in r
-            and not _row_settled(r) and all(k in r for k in keys)}
+        try:
+            r = measure(*config)
+            r["measured_unix"] = round(time.time(), 1)
+        except Exception as exc:  # noqa: BLE001 — record and continue
+            prior = pending.get(key, {})
+            r = dict(zip(keys, config))
+            r.update({"error": repr(exc)[:300],
+                      "attempts": prior.get("attempts", 0) + 1})
+        rows.append(r)
+        pending.pop(key, None)
+        append_jsonl(r)
+        # Un-reattempted pending errors ride along so their attempt counts
+        # survive a mid-stage kill.
+        write_artifact(partial, rows + list(pending.values()))
+        print(f"{describe(*config)}: {r.get('value', 'FAIL')}",
+              file=sys.stderr)
+        beat()
+    write_artifact(final, rows)
+    with contextlib.suppress(OSError):
+        os.remove(os.path.join(ART, partial))
+    return rows
 
 
 def stage_bench():
@@ -239,32 +271,13 @@ def stage_sweep():
         (32, "bfloat16", True),
         (32, "float32", True),
     ]
-    partial = f"sweep_{ROUND}.partial.json"
-    final = f"sweep_{ROUND}.json"
-    key_fields = ("batch_size", "compute_dtype", "use_pallas")
-    rows = _settled_rows(partial, final, key_fields)
-    attempts = _prior_attempts(partial, final, key_fields)
-    done = {tuple(r[k] for k in key_fields) for r in rows}
-    for batch, dtype, pallas in configs:
-        key = (batch, dtype, pallas)
-        if key in done:
-            continue
-        try:
-            r = _measure_config(batch, dtype, pallas, warmup=2, measure=20)
-            r["measured_unix"] = round(time.time(), 1)
-        except Exception as exc:  # noqa: BLE001 — record and continue
-            r = {"batch_size": batch, "compute_dtype": dtype,
-                 "use_pallas": pallas, "error": repr(exc)[:300],
-                 "attempts": attempts.get(key, 0) + 1}
-        rows.append(r)
-        append_jsonl(r)
-        write_artifact(partial, rows)
-        print(f"sweep {batch}/{dtype}/pallas={pallas}: "
-              f"{r.get('value', 'FAIL')}", file=sys.stderr)
-        beat()
-    with contextlib.suppress(OSError):
-        os.remove(os.path.join(ART, partial))
-    return rows
+    return _run_incremental(
+        configs, ("batch_size", "compute_dtype", "use_pallas"),
+        f"sweep_{ROUND}.partial.json", f"sweep_{ROUND}.json",
+        lambda batch, dtype, pallas: _measure_config(
+            batch, dtype, pallas, warmup=2, measure=20),
+        lambda batch, dtype, pallas: f"sweep {batch}/{dtype}/"
+                                     f"pallas={pallas}")
 
 
 def stage_models():
@@ -272,29 +285,15 @@ def stage_models():
     resume protocol as stage_sweep."""
     from bench import _measure_config
 
-    partial = f"models_bench_{ROUND}.partial.json"
-    final = f"models_bench_{ROUND}.json"
-    rows = _settled_rows(partial, final, ("model",))
-    attempts = _prior_attempts(partial, final, ("model",))
-    done = {r["model"] for r in rows}
-    for model in ("single_distance", "single_event", "multi_classifier"):
-        if model in done:
-            continue
-        try:
-            r = _measure_config(256, "bfloat16", use_pallas=False,
-                                warmup=2, measure=20, model=model)
-            r["measured_unix"] = round(time.time(), 1)
-        except Exception as exc:  # noqa: BLE001
-            r = {"model": model, "error": repr(exc)[:300],
-                 "attempts": attempts.get((model,), 0) + 1}
-        rows.append(r)
-        append_jsonl(r)
-        write_artifact(partial, rows)
-        print(f"models {model}: {r.get('value', 'FAIL')}", file=sys.stderr)
-        beat()
-    with contextlib.suppress(OSError):
-        os.remove(os.path.join(ART, partial))
-    return rows
+    return _run_incremental(
+        [(m,) for m in ("single_distance", "single_event",
+                        "multi_classifier")],
+        ("model",),
+        f"models_bench_{ROUND}.partial.json",
+        f"models_bench_{ROUND}.json",
+        lambda model: _measure_config(256, "bfloat16", use_pallas=False,
+                                      warmup=2, measure=20, model=model),
+        lambda model: f"models {model}")
 
 
 def stage_latency():
@@ -400,13 +399,24 @@ def main() -> int:
     ap.add_argument("--stages", type=str, default="",
                     help="comma-separated subset (default: all pending)")
     ap.add_argument("--force", action="store_true",
-                    help="re-run stages whose artifact already exists")
+                    help="re-run stages whose artifact already exists, "
+                         "re-measuring every sweep/models config")
     args = ap.parse_args()
 
+    global FORCE
+    FORCE = args.force
     os.makedirs(ART, exist_ok=True)
     sys.path.insert(0, os.path.join(_REPO, "scripts"))
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dasmtl_jax_cache")
     want = set(args.stages.split(",")) if args.stages else None
+    if want is not None:
+        known = {n for n, _, _ in STAGES}
+        unknown = want - known
+        if unknown:
+            # A typo'd stage name exiting 0 with "all captured" would read
+            # as evidence existing when the stage never ran.
+            ap.error(f"unknown stage(s) {sorted(unknown)}; "
+                     f"known: {sorted(known)}")
     pending = [(n, f, fn) for n, f, fn in STAGES
                if (want is None or n in want)
                and (args.force or not artifact_done(f))]

@@ -94,14 +94,15 @@ def test_capture_main_raises_on_nonzero_rc(harvest):
         harvest._capture_main(lambda: 2, ["fake"])
 
 
-def test_settled_rows_resume_protocol(harvest, tmp_path):
+def test_stage_progress_resume_protocol(harvest, tmp_path):
     """A mid-sweep tunnel death must leave exactly the missing/failed
     configs to re-measure: TPU success rows and retry-exhausted errors are
-    kept, fresh error rows and CPU smoke rows are re-attempted, a missing
-    partial falls back to the final artifact, neither means fresh start."""
+    settled, fresh error rows come back as pending (with their attempt
+    counts), CPU smoke rows are in neither, a missing partial falls back
+    to the final artifact."""
     keys = ("batch_size", "compute_dtype", "use_pallas")
-    assert harvest._settled_rows("none.partial.json", "none.json",
-                                 keys) == []
+    assert harvest._stage_progress("none.partial.json", "none.json",
+                                   keys) == ([], {})
     rows = [
         {"batch_size": 256, "compute_dtype": "bfloat16",
          "use_pallas": False, "backend": "tpu", "value": 9.0},
@@ -114,16 +115,65 @@ def test_settled_rows_resume_protocol(harvest, tmp_path):
          "use_pallas": False, "backend": "cpu", "value": 1.0},
     ]
     (tmp_path / "s.partial.json").write_text(json.dumps(rows))
-    kept = harvest._settled_rows("s.partial.json", "s.json", keys)
-    assert sorted(r["batch_size"] for r in kept) == [64, 256]
-    # The fresh error row's attempt count carries into the retry.
-    attempts = harvest._prior_attempts("s.partial.json", "s.json", keys)
-    assert attempts == {(512, "bfloat16", False): 1}
+    settled, pending = harvest._stage_progress("s.partial.json", "s.json",
+                                               keys)
+    assert sorted(r["batch_size"] for r in settled) == [64, 256]
+    assert list(pending) == [(512, "bfloat16", False)]
+    assert pending[(512, "bfloat16", False)]["attempts"] == 1
     # No partial -> the promoted final artifact seeds the same way.
     (tmp_path / "s.partial.json").rename(tmp_path / "s.json")
-    assert sorted(r["batch_size"] for r in
-                  harvest._settled_rows("s.partial.json", "s.json", keys)
-                  ) == [64, 256]
+    settled, pending = harvest._stage_progress("s.partial.json", "s.json",
+                                               keys)
+    assert sorted(r["batch_size"] for r in settled) == [64, 256]
+    assert list(pending) == [(512, "bfloat16", False)]
+
+
+def test_run_incremental_survives_interrupted_windows(harvest, tmp_path):
+    """The engine behind stage_sweep/stage_models: a window that dies
+    mid-stage must (a) keep measured rows, (b) keep the attempt counts of
+    error rows it never got to re-attempt, and (c) settle a
+    deterministically failing config after exactly MAX_ATTEMPTS failures.
+    Also: the final artifact must exist before the partial is removed
+    (simulated by checking the promoted final after a full pass)."""
+    configs = [("a",), ("b",), ("c",)]
+    keys = ("model",)
+
+    calls = []
+
+    def measure_window1(model):
+        calls.append(model)
+        if model == "a":
+            return {"model": model, "backend": "tpu", "value": 1.0}
+        if model == "b":
+            raise RuntimeError("transient")
+        raise KeyboardInterrupt  # window dies at config c
+
+    try:
+        harvest._run_incremental(configs, keys, "m.partial.json", "m.json",
+                                 measure_window1, lambda m: m)
+    except KeyboardInterrupt:
+        pass
+    # Partial holds the success + b's first-attempt error.
+    partial = json.loads((tmp_path / "m.partial.json").read_text())
+    assert {r["model"] for r in partial} == {"a", "b"}
+    assert not harvest.artifact_done("m.json")
+
+    # Window 2: b fails again (attempt 2 -> settled), c succeeds.
+    def measure_window2(model):
+        calls.append(model)
+        if model == "b":
+            raise RuntimeError("permanent")
+        return {"model": model, "backend": "tpu", "value": 2.0}
+
+    rows = harvest._run_incremental(configs, keys, "m.partial.json",
+                                    "m.json", measure_window2,
+                                    lambda m: m)
+    assert calls == ["a", "b", "c", "b", "c"]  # a never re-measured
+    by_model = {r["model"]: r for r in rows}
+    assert by_model["b"]["attempts"] == harvest.MAX_ATTEMPTS
+    assert by_model["c"]["value"] == 2.0
+    assert not (tmp_path / "m.partial.json").exists()
+    assert harvest.artifact_done("m.json")
 
 
 def test_heartbeat_allowance_roundtrip(harvest, tmp_path, monkeypatch):
@@ -142,6 +192,34 @@ def test_heartbeat_allowance_roundtrip(harvest, tmp_path, monkeypatch):
     harvest.beat()  # allowance cleared -> back to the default budget
     _, allow = harvest_supervisor.heartbeat_state()
     assert allow == 0.0
+
+
+def test_force_re_measures_settled_configs(harvest, tmp_path):
+    (tmp_path / "f.json").write_text(json.dumps(
+        [{"model": "a", "backend": "tpu", "value": 1.0}]))
+    calls = []
+
+    def measure(model):
+        calls.append(model)
+        return {"model": model, "backend": "tpu", "value": 2.0}
+
+    harvest.FORCE = True
+    try:
+        rows = harvest._run_incremental([("a",)], ("model",),
+                                        "f.partial.json", "f.json",
+                                        measure, lambda m: m)
+    finally:
+        harvest.FORCE = False
+    assert calls == ["a"] and rows[0]["value"] == 2.0
+
+
+def test_unknown_stage_name_errors(harvest, monkeypatch, capsys):
+    monkeypatch.setattr(sys, "argv", ["harvest_tpu.py",
+                                      "--stages", "latncy"])
+    with pytest.raises(SystemExit) as exc:
+        harvest.main()
+    assert exc.value.code == 2
+    assert "unknown stage" in capsys.readouterr().err
 
 
 def test_stage_table_covers_the_chain(harvest):

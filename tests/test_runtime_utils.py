@@ -179,6 +179,19 @@ def test_bench_last_recorded_tpu_picks_newest_tpu_row(tmp_path, monkeypatch):
     (art / "bench_r02_tpu.json").unlink()
     assert bench._last_recorded_tpu() is None
 
+    # With no artifact rows, the published block (an earlier round's live
+    # TPU measurement) is the fallback — a tunnel-down round still records
+    # the best-known TPU evidence, clearly labeled with its provenance.
+    (tmp_path / "BASELINE.json").write_text(json.dumps({"published": {
+        "mtl_train_samples_per_s": 128510.56,
+        "mtl_train_samples_per_s_meta": {
+            "step_time_ms": 1.992, "mfu": 0.8078,
+            "measured": "2026-07-29, round 2"}}}))
+    last = bench._last_recorded_tpu()
+    assert last["value"] == 128510.56
+    assert "BASELINE.json published" in last["source"]
+    assert "2026-07-29" in last["source"]
+
 
 def test_bench_run_child_salvages_result_from_stalled_child():
     """Round-2 failure mode: a child that prints its BENCH_RESULT and then
