@@ -42,8 +42,10 @@ def log(msg: str) -> None:
 
 def heartbeat_state() -> tuple:
     """(age_s, allowance_s): how long since the worker last made progress,
-    and the extra beat-free stretch its current stage declared legitimate
-    (harvest_tpu.STAGE_ALLOW_S — long single-measurement stages)."""
+    and the staleness budget its current phase declared (harvest_tpu's
+    INIT_ALLOW_S — short, so fresh tunnel dials catch short windows — or
+    STAGE_ALLOW_S — long, so single-measurement stages aren't
+    kill-looped).  0 when the phase declared none."""
     try:
         age = time.time() - os.path.getmtime(HEARTBEAT)
     except OSError:
@@ -66,17 +68,21 @@ def all_done() -> bool:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--stale_s", type=float, default=480,
-                    help="heartbeat age that counts as a dead worker. Beats "
+                    help="fallback heartbeat-age budget when the worker's "
+                         "current phase declares no allowance (init and "
+                         "long stages declare their own — see "
+                         "harvest_tpu.INIT_ALLOW_S/STAGE_ALLOW_S). Beats "
                          "happen between measurements, not inside them, so "
-                         "this must exceed the longest legitimate beat-free "
-                         "stretch (a cold-compile-heavy stage like e2e or "
-                         "export over the tunnel). A false-positive kill is "
-                         "cheap — completed stages/configs persist and the "
-                         "persistent XLA compile cache banks even a killed "
-                         "attempt's compiles — so erring low only costs a "
-                         "retry, while erring high delays dead-tunnel "
-                         "detection.")
-    ap.add_argument("--retry_s", type=float, default=60)
+                         "budgets must exceed the phase's longest "
+                         "legitimate beat-free stretch. A false-positive "
+                         "kill is cheap — completed stages/configs persist "
+                         "and the persistent XLA compile cache banks even "
+                         "a killed attempt's compiles.")
+    # If windows follow relay restarts (the 03:43-relay / 03:47-window
+    # pattern), a blocked worker dies on its own the moment the relay
+    # restarts (its socket resets), making this respawn delay the critical
+    # path to catching the window that follows.
+    ap.add_argument("--retry_s", type=float, default=30)
     ap.add_argument("--deadline_h", type=float, default=9.0,
                     help="hard stop so the supervisor can never contend "
                          "with the driver's end-of-round bench run")
@@ -133,9 +139,9 @@ def main() -> int:
                 log("deadline reached — exiting")
                 return 0
             age, allow = heartbeat_state()
-            if age > max(args.stale_s, allow):
-                reap(f"worker stale ({age:.0f}s, budget "
-                     f"{max(args.stale_s, allow):.0f}s)")
+            budget = allow or args.stale_s
+            if age > budget:
+                reap(f"worker stale ({age:.0f}s, budget {budget:.0f}s)")
                 break
         rc = proc.poll()
         log(f"worker exited rc={rc}")

@@ -60,14 +60,22 @@ MAX_ATTEMPTS = 2
 FORCE = False
 
 
-# Longest legitimately beat-free stretch per stage: the single-measurement
-# stages (a full Trainer epoch loop, the export round-trip) can spend many
-# minutes inside one unit of work with no spot to beat from.  The allowance
-# rides inside the heartbeat so the supervisor stretches its staleness
-# budget for exactly these stages — long stages aren't kill-looped, short
-# ones keep fast dead-tunnel detection.  A kill that still happens only
-# costs a retry (completed work persists; the XLA compile cache banks even
-# a killed attempt's compiles).
+# Longest legitimately beat-free stretch per phase, declared inside the
+# heartbeat; the supervisor uses it AS the staleness budget for the current
+# phase (its --stale_s is only the fallback when no allowance is set).
+# Two regimes matter:
+#   - init (import jax against the tunnel): ~10-30s on a live tunnel, so a
+#     SHORT budget — a worker blocked in init sits on a connection opened
+#     before any window and likely cannot be answered by a later-restarted
+#     orchestrator, so only killing it and dialing FRESH can catch a new
+#     window.  Budget 150s + retry 30s (+ TERM grace when needed) ≈ a
+#     fresh dial every ~3 min, matched to the observed ~1-2-min windows.
+#   - long single-measurement stages (a full Trainer epoch loop, the
+#     export round-trip): many minutes inside one unit of work with no
+#     spot to beat from — a LONG budget so they aren't kill-looped.
+# A kill that still happens only costs a retry (completed work persists;
+# the XLA compile cache banks even a killed attempt's compiles).
+INIT_ALLOW_S = 150
 STAGE_ALLOW_S = {"export": 900, "stream": 900, "e2e": 1500, "cv": 1500,
                  "convergence": 1500}
 _stage_allowance: float | None = None
@@ -424,6 +432,10 @@ def main() -> int:
         print("harvest: all artifacts already captured", file=sys.stderr)
         return 0
 
+    # The init budget covers the whole tunnel bring-up — import AND the
+    # backend-init calls below (default_backend/devices also block on a
+    # dead tunnel); on a live tunnel the lot takes ~30s.
+    set_stage_allowance(INIT_ALLOW_S)
     beat()
     t0 = time.time()
     import jax  # may block on the tunnel; supervisor watches the heartbeat
@@ -438,6 +450,7 @@ def main() -> int:
         # so a real window still re-captures them).
         print("harvest: backend is CPU — refusing to record", file=sys.stderr)
         return 3
+    set_stage_allowance(None)
     beat()
 
     failed = []
