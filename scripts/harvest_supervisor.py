@@ -65,6 +65,18 @@ def all_done() -> bool:
     return all(artifact_done(f) for _, f, _ in STAGES)
 
 
+def refresh_summary() -> None:
+    """Keep artifacts/HARVEST_SUMMARY_<round>.md current with whatever the
+    last worker captured — evidence stays self-describing even when the
+    harvest outlives the session that armed it."""
+    try:
+        import render_harvest
+
+        render_harvest.main()
+    except Exception as exc:  # noqa: BLE001 — summary is best-effort
+        log(f"summary refresh failed: {exc!r}")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--stale_s", type=float, default=480,
@@ -130,12 +142,14 @@ def main() -> int:
             time.sleep(15)
             if os.path.exists(STOP):
                 reap("stop file present")
+                refresh_summary()
                 return 0
             if time.time() > deadline:
                 # The deadline exists so nothing of ours can contend with
                 # the driver's end-of-round bench — that includes a still-
                 # running worker, which must die with the supervisor.
                 reap("deadline reached")
+                refresh_summary()
                 log("deadline reached — exiting")
                 return 0
             age, allow = heartbeat_state()
@@ -145,6 +159,7 @@ def main() -> int:
                 break
         rc = proc.poll()
         log(f"worker exited rc={rc}")
+        refresh_summary()
         if rc == 0 and all_done():
             log("harvest complete")
             return 0

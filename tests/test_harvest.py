@@ -222,6 +222,35 @@ def test_unknown_stage_name_errors(harvest, monkeypatch, capsys):
     assert "unknown stage" in capsys.readouterr().err
 
 
+def test_pallas_verdict_mechanical_decision(harvest, monkeypatch):
+    """The round-2 verdict asked for the sweep to DECIDE the Pallas gate
+    default; render_harvest computes that decision mechanically from
+    paired on/off rows at production batch sizes."""
+    monkeypatch.syspath_prepend(_SCRIPTS)
+    sys.modules.pop("render_harvest", None)
+    rh = importlib.import_module("render_harvest")
+    try:
+        def rows(gain_at_256, batch=256):
+            return [
+                {"batch_size": batch, "compute_dtype": "bfloat16",
+                 "use_pallas": False, "value": 100.0, "backend": "tpu"},
+                {"batch_size": batch, "compute_dtype": "bfloat16",
+                 "use_pallas": True, "value": 100.0 * (1 + gain_at_256),
+                 "backend": "tpu"},
+            ]
+
+        assert "KEEP DEFAULT OFF" in rh._pallas_verdict(rows(-0.016))
+        assert "KEEP DEFAULT OFF" in rh._pallas_verdict(rows(0.01))
+        assert "MAKE DEFAULT ON" in rh._pallas_verdict(rows(0.05))
+        assert "pending" in rh._pallas_verdict(
+            [{"batch_size": 512, "error": "OOM"}])
+        # Small-batch pairs alone must not produce a confident default.
+        small_only = rh._pallas_verdict(rows(0.5, batch=32))
+        assert "pending" in small_only and "DEFAULT" not in small_only
+    finally:
+        sys.modules.pop("render_harvest", None)
+
+
 def test_stage_table_covers_the_chain(harvest):
     """Every artifact the serial chain produced must have a harvester
     stage, so a short tunnel window can stand in for the whole chain."""
