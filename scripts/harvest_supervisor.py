@@ -49,7 +49,11 @@ def heartbeat_state() -> tuple:
     try:
         age = time.time() - os.path.getmtime(HEARTBEAT)
     except OSError:
-        return 0.0, 0.0
+        # The supervisor writes a fresh heartbeat before every spawn, so a
+        # missing file mid-run means it was deleted (e.g. an artifacts
+        # cleanup) — treat that as infinitely stale rather than fresh, or a
+        # worker blocked against a dead tunnel would never be reaped.
+        return float("inf"), 0.0
     allow = 0.0
     try:
         with open(HEARTBEAT) as f:

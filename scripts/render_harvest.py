@@ -86,20 +86,26 @@ def _pallas_verdict(rows: list) -> str:
             gain = vals[True] / vals[False] - 1.0
             verdicts.append(f"batch {batch}/{dtype}: pallas "
                             f"{'+' if gain >= 0 else ''}{gain * 100:.1f}%")
-            if batch >= 256:
+            # The decision is about the production config specifically
+            # (batch ≥256 AND bfloat16): a float32-only Pallas win must not
+            # flip the default the production dtype would regress under.
+            if batch >= 256 and dtype == "bfloat16":
                 production_gains.append(gain)
     if not verdicts:
         return ("No paired pallas-on/off rows captured yet — decision "
                 "pending.")
     if not production_gains:
-        # Small-batch pairs alone must not produce a confident default —
-        # the decision is about production batch sizes.
-        return (f"{'; '.join(verdicts)}.  No ≥256-batch pairs captured yet "
-                "— decision pending.")
-    decision = ("MAKE DEFAULT ON" if max(production_gains) >= 0.02 else
+        # Small-batch or off-dtype pairs alone must not produce a confident
+        # default — the decision is about the production config.
+        return (f"{'; '.join(verdicts)}.  No ≥256-batch bfloat16 pairs "
+                "captured yet — decision pending.")
+    # Default flips ON only when EVERY production pair clears the bar — a
+    # win at one batch size must not override a regression at another.
+    decision = ("MAKE DEFAULT ON" if min(production_gains) >= 0.02 else
                 "KEEP DEFAULT OFF")
-    return (f"{'; '.join(verdicts)}.  Decision at production batch sizes "
-            f"(≥256): **{decision}** (threshold: ≥2% win).")
+    return (f"{'; '.join(verdicts)}.  Decision at the production config "
+            f"(batch ≥256, bfloat16): **{decision}** (threshold: ≥2% win "
+            "at every ≥256-batch bfloat16 pair).")
 
 
 def render() -> str:

@@ -251,6 +251,69 @@ def test_pallas_verdict_mechanical_decision(harvest, monkeypatch):
         sys.modules.pop("render_harvest", None)
 
 
+def test_pallas_verdict_keys_on_production_dtype(harvest, monkeypatch):
+    """A float32-only Pallas win must not flip the default: the decision is
+    keyed on the production config (batch >=256, bfloat16) specifically."""
+    monkeypatch.syspath_prepend(_SCRIPTS)
+    sys.modules.pop("render_harvest", None)
+    rh = importlib.import_module("render_harvest")
+    try:
+        f32_win_bf16_loss = [
+            {"batch_size": 256, "compute_dtype": "float32",
+             "use_pallas": False, "value": 100.0, "backend": "tpu"},
+            {"batch_size": 256, "compute_dtype": "float32",
+             "use_pallas": True, "value": 110.0, "backend": "tpu"},
+            {"batch_size": 256, "compute_dtype": "bfloat16",
+             "use_pallas": False, "value": 200.0, "backend": "tpu"},
+            {"batch_size": 256, "compute_dtype": "bfloat16",
+             "use_pallas": True, "value": 190.0, "backend": "tpu"},
+        ]
+        assert "KEEP DEFAULT OFF" in rh._pallas_verdict(f32_win_bf16_loss)
+        # A f32-only pair (no bf16 pair at >=256) leaves the decision pending.
+        pending = rh._pallas_verdict(f32_win_bf16_loss[:2])
+        assert "pending" in pending and "DEFAULT" not in pending
+        # A 256-batch win must not override a 512-batch regression: the
+        # default flips only when every production pair clears the bar.
+        mixed_batches = [
+            {"batch_size": 256, "compute_dtype": "bfloat16",
+             "use_pallas": False, "value": 100.0, "backend": "tpu"},
+            {"batch_size": 256, "compute_dtype": "bfloat16",
+             "use_pallas": True, "value": 105.0, "backend": "tpu"},
+            {"batch_size": 512, "compute_dtype": "bfloat16",
+             "use_pallas": False, "value": 100.0, "backend": "tpu"},
+            {"batch_size": 512, "compute_dtype": "bfloat16",
+             "use_pallas": True, "value": 80.0, "backend": "tpu"},
+        ]
+        assert "KEEP DEFAULT OFF" in rh._pallas_verdict(mixed_batches)
+    finally:
+        sys.modules.pop("render_harvest", None)
+
+
+def test_honest_name_for_non_tpu_captures(harvest):
+    """A CPU-smoke capture must never land in a *_tpu-named artifact
+    (round-3 verdict: bench_r03_tpu.json held a backend=cpu row)."""
+    assert harvest.honest_name("bench_r04_tpu.json", "tpu") == \
+        "bench_r04_tpu.json"
+    assert harvest.honest_name("bench_r04_tpu.json", "cpu") == \
+        "bench_r04_cpu_smoke.json"
+    assert harvest.honest_name("convergence_tpu_r04.json", "cpu") == \
+        "convergence_cpu_smoke_r04.json"
+    # Names without a tpu claim pass through untouched.
+    assert harvest.honest_name("sweep_r04.json", "cpu") == "sweep_r04.json"
+
+
+def test_missing_heartbeat_is_infinitely_stale(harvest, monkeypatch,
+                                               tmp_path):
+    """A deleted heartbeat must read as stale, not fresh — otherwise a
+    worker blocked against a dead tunnel is never reaped (r03 advice)."""
+    import harvest_supervisor
+
+    monkeypatch.setattr(harvest_supervisor, "HEARTBEAT",
+                        str(tmp_path / "gone_heartbeat"))
+    age, allow = harvest_supervisor.heartbeat_state()
+    assert age == float("inf") and allow == 0.0
+
+
 def test_stage_table_covers_the_chain(harvest):
     """Every artifact the serial chain produced must have a harvester
     stage, so a short tunnel window can stand in for the whole chain."""
