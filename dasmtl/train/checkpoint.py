@@ -18,6 +18,7 @@ the latest checkpoint.
 
 from __future__ import annotations
 
+import json
 import os
 import re
 from typing import Any, Dict, Optional, Tuple
@@ -168,20 +169,41 @@ def latest_step_path(run_dir: str) -> Optional[str]:
     return os.path.join(ckpt_root, f"step_{max(steps)}")
 
 
+def run_dir_model(run_dir: str) -> Optional[str]:
+    """The model family a run dir belongs to — read from the ``config.json``
+    every run writes (``dasmtl/main.py``), which survives a directory rename;
+    the run-dir *name* is cosmetic.  Legacy fallback: parse the
+    ``model_type=<m>`` naming convention for dirs created without a config
+    (programmatic Trainer use).  ``None`` when neither source knows."""
+    try:
+        with open(os.path.join(run_dir, "config.json")) as f:
+            model = json.load(f).get("model")
+        if model is not None:
+            return str(model)
+    except (OSError, ValueError, AttributeError):
+        # AttributeError: valid JSON that isn't an object — one malformed
+        # run dir must not crash resume discovery for the whole savedir.
+        pass
+    m = re.search(r"model_type=(\S+)",
+                  os.path.basename(os.path.abspath(run_dir)))
+    return m.group(1) if m else None
+
+
 def find_latest_checkpoint(savedir: str,
                            model: Optional[str] = None) -> Optional[str]:
     """The newest ``step_<n>`` checkpoint across every run dir under
     ``savedir`` — the ``--resume`` discovery path.  "Newest" is by checkpoint
     mtime (not run-dir name, which sorts wrongly across year boundaries).
-    When ``model`` is given, only run dirs of that model family are
-    considered (run dirs are named ``... model_type=<model> ...``) so a
-    multi-classifier resume never tries to load MTL weights."""
+    When ``model`` is given, only run dirs of that model family (per
+    :func:`run_dir_model`) are considered, so a multi-classifier resume never
+    tries to load MTL weights."""
     if not os.path.isdir(savedir):
         return None
     best: Optional[str] = None
     best_mtime = -1.0
     for run_name in os.listdir(savedir):
-        if model is not None and f"model_type={model} " not in run_name + " ":
+        if (model is not None
+                and run_dir_model(os.path.join(savedir, run_name)) != model):
             continue
         path = latest_step_path(os.path.join(savedir, run_name))
         if path is None:

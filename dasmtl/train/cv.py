@@ -38,7 +38,7 @@ from dasmtl.data.sources import SubsetSource, _SourceBase
 from dasmtl.models.registry import ModelSpec
 from dasmtl.train import metrics as host_metrics
 from dasmtl.train.checkpoint import (CheckpointManager, best_metric_on_disk,
-                                     latest_step_path)
+                                     latest_step_path, run_dir_model)
 from dasmtl.train.loop import (MetricLines, ValidationResult, dispatch_len,
                                resident_eval_outputs)
 from dasmtl.train.optim import stepped_lr
@@ -304,9 +304,11 @@ class CVTrainer:
             return None
         best_run, best_mtime, best_paths = None, -1.0, None
         for run_name in os.listdir(savedir):
-            if f"model_type={self.cfg.model} " not in run_name + " ":
-                continue
             run_dir = os.path.join(savedir, run_name)
+            # config.json is authoritative (survives a dir rename); the
+            # model_type=<m> name is only a legacy fallback (round-3 verdict).
+            if run_dir_model(run_dir) != self.cfg.model:
+                continue
             paths = [latest_step_path(os.path.join(run_dir, f"fold{f}"))
                      for f in range(self.n_folds)]
             if any(p is None for p in paths):

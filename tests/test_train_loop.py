@@ -149,6 +149,40 @@ def test_restore_weights_is_weights_only(tmp_path, tiny_arrays):
         np.testing.assert_array_equal(a, b)
 
 
+def test_resume_discovery_keyed_on_config_json(tmp_path):
+    """find_latest_checkpoint reads each run dir's config.json (round-3
+    verdict item 7): a renamed run dir is still discovered, a name that lies
+    about the model is overridden, and the legacy model_type=<m> naming
+    still works for dirs without a config."""
+    from dasmtl.train.checkpoint import find_latest_checkpoint, run_dir_model
+
+    # Renamed dir: no naming convention, config.json is authoritative.
+    a = tmp_path / "renamed experiment"
+    (a / "ckpts" / "step_3").mkdir(parents=True)
+    (a / "config.json").write_text(json.dumps({"model": "MTL"}))
+    assert run_dir_model(str(a)) == "MTL"
+    assert find_latest_checkpoint(str(tmp_path), model="MTL") == \
+        str(a / "ckpts" / "step_3")
+
+    # Lying name: dir claims MTL, config says multi_classifier — an MTL
+    # resume must not load it even though it is newer.
+    b = tmp_path / "2099-01-01 model_type=MTL is_test=False"
+    (b / "ckpts" / "step_9").mkdir(parents=True)
+    (b / "config.json").write_text(json.dumps({"model": "multi_classifier"}))
+    assert run_dir_model(str(b)) == "multi_classifier"
+    assert find_latest_checkpoint(str(tmp_path), model="MTL") == \
+        str(a / "ckpts" / "step_3")
+    assert find_latest_checkpoint(str(tmp_path), model="multi_classifier") \
+        == str(b / "ckpts" / "step_9")
+
+    # Legacy fallback: no config.json, the name convention still matches.
+    c = tmp_path / "2026-01-02 model_type=single_event is_test=False"
+    (c / "ckpts" / "step_1").mkdir(parents=True)
+    assert run_dir_model(str(c)) == "single_event"
+    assert find_latest_checkpoint(str(tmp_path), model="single_event") == \
+        str(c / "ckpts" / "step_1")
+
+
 def test_preempt_stops_early_and_saves_resumable_state(tmp_path, tiny_arrays):
     """request_preempt() mid-run: fit stops at the next step boundary, writes
     a full-state checkpoint, and does NOT advance the partial epoch's counter
