@@ -175,9 +175,14 @@ class InceptionE(nn.Module):
 
 
 class InceptionAux(nn.Module):
-    """Auxiliary head; only usable when the Mixed_6e map is >= 5x5 (with the
-    100x250 DAS input it is not — kept for architectural completeness, off by
-    default like the reference's ``aux_logits=False``)."""
+    """Auxiliary head (train-mode only, ``aux_logits=True``).  Geometrically
+    viable only when the Mixed_6e map is >= 17x17 — i.e. >=299x299 inputs,
+    the stock InceptionV3 geometry; with the (100, 250) DAS input it is not,
+    which is why the default matches the reference's ``aux_logits=False``
+    (modelC_multiClassifier.py:36,78-80).  When enabled, its logits ride in
+    the train-mode output tuple and ``losses.multi_classifier_loss`` adds
+    ``AUX_LOSS_WEIGHT`` x its cross-entropy (exercised by
+    ``tests/test_inception.py``)."""
 
     num_classes: int
     dtype: Dtype = jnp.float32

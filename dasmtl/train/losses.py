@@ -43,10 +43,25 @@ def single_task_loss(outputs, batch, task: str):
     return l, {task: l}
 
 
+#: Auxiliary-classifier loss weight when the Inception aux head is enabled —
+#: the standard InceptionV3 training recipe value (the reference never trains
+#: with aux: ``aux_logits=False`` at modelC_multiClassifier.py:36,78-80).
+AUX_LOSS_WEIGHT = 0.4
+
+
 def multi_classifier_loss(outputs, batch):
-    """Cross-entropy on the 32-way mixed label distance + 16*event."""
+    """Cross-entropy on the 32-way mixed label distance + 16*event.
+
+    When the model was built with ``aux_logits=True`` its train-mode forward
+    returns ``(logits, aux_logits)``; the aux head contributes
+    ``AUX_LOSS_WEIGHT``× its own CE on the same mixed label."""
     mixed = mixed_label(batch["distance"], batch["event"])
     logits = outputs[0]
     log_probs = jax.nn.log_softmax(logits, axis=-1)
     l = weighted_nll(log_probs, mixed, batch["weight"])
-    return l, {"mixed": l}
+    parts = {"mixed": l}
+    if len(outputs) > 1:
+        aux_lp = jax.nn.log_softmax(outputs[1], axis=-1)
+        parts["aux"] = weighted_nll(aux_lp, mixed, batch["weight"])
+        l = l + AUX_LOSS_WEIGHT * parts["aux"]
+    return l, parts

