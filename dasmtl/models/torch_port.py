@@ -1,0 +1,143 @@
+"""Port reference PyTorch checkpoints into :class:`TwoLevelNet` variables.
+
+The reference trains ``MTL_Net`` / ``Single_Task_Net`` (reference
+model/modelA_MTL.py:53-174, model/modelB_singleTask.py:53-178) and saves
+``model.state_dict()`` as ``.pth`` (reference utils.py:329-334).  This module
+converts such a state dict — name-for-name, with layout transforms — into the
+``{"params": ..., "batch_stats": ...}`` variables of our Flax ``TwoLevelNet``,
+so a user switching from the reference can carry trained weights across:
+
+- conv kernels: torch OIHW -> Flax HWIO (``transpose(2, 3, 1, 0)``);
+- BatchNorm: ``weight/bias`` -> ``scale/bias`` (params),
+  ``running_mean/running_var`` -> ``mean/var`` (batch_stats);
+  ``num_batches_tracked`` is dropped (momentum is static in both stacks);
+- module names: the reference's ``nn.Sequential`` indices and per-task
+  ``nn.ModuleList`` slots (including the ``att_mask_generato2`` typo at
+  model/modelA_MTL.py:93) map onto our named submodules
+  (``resblock3.conv_bn1`` etc., SURVEY.md §2.2).
+
+The port is strict: every reference tensor must be consumed and every
+destination leaf filled, so a renamed or truncated checkpoint fails loudly
+instead of silently forward-passing garbage.  End-to-end parity of the ported
+forward against the reference network is asserted by
+``tests/test_torch_parity.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Tuple
+
+import numpy as np
+
+# torch nn.Sequential slot layout inside one ``att_generator``
+# (model/modelA_MTL.py:42-50): 0 conv1x1, 1 BN, 3 conv3x3, 4 BN.
+# Stage -> reference attribute name; stage 2 carries the reference's typo.
+_ATT_ATTR = {1: "att_mask_generator1", 2: "att_mask_generato2",
+             3: "att_mask_generator3", 4: "att_mask_generator4"}
+
+
+def _np(v) -> np.ndarray:
+    """Accept torch tensors (without importing torch) or array-likes."""
+    detach = getattr(v, "detach", None)
+    if detach is not None:
+        v = detach()
+    cpu = getattr(v, "cpu", None)
+    if cpu is not None:
+        v = cpu()
+    numpy = getattr(v, "numpy", None)
+    if numpy is not None:
+        v = numpy()
+    return np.asarray(v, dtype=np.float32)
+
+
+class _Consumer:
+    """Strict reader over the state dict: records what was taken so the port
+    can prove nothing was left behind."""
+
+    def __init__(self, sd: Mapping[str, object]):
+        self.sd = dict(sd)
+        self.taken: set = set()
+
+    def take(self, key: str) -> np.ndarray:
+        if key not in self.sd:
+            raise KeyError(f"reference state dict is missing {key!r}")
+        self.taken.add(key)
+        return _np(self.sd[key])
+
+    def has(self, key: str) -> bool:
+        return key in self.sd
+
+    def leftovers(self) -> list:
+        ignorable = {k for k in self.sd if k.endswith("num_batches_tracked")}
+        return sorted(set(self.sd) - self.taken - ignorable)
+
+
+def _conv_kernel(w: np.ndarray) -> np.ndarray:
+    """torch OIHW -> Flax HWIO."""
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+def _conv_bn(c: _Consumer, conv: str, bn: str, bias: bool) -> Tuple[dict, dict]:
+    """One ``ConvBN`` submodule's (params, batch_stats) from torch keys."""
+    conv_p = {"kernel": _conv_kernel(c.take(f"{conv}.weight"))}
+    if bias:
+        conv_p["bias"] = c.take(f"{conv}.bias")
+    params = {"conv": conv_p,
+              "bn": {"scale": c.take(f"{bn}.weight"),
+                     "bias": c.take(f"{bn}.bias")}}
+    stats = {"bn": {"mean": c.take(f"{bn}.running_mean"),
+                    "var": c.take(f"{bn}.running_var")}}
+    return params, stats
+
+
+def port_two_level_state_dict(
+        state_dict: Mapping[str, object],
+        tasks: Tuple[str, ...] = ("distance", "event")) -> dict:
+    """Convert a reference ``MTL_Net`` / ``Single_Task_Net`` state dict into
+    ``TwoLevelNet`` variables.
+
+    ``tasks`` must match the network the checkpoint was trained with:
+    ``("distance", "event")`` for model A, a single-task tuple for model B
+    (the reference stores either as the same module-name layout with one or
+    two ``ModuleList`` slots).
+    """
+    c = _Consumer(state_dict)
+    params: dict = {}
+    stats: dict = {}
+
+    def put(dst: str, sub: Mapping[str, Tuple[dict, dict]]) -> None:
+        params[dst] = {name: p for name, (p, _) in sub.items()}
+        stats[dst] = {name: s for name, (_, s) in sub.items()}
+
+    put("conv1", {"": _conv_bn(c, "conv1.0", "conv1.1", bias=False)})
+    # conv1 has no inner submodule name: flatten the "" level back out.
+    params["conv1"], stats["conv1"] = params["conv1"][""], stats["conv1"][""]
+
+    for i in range(1, 9):
+        sub = {"conv_bn1": _conv_bn(c, f"resblock{i}.left.0",
+                                    f"resblock{i}.left.1", bias=False),
+               "conv_bn2": _conv_bn(c, f"resblock{i}.left.3",
+                                    f"resblock{i}.left.4", bias=False)}
+        if c.has(f"resblock{i}.shortcut.0.weight"):
+            sub["shortcut"] = _conv_bn(c, f"resblock{i}.shortcut.0",
+                                       f"resblock{i}.shortcut.1", bias=False)
+        put(f"resblock{i}", sub)
+
+    for t_idx, task in enumerate(tasks):
+        for k in range(1, 5):
+            att = f"{_ATT_ATTR[k]}.{t_idx}"
+            put(f"{task}_att{k}",
+                {"reduce": _conv_bn(c, f"{att}.0", f"{att}.1", bias=True),
+                 "expand": _conv_bn(c, f"{att}.3", f"{att}.4", bias=True)})
+        for k in range(1, 4):
+            out = f"output_layer{k}.{t_idx}"
+            put(f"{task}_out{k}",
+                {"conv_bn": _conv_bn(c, f"{out}.0", f"{out}.1", bias=False)})
+
+    leftovers = c.leftovers()
+    if leftovers:
+        raise ValueError(
+            f"{len(leftovers)} reference tensors were not consumed by the "
+            f"port (first few: {leftovers[:5]}) — tasks={tasks!r} may not "
+            "match the checkpoint's architecture")
+    return {"params": params, "batch_stats": stats}

@@ -1,0 +1,85 @@
+"""Convert a reference ``.pth`` checkpoint into a dasmtl checkpoint.
+
+The reference saves ``model.state_dict()`` via ``torch.save`` when a run
+crosses its accuracy gate (reference utils.py:329-334).  This tool ports such
+a file — model A (``MTL``) or model B (``single_distance``/``single_event``)
+— into an Orbax checkpoint that ``test.py --model_path`` / ``train.py
+--model_path`` restore directly, so reference users switch frameworks without
+retraining.  Forward-output parity of the port is proven by
+``tests/test_torch_parity.py``.
+
+Run:  python scripts/import_torch_checkpoint.py \
+          --pth <reference_ckpt.pth> --model MTL --out <ckpt_dir>
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+_MODEL_TASKS = {"MTL": ("distance", "event"),
+                "single_distance": ("distance",),
+                "single_event": ("event",)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pth", required=True,
+                    help="reference checkpoint (torch.save'd state_dict)")
+    ap.add_argument("--model", default="MTL", choices=sorted(_MODEL_TASKS),
+                    help="which reference network the checkpoint belongs to "
+                         "(multi_classifier .pth files depend on torchvision "
+                         "block internals and are not portable)")
+    ap.add_argument("--out", required=True, help="output checkpoint dir")
+    args = ap.parse_args()
+
+    # torch only for unpickling; everything after is numpy/JAX.
+    # weights_only: a .pth is a pickle — a state_dict needs no arbitrary
+    # code execution on load.
+    import torch
+
+    state_dict = torch.load(args.pth, map_location="cpu", weights_only=True)
+
+    from dasmtl.config import Config
+    from dasmtl.main import build_state
+    from dasmtl.models.registry import get_model_spec
+    from dasmtl.models.torch_port import port_two_level_state_dict
+    from dasmtl.train.checkpoint import state_payload
+
+    variables = port_two_level_state_dict(state_dict,
+                                          tasks=_MODEL_TASKS[args.model])
+
+    # Fresh TrainState (epoch 0, fresh Adam moments, seeded RNG) carrying the
+    # ported weights — the exact shape --model_path's weights-only restore
+    # expects (dasmtl/train/checkpoint.py restore_weights).
+    import jax
+
+    cfg = Config(model=args.model)
+    state = build_state(cfg, get_model_spec(args.model))
+    for group in ("params", "batch_stats"):
+        tpl = jax.tree.structure(jax.device_get(getattr(state, group)))
+        got = jax.tree.structure(variables[group])
+        if tpl != got:
+            raise SystemExit(f"ported {group} tree does not match the "
+                             f"{args.model} template — wrong --model for "
+                             "this checkpoint?")
+    state = state.replace(params=variables["params"],
+                          batch_stats=variables["batch_stats"])
+
+    import orbax.checkpoint as ocp
+
+    out = os.path.abspath(args.out)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(out, state_payload(state), force=True)
+    ckptr.wait_until_finished()
+    n = sum(v.size for v in jax.tree.leaves(variables["params"]))
+    print(f"imported {args.pth} -> {out} ({args.model}, {n:,} params)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

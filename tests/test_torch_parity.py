@@ -1,0 +1,155 @@
+"""End-to-end numerical parity against the reference networks (round-3
+verdict item 2): load the reference's own ``MTL_Net``/``Single_Task_Net``
+(imported from /root/reference, never copied), port its state dict into our
+``TwoLevelNet`` via :mod:`dasmtl.models.torch_port`, and assert the
+eval-mode forward log-probs agree on random inputs.
+
+This upgrades architectural parity from inferred (param counts, op-level
+checks) to proven: the two stacks compute the same function.
+"""
+
+import os
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dasmtl.models import MTLNet, SingleTaskNet
+from dasmtl.models.torch_port import port_two_level_state_dict
+
+REFERENCE = "/root/reference"
+
+
+@pytest.fixture(scope="module")
+def torch_ref():
+    """The reference's own model modules, imported in place."""
+    import torch
+
+    if REFERENCE not in sys.path:
+        sys.path.insert(0, REFERENCE)
+    from model.modelA_MTL import MTL_Net
+    from model.modelB_singleTask import Single_Task_Net
+
+    return torch, MTL_Net, Single_Task_Net
+
+
+def _randomized(torch, model, batches: int = 3):
+    """Give the torch model non-trivial weights AND running stats: perturb
+    every parameter (BN affine included — fresh init is scale=1/bias=0,
+    which would mask scale/bias mapping bugs), then run train-mode forwards
+    so running_mean/var move off their 0/1 init (which would mask a
+    mean<->var swap)."""
+    g = torch.Generator().manual_seed(7)
+    with torch.no_grad():
+        for p in model.parameters():
+            p.add_(0.05 * torch.randn(p.shape, generator=g))
+    model.train()
+    with torch.no_grad():
+        for _ in range(batches):
+            model(torch.randn(8, 1, 100, 250, generator=g))
+    model.eval()
+    return model
+
+
+def _assert_forward_parity(torch, torch_model, flax_model, tasks, seed=0):
+    torch_model = _randomized(torch, torch_model)
+    variables = port_two_level_state_dict(torch_model.state_dict(),
+                                          tasks=tasks)
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(4, 100, 250, 1)).astype(np.float32)
+    with torch.no_grad():
+        torch_out = torch_model(torch.from_numpy(
+            np.transpose(x, (0, 3, 1, 2))))  # NHWC -> NCHW
+    if not isinstance(torch_out, tuple):
+        torch_out = (torch_out,)
+    flax_out = flax_model.apply(variables, jnp.asarray(x), train=False)
+
+    assert len(torch_out) == len(flax_out) == len(tasks)
+    for task, t_out, f_out in zip(tasks, torch_out, flax_out):
+        t_np, f_np = t_out.numpy(), np.asarray(f_out)
+        assert t_np.shape == f_np.shape
+        np.testing.assert_allclose(
+            f_np, t_np, atol=5e-4, rtol=1e-4,
+            err_msg=f"forward log-probs diverge on task {task}")
+        # The decision the user sees must agree exactly.
+        np.testing.assert_array_equal(f_np.argmax(-1), t_np.argmax(-1))
+
+
+def test_mtl_forward_parity(torch_ref):
+    """Ported reference MTL_Net (model/modelA_MTL.py:53-174) and our MTLNet
+    compute the same log-probs for both tasks."""
+    torch, MTL_Net, _ = torch_ref
+    torch.manual_seed(0)
+    _assert_forward_parity(torch, MTL_Net(), MTLNet(),
+                           ("distance", "event"))
+
+
+@pytest.mark.parametrize("task", ["distance", "event"])
+def test_single_task_forward_parity(torch_ref, task):
+    """Ported reference Single_Task_Net (model/modelB_singleTask.py:53-178)
+    matches SingleTaskNet for either task."""
+    torch, _, Single_Task_Net = torch_ref
+    torch.manual_seed(1)
+    _assert_forward_parity(torch, Single_Task_Net(task=task),
+                           SingleTaskNet(task), (task,))
+
+
+def test_port_is_strict_about_leftovers(torch_ref):
+    """A tasks mismatch (model-B checkpoint into a two-task net) must fail
+    loudly, not forward-pass garbage."""
+    torch, _, Single_Task_Net = torch_ref
+    sd = Single_Task_Net(task="distance").state_dict()
+    with pytest.raises(KeyError):
+        port_two_level_state_dict(sd, tasks=("distance", "event"))
+
+
+def test_port_is_strict_about_missing_keys(torch_ref):
+    torch, MTL_Net, _ = torch_ref
+    sd = MTL_Net().state_dict()
+    sd.pop("resblock3.left.0.weight")
+    with pytest.raises(KeyError):
+        port_two_level_state_dict(sd)
+
+
+def test_import_cli_round_trip(torch_ref, tmp_path, monkeypatch):
+    """scripts/import_torch_checkpoint.py: a reference ``.pth`` becomes an
+    Orbax checkpoint that restore_weights loads bit-identically to the
+    direct port."""
+    import sys as _sys
+
+    import jax
+
+    from dasmtl.config import Config
+    from dasmtl.main import build_state
+    from dasmtl.models.registry import get_model_spec
+    from dasmtl.train.checkpoint import restore_weights
+
+    torch, _, Single_Task_Net = torch_ref
+    torch.manual_seed(3)
+    net = _randomized(torch, Single_Task_Net(task="event"))
+    pth = tmp_path / "ref.pth"
+    torch.save(net.state_dict(), pth)
+
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    monkeypatch.syspath_prepend(scripts)
+    import import_torch_checkpoint
+
+    out = tmp_path / "ckpt"
+    monkeypatch.setattr(_sys, "argv", [
+        "import_torch_checkpoint.py", "--pth", str(pth),
+        "--model", "single_event", "--out", str(out)])
+    assert import_torch_checkpoint.main() == 0
+
+    state = build_state(Config(model="single_event"),
+                        get_model_spec("single_event"))
+    restored = restore_weights(state, str(out))
+    expected = port_two_level_state_dict(net.state_dict(), tasks=("event",))
+    for a, b in zip(jax.tree.leaves(jax.device_get(restored.params)),
+                    jax.tree.leaves(expected["params"])):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(jax.tree.leaves(jax.device_get(restored.batch_stats)),
+                    jax.tree.leaves(expected["batch_stats"])):
+        np.testing.assert_array_equal(a, b)
