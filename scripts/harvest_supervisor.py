@@ -34,6 +34,21 @@ sys.path.insert(0, _REPO)
 ART = os.environ.get("DASMTL_ART_DIR", os.path.join(_REPO, "artifacts"))
 HEARTBEAT = os.path.join(ART, "harvest_heartbeat")
 STOP = os.path.join(ART, "harvest_stop")
+# Tunnel windows follow relay restarts (round-3 observation: relay mtime
+# 03:43 -> window 03:47, gone by 03:48).  Watching the relay file lets the
+# supervisor reap a blocked worker and dial fresh within seconds of a
+# restart instead of waiting out the stale budget + retry sleep — on
+# ~1-minute windows that latency is the difference between evidence and
+# none.
+RELAY = os.environ.get("DASMTL_RELAY_PATH", "/root/.relay.py")
+
+
+def relay_mtime() -> float:
+    """The relay script's mtime (0.0 when absent — no restart signal)."""
+    try:
+        return os.path.getmtime(RELAY)
+    except OSError:
+        return 0.0
 
 
 def log(msg: str) -> None:
@@ -126,6 +141,7 @@ def main() -> int:
             log("all artifacts captured — exiting")
             return 0
         attempt += 1
+        last_relay = relay_mtime()
         log(f"attempt #{attempt}: spawning worker")
         # Fresh heartbeat so this attempt's staleness clock starts now.
         with open(HEARTBEAT, "w") as f:
@@ -143,7 +159,7 @@ def main() -> int:
                 proc.wait()
 
         while proc.poll() is None:
-            time.sleep(15)
+            time.sleep(5)
             if os.path.exists(STOP):
                 reap("stop file present")
                 refresh_summary()
@@ -156,6 +172,13 @@ def main() -> int:
                 refresh_summary()
                 log("deadline reached — exiting")
                 return 0
+            now_relay = relay_mtime()
+            if now_relay != last_relay:
+                # A restart both killed this worker's upstream and likely
+                # opened a short window: dial fresh immediately.
+                last_relay = now_relay
+                reap("relay restarted — fresh dial to catch its window")
+                break
             age, allow = heartbeat_state()
             budget = allow or args.stale_s
             if age > budget:
@@ -167,7 +190,15 @@ def main() -> int:
         if rc == 0 and all_done():
             log("harvest complete")
             return 0
-        time.sleep(args.retry_s)
+        # Relay-aware retry sleep: a restart mid-sleep means a window may be
+        # open right now — stop waiting and dial.
+        slept = 0.0
+        while slept < args.retry_s:
+            time.sleep(2)
+            slept += 2
+            if relay_mtime() != last_relay:
+                log("relay restarted during retry sleep — dialing now")
+                break
     log("deadline reached — exiting")
     return 0
 

@@ -302,6 +302,23 @@ def test_honest_name_for_non_tpu_captures(harvest):
     assert harvest.honest_name("sweep_r04.json", "cpu") == "sweep_r04.json"
 
 
+def test_relay_mtime_signal(harvest, monkeypatch, tmp_path):
+    """The supervisor's relay-restart watch: mtime of the relay script, 0.0
+    when absent (no signal; the retry cadence alone applies)."""
+    import harvest_supervisor
+
+    monkeypatch.setattr(harvest_supervisor, "RELAY",
+                        str(tmp_path / "no_relay.py"))
+    assert harvest_supervisor.relay_mtime() == 0.0
+    relay = tmp_path / "relay.py"
+    relay.write_text("# relay")
+    monkeypatch.setattr(harvest_supervisor, "RELAY", str(relay))
+    first = harvest_supervisor.relay_mtime()
+    assert first > 0.0
+    os.utime(relay, (first + 100, first + 100))  # a restart rewrites it
+    assert harvest_supervisor.relay_mtime() != first
+
+
 def test_missing_heartbeat_is_infinitely_stale(harvest, monkeypatch,
                                                tmp_path):
     """A deleted heartbeat must read as stale, not fresh — otherwise a
