@@ -284,6 +284,9 @@ def stage_sweep():
         (256, "bfloat16", True),
         (512, "bfloat16", False),
         (512, "bfloat16", True),
+        # Scaling probe past the headline batch: does MFU keep climbing?
+        # (An OOM here is itself a finding; the row settles after retries.)
+        (1024, "bfloat16", False),
         (256, "float32", False),
         (32, "bfloat16", False),
         (32, "float32", False),

@@ -126,7 +126,10 @@ def render() -> str:
                   f"{bench.get('batch_size')}, {bench.get('compute_dtype')}, "
                   f"{_fmt(bench.get('step_time_ms'), 3)} ms/step, MFU "
                   f"{_fmt(bench.get('mfu'), 4)}, vs published baseline "
-                  f"{_fmt(bench.get('vs_baseline'), 4)}×.",
+                  f"{_fmt(bench.get('vs_baseline'), 4)}×"
+                  + (f" (median of {bench['repeats']}, IQR "
+                     f"{_fmt(bench.get('iqr_pct'), 1)}%)"
+                     if bench.get("repeats", 1) > 1 else "") + ".",
                   ""]
     else:
         missing.append("bench (flagship train step)")
