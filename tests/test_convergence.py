@@ -6,7 +6,8 @@ test drives the full Trainer on the synthetic tree until the gate is crossed
 and asserts the gated-best checkpoint actually lands, exercising the
 validate -> gate -> ckpts/best path for real (VERDICT round 1, item 6).
 
-A recorded run lives at ``artifacts/convergence_r02.log``.
+Recorded runs live at ``artifacts/convergence_r04.log`` (current code) and
+``artifacts/convergence_r02.log``.
 """
 
 import glob
