@@ -147,6 +147,7 @@ def main() -> int:
         with open(HEARTBEAT, "w") as f:
             json.dump({"t": time.time()}, f)
         proc = subprocess.Popen(worker_cmd, cwd=_REPO)
+        relay_restarted = False
 
         def reap(why: str) -> None:
             log(f"{why} — TERM worker")
@@ -177,6 +178,7 @@ def main() -> int:
                 # A restart both killed this worker's upstream and likely
                 # opened a short window: dial fresh immediately.
                 last_relay = now_relay
+                relay_restarted = True
                 reap("relay restarted — fresh dial to catch its window")
                 break
             age, allow = heartbeat_state()
@@ -190,6 +192,14 @@ def main() -> int:
         if rc == 0 and all_done():
             log("harvest complete")
             return 0
+        if relay_restarted:
+            # The reap itself was triggered by a restart — the window it
+            # opened may be ticking away right now.  Any sleep here (even a
+            # relay-aware one: last_relay was already advanced above, so a
+            # mid-sleep check can't fire for THIS restart) burns it; dial
+            # immediately.
+            log("respawning immediately after relay-restart reap")
+            continue
         # Relay-aware retry sleep: a restart mid-sleep means a window may be
         # open right now — stop waiting and dial.
         slept = 0.0

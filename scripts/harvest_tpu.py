@@ -41,7 +41,9 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-ROUND = os.environ.get("DASMTL_ROUND", "r03")
+from roundinfo import resolve_round
+
+ROUND = resolve_round()
 # Overridable so the stage plumbing can be smoke-tested on CPU into a
 # scratch dir without touching the round's real evidence.
 ART = os.environ.get("DASMTL_ART_DIR", os.path.join(_REPO, "artifacts"))
@@ -101,13 +103,21 @@ def append_jsonl(row: dict) -> None:
         f.write(json.dumps(row) + "\n")
 
 
-def write_artifact(filename: str, obj) -> None:
+def write_artifact(filename: str, obj) -> str:
+    """Atomic JSON write; returns the name actually written.  The
+    backend-honesty rename lives HERE (round-4 advisor, low): every write
+    path — main()'s stage loop and the incremental stages' partial/final
+    writes alike — must route a non-TPU capture away from a ``*_tpu``
+    filename, or a future tpu-named incremental stage would silently
+    reintroduce the round-3 misnaming."""
+    filename = honest_name(filename, _backend())
     path = os.path.join(ART, filename)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(obj, f, indent=1)
         f.write("\n")
     os.replace(tmp, path)
+    return filename
 
 
 def _row_settled(row) -> bool:
@@ -173,12 +183,23 @@ def _capture_main(mod_main, argv: list[str]) -> list[dict]:
 # Order = evidence value per second of tunnel time.
 # --------------------------------------------------------------------------
 
+_BACKEND = None
+
+
 def _backend() -> str:
-    import jax
+    """Resolved once per process (the backend cannot change under a live
+    worker).  Caching keeps write_artifact's honesty rename jax-free for
+    tests: the test fixture injects ``_BACKEND`` so the pure-logic suite
+    never triggers jax init (which on this host dials the axon tunnel and
+    can block)."""
+    global _BACKEND
+    if _BACKEND is None:
+        import jax
 
-    from dasmtl.utils.platform import normalize_backend
+        from dasmtl.utils.platform import normalize_backend
 
-    return normalize_backend(jax.default_backend())
+        _BACKEND = normalize_backend(jax.default_backend())
+    return _BACKEND
 
 
 def _vs_baseline(value: float, backend: str) -> float:
@@ -483,8 +504,7 @@ def main() -> int:
                           "measured_unix": round(time.time(), 1)})
             beat()
             continue
-        out_name = honest_name(filename, _backend())
-        write_artifact(out_name, obj)
+        out_name = write_artifact(filename, obj)
         beat()
         print(f"harvest: stage {name} done in {time.time() - t0:.1f}s "
               f"-> artifacts/{out_name}", file=sys.stderr)

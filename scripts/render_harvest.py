@@ -21,7 +21,9 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-ROUND = os.environ.get("DASMTL_ROUND", "r03")
+from roundinfo import resolve_round
+
+ROUND = resolve_round()
 ART = os.environ.get("DASMTL_ART_DIR", os.path.join(_REPO, "artifacts"))
 
 

@@ -20,6 +20,10 @@ def harvest(tmp_path, monkeypatch):
     monkeypatch.syspath_prepend(_SCRIPTS)
     sys.modules.pop("harvest_tpu", None)
     mod = importlib.import_module("harvest_tpu")
+    # Keep this suite jax-free: write_artifact's honesty rename consults
+    # _backend(), which would otherwise trigger jax init (and on this host,
+    # an axon-tunnel dial that can block).
+    mod._BACKEND = "cpu"
     yield mod
     sys.modules.pop("harvest_tpu", None)
 
@@ -337,3 +341,50 @@ def test_stage_table_covers_the_chain(harvest):
     names = {n for n, _, _ in harvest.STAGES}
     assert {"bench", "sweep", "models", "latency", "trace", "export",
             "stream", "e2e", "cv", "convergence"} <= names
+
+
+def test_round_resolution_env_file_and_error(monkeypatch, tmp_path):
+    """r04 verdict weak #2: launching the harvest bare must never file a
+    new round's evidence under an old round's names.  Resolution order is
+    DASMTL_ROUND env > committed ROUND file > hard error."""
+    monkeypatch.syspath_prepend(_SCRIPTS)
+    import roundinfo
+
+    monkeypatch.setenv("DASMTL_ROUND", "r99")
+    assert roundinfo.resolve_round() == "r99"
+
+    monkeypatch.delenv("DASMTL_ROUND")
+    # The committed ROUND file is authoritative when the env is unset.
+    with open(roundinfo._ROUND_FILE) as f:
+        assert roundinfo.resolve_round() == f.read().strip()
+
+    monkeypatch.setattr(roundinfo, "_ROUND_FILE",
+                        str(tmp_path / "no_round_here"))
+    with pytest.raises(RuntimeError, match="no round tag"):
+        roundinfo.resolve_round()
+
+    monkeypatch.setenv("DASMTL_ROUND", "round5")
+    with pytest.raises(RuntimeError, match="invalid round tag"):
+        roundinfo.resolve_round()
+
+
+def test_harvester_round_tracks_round_file(harvest):
+    """harvest_tpu must take its round from the resolver, not a stale
+    hard-coded default (how r04 nearly misfiled into harvest_r03.jsonl)."""
+    import roundinfo
+
+    assert harvest.ROUND == roundinfo.resolve_round()
+    assert harvest.JSONL.endswith(f"harvest_{harvest.ROUND}.jsonl")
+
+
+def test_write_artifact_renames_non_tpu_capture(harvest, tmp_path):
+    """r04 advisor (low): the backend-honesty rename must hold on EVERY
+    write path, so it lives inside write_artifact itself."""
+    harvest.write_artifact(f"bench_{harvest.ROUND}_tpu.json",
+                           {"backend": "cpu", "value": 1.0})
+    backend = harvest._backend()
+    expected = harvest.honest_name(f"bench_{harvest.ROUND}_tpu.json",
+                                   backend)
+    assert (tmp_path / expected).exists()
+    if backend != "tpu":
+        assert not (tmp_path / f"bench_{harvest.ROUND}_tpu.json").exists()
