@@ -47,7 +47,11 @@ def _np(v) -> np.ndarray:
     numpy = getattr(v, "numpy", None)
     if numpy is not None:
         v = numpy()
-    return np.asarray(v, dtype=np.float32)
+    # Copy, never view: torch's .numpy() shares the tensor's buffer, and
+    # same-dtype asarray would keep sharing it — a later in-place torch
+    # mutation (optimizer.step, BN stat update) would silently rewrite the
+    # ported variables.
+    return np.array(v, dtype=np.float32)
 
 
 class _Consumer:
@@ -134,10 +138,97 @@ def port_two_level_state_dict(
             put(f"{task}_out{k}",
                 {"conv_bn": _conv_bn(c, f"{out}.0", f"{out}.1", bias=False)})
 
+    _assert_no_leftovers(
+        c, "two-level",
+        hint=f"tasks={tasks!r} may not match the checkpoint's architecture")
+    return {"params": params, "batch_stats": stats}
+
+
+def _assert_no_leftovers(c: _Consumer, what: str, hint: str = "") -> None:
     leftovers = c.leftovers()
     if leftovers:
         raise ValueError(
             f"{len(leftovers)} reference tensors were not consumed by the "
-            f"port (first few: {leftovers[:5]}) — tasks={tasks!r} may not "
-            "match the checkpoint's architecture")
+            f"{what} port (first few: {leftovers[:5]})"
+            + (f" — {hint}" if hint else ""))
+
+
+# torchvision-layout branch names per mixed-block attribute (reference
+# model/modelC_multiClassifier.py:70-83 wires InceptionA..E from torchvision,
+# so the saved state-dict keys are plain torchvision strings; our
+# models/inception.py mirrors those names module-for-module).
+_INCEPTION_BRANCHES = {
+    "Mixed_5b": ("branch1x1", "branch5x5_1", "branch5x5_2", "branch3x3dbl_1",
+                 "branch3x3dbl_2", "branch3x3dbl_3", "branch_pool"),
+    "Mixed_6a": ("branch3x3", "branch3x3dbl_1", "branch3x3dbl_2",
+                 "branch3x3dbl_3"),
+    "Mixed_6b": ("branch1x1", "branch7x7_1", "branch7x7_2", "branch7x7_3",
+                 "branch7x7dbl_1", "branch7x7dbl_2", "branch7x7dbl_3",
+                 "branch7x7dbl_4", "branch7x7dbl_5", "branch_pool"),
+    "Mixed_7a": ("branch3x3_1", "branch3x3_2", "branch7x7x3_1",
+                 "branch7x7x3_2", "branch7x7x3_3", "branch7x7x3_4"),
+    "Mixed_7b": ("branch1x1", "branch3x3_1", "branch3x3_2a", "branch3x3_2b",
+                 "branch3x3dbl_1", "branch3x3dbl_2", "branch3x3dbl_3a",
+                 "branch3x3dbl_3b", "branch_pool"),
+}
+_INCEPTION_BRANCHES["Mixed_5c"] = _INCEPTION_BRANCHES["Mixed_5b"]
+_INCEPTION_BRANCHES["Mixed_5d"] = _INCEPTION_BRANCHES["Mixed_5b"]
+for _m in ("Mixed_6c", "Mixed_6d", "Mixed_6e"):
+    _INCEPTION_BRANCHES[_m] = _INCEPTION_BRANCHES["Mixed_6b"]
+_INCEPTION_BRANCHES["Mixed_7c"] = _INCEPTION_BRANCHES["Mixed_7b"]
+
+_INCEPTION_STEM = ("Conv2d_1a_3x3", "Conv2d_2a_3x3", "Conv2d_2b_3x3",
+                   "Conv2d_3b_1x1", "Conv2d_4a_3x3")
+
+
+def _dense(c: _Consumer, prefix: str) -> dict:
+    """torch Linear [out, in] -> Flax Dense {kernel [in, out], bias}."""
+    return {"kernel": np.transpose(c.take(f"{prefix}.weight"), (1, 0)),
+            "bias": c.take(f"{prefix}.bias")}
+
+
+def port_inception_state_dict(state_dict: Mapping[str, object]) -> dict:
+    """Convert a reference ``Multi_Classifier`` (model C) state dict into
+    :class:`~dasmtl.models.inception.InceptionV3Classifier` variables.
+
+    The reference assembles torchvision's InceptionV3 blocks around a
+    1-channel stem (model/modelC_multiClassifier.py:63-86) and loads saved
+    ``.pth`` files the same way as models A/B (reference utils.py:122-123).
+    The state-dict keys are torchvision-layout strings
+    (``Mixed_5b.branch1x1.conv.weight`` ...), which our Flax module tree
+    mirrors name-for-name — so the port needs no torchvision import: every
+    ``BasicConv2d`` becomes ``{conv.kernel (OIHW->HWIO), bn.scale/bias}`` +
+    running stats, and the two Linear heads transpose to Dense kernels.
+
+    ``AuxLogits.*`` keys are ported when present (a checkpoint trained with
+    ``aux_logits=True``); the reference default saves without them
+    (modelC_multiClassifier.py:36).  Same strictness as the two-level port:
+    unconsumed tensors and missing keys both raise.
+    """
+    c = _Consumer(state_dict)
+    params: dict = {}
+    stats: dict = {}
+
+    def put_conv(dst_parent: dict, stats_parent: dict, name: str,
+                 prefix: str) -> None:
+        p, s = _conv_bn(c, f"{prefix}.conv", f"{prefix}.bn", bias=False)
+        dst_parent[name] = p
+        stats_parent[name] = s
+
+    for name in _INCEPTION_STEM:
+        put_conv(params, stats, name, name)
+    for mixed, branches in _INCEPTION_BRANCHES.items():
+        params[mixed], stats[mixed] = {}, {}
+        for b in branches:
+            put_conv(params[mixed], stats[mixed], b, f"{mixed}.{b}")
+    if c.has("AuxLogits.fc.weight"):
+        params["AuxLogits"], stats["AuxLogits"] = {}, {}
+        put_conv(params["AuxLogits"], stats["AuxLogits"], "conv0",
+                 "AuxLogits.conv0")
+        put_conv(params["AuxLogits"], stats["AuxLogits"], "conv1",
+                 "AuxLogits.conv1")
+        params["AuxLogits"]["fc"] = _dense(c, "AuxLogits.fc")
+    params["fc"] = _dense(c, "fc")
+
+    _assert_no_leftovers(c, "Inception")
     return {"params": params, "batch_stats": stats}

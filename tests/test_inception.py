@@ -35,8 +35,12 @@ def test_output_shape_and_logits(model_and_vars):
     m, v = model_and_vars
     (out,) = m.apply(v, jnp.ones((3, 100, 250, 1)), train=False)
     assert out.shape == (3, 32)
-    # Raw logits (CE loss applies log_softmax), not log-probabilities.
-    assert not np.allclose(np.exp(np.asarray(out)).sum(-1), 1.0)
+    # Raw logits (CE loss applies log_softmax), not log-probabilities:
+    # log-probs would logsumexp to exactly 0.  Compared in log space so
+    # untrained-magnitude logits can't overflow exp (r04 advisor).
+    from scipy.special import logsumexp
+
+    assert not np.allclose(logsumexp(np.asarray(out), axis=-1), 0.0)
 
 
 def test_dropout_is_stochastic_in_train_mode(model_and_vars):

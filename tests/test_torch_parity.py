@@ -153,3 +153,144 @@ def test_import_cli_round_trip(torch_ref, tmp_path, monkeypatch):
     for a, b in zip(jax.tree.leaves(jax.device_get(restored.batch_stats)),
                     jax.tree.leaves(expected["batch_stats"])):
         np.testing.assert_array_equal(a, b)
+
+
+def _one_torch_step(torch, model, x_nchw, d, e, lr=1e-3):
+    """The reference's inner loop, verbatim semantics (utils.py:346-374):
+    NLLLoss on log-prob outputs, summed across tasks, one coupled-L2 Adam
+    step (utils.py:133-139 builds exactly this optimizer/criterion pair)."""
+    model.train()
+    opt = torch.optim.Adam(model.parameters(), lr=lr, weight_decay=1e-5)
+    crit = torch.nn.NLLLoss()
+    outs = model(x_nchw)
+    if not isinstance(outs, tuple):
+        outs = (outs,)
+    labels = [t for t in (d, e) if t is not None]
+    loss = sum(crit(o, t) for o, t in zip(outs, labels))
+    opt.zero_grad()
+    loss.backward()
+    opt.step()
+    return float(loss.item())
+
+
+def _one_flax_step(model_name, variables, batch, lr=1e-3):
+    import jax
+
+    from dasmtl.config import Config
+    from dasmtl.main import build_state
+    from dasmtl.models.registry import get_model_spec
+    from dasmtl.train.steps import make_train_step
+
+    spec = get_model_spec(model_name)
+    state = build_state(Config(model=model_name), spec)
+    state = state.replace(params=variables["params"],
+                          batch_stats=variables["batch_stats"])
+    train_step = make_train_step(spec)
+    new_state, metrics = train_step(
+        state, {k: jnp.asarray(v) for k, v in batch.items()},
+        jnp.float32(lr))
+    loss = float(metrics["loss_sum"] / metrics["count"])
+    return jax.device_get(new_state), loss
+
+
+def _assert_tree_close(ported, ours, what, atol, rtol, outlier_abs=None):
+    """Leaf-wise allclose with an optional two-tier rule: Adam's first-step
+    update is ~lr*sign(g), so elements whose true gradient sits at the
+    cross-framework reduction noise floor can legitimately move differently
+    by up to ~2*lr.  That floor is *absolute*, set by the reduction's
+    typical element magnitude (~1e-5 here for summands of ~1e-2 over ~1e5
+    terms, plus the 1e-5*w coupled-decay term), so gradients as large as
+    ~1e-5 can flip sign between stacks.  Permit a <=0.5% fraction of such
+    outliers per leaf, each bounded by ``outlier_abs`` (the sign-flip
+    envelope); everything else must meet the tight tolerance."""
+    import jax
+
+    flat_a, tdef_a = jax.tree.flatten_with_path(ported)
+    flat_b, tdef_b = jax.tree.flatten_with_path(ours)
+    assert tdef_a == tdef_b
+    for (path_a, a), (_, b) in zip(flat_a, flat_b):
+        a, b = np.asarray(a), np.asarray(b)
+        if outlier_abs is None:
+            np.testing.assert_allclose(
+                b, a, atol=atol, rtol=rtol,
+                err_msg=f"{what} diverge after one step at {path_a}")
+            continue
+        close = np.isclose(b, a, atol=atol, rtol=rtol)
+        n_out = int((~close).sum())
+        assert n_out <= max(2, a.size // 200), \
+            f"{what} at {path_a}: {n_out}/{a.size} outside tight tolerance"
+        np.testing.assert_allclose(
+            b[~close], a[~close], atol=outlier_abs,
+            err_msg=f"{what} outliers at {path_a} exceed the Adam "
+                    f"first-step sign-flip envelope")
+
+
+def test_mtl_one_train_step_parity(torch_ref):
+    """One full optimizer step agrees across stacks (the last numerical-
+    parity gap, r04 verdict missing #4): ported weights + the identical
+    batch -> forward + summed NLL + backward + coupled-L2 Adam step +
+    train-mode BN stat update in BOTH stacks -> the loss scalars, updated
+    parameters, and BatchNorm running stats all agree.
+
+    Tolerances: fp32 cross-framework gradients agree to ~1e-6; Adam's
+    first-step update is ~sign(g), so parameters whose true gradient sits
+    at that noise floor can move differently by O(lr) — atol absorbs that
+    for the few dead-gradient leaves, rtol covers everything live.  Torch's
+    running_var is Bessel-corrected (n/(n-1)) while Flax's is biased; at
+    n = B*H*W >= 1e5 per channel that is ~1e-5 relative, inside rtol."""
+    torch, MTL_Net, _ = torch_ref
+    torch.manual_seed(5)
+    net = _randomized(torch, MTL_Net())
+    variables = port_two_level_state_dict(net.state_dict())
+
+    rng = np.random.default_rng(11)
+    B = 4
+    x = rng.normal(size=(B, 100, 250, 1)).astype(np.float32)
+    d = rng.integers(0, 16, size=B)
+    e = rng.integers(0, 2, size=B)
+
+    t_loss = _one_torch_step(torch, net,
+                             torch.from_numpy(np.transpose(x, (0, 3, 1, 2))),
+                             torch.from_numpy(d), torch.from_numpy(e))
+    new_state, f_loss = _one_flax_step(
+        "MTL", variables,
+        {"x": x, "distance": d, "event": e,
+         "weight": np.ones(B, np.float32)})
+
+    assert abs(f_loss - t_loss) < 1e-4, (f_loss, t_loss)
+    expected = port_two_level_state_dict(net.state_dict())
+    _assert_tree_close(expected["params"], new_state.params,
+                       "params", atol=5e-5, rtol=1e-3, outlier_abs=2.5e-3)
+    _assert_tree_close(expected["batch_stats"], new_state.batch_stats,
+                       "BN running stats", atol=1e-5, rtol=1e-3)
+
+
+def test_single_task_one_train_step_parity(torch_ref):
+    """Same one-step check on the single-task family (event head), whose
+    loss is a single NLL term (utils.py:489-502 trains it with the same
+    optimizer/criterion)."""
+    torch, _, Single_Task_Net = torch_ref
+    torch.manual_seed(6)
+    net = _randomized(torch, Single_Task_Net(task="event"))
+    variables = port_two_level_state_dict(net.state_dict(),
+                                          tasks=("event",))
+
+    rng = np.random.default_rng(12)
+    B = 4
+    x = rng.normal(size=(B, 100, 250, 1)).astype(np.float32)
+    e = rng.integers(0, 2, size=B)
+
+    t_loss = _one_torch_step(torch, net,
+                             torch.from_numpy(np.transpose(x, (0, 3, 1, 2))),
+                             None, torch.from_numpy(e))
+    new_state, f_loss = _one_flax_step(
+        "single_event", variables,
+        {"x": x, "event": e, "distance": np.zeros(B, np.int64),
+         "weight": np.ones(B, np.float32)})
+
+    assert abs(f_loss - t_loss) < 1e-4, (f_loss, t_loss)
+    expected = port_two_level_state_dict(net.state_dict(), tasks=("event",))
+    _assert_tree_close(expected["params"], new_state.params,
+                       "params", atol=5e-5, rtol=1e-3, outlier_abs=2.5e-3)
+    _assert_tree_close(expected["batch_stats"], new_state.batch_stats,
+                       "BN running stats", atol=1e-5, rtol=1e-3)
