@@ -23,7 +23,8 @@ sys.path.insert(0, _REPO)
 
 _MODEL_TASKS = {"MTL": ("distance", "event"),
                 "single_distance": ("distance",),
-                "single_event": ("event",)}
+                "single_event": ("event",),
+                "multi_classifier": None}  # torchvision-layout port
 
 
 def main() -> int:
@@ -31,10 +32,13 @@ def main() -> int:
     ap.add_argument("--pth", required=True,
                     help="reference checkpoint (torch.save'd state_dict)")
     ap.add_argument("--model", default="MTL", choices=sorted(_MODEL_TASKS),
-                    help="which reference network the checkpoint belongs to "
-                         "(multi_classifier .pth files depend on torchvision "
-                         "block internals and are not portable)")
+                    help="which reference network the checkpoint belongs to")
     ap.add_argument("--out", required=True, help="output checkpoint dir")
+    ap.add_argument("--strip_aux", action="store_true",
+                    help="drop AuxLogits.* keys from a multi_classifier "
+                         "checkpoint trained with aux_logits=True (the aux "
+                         "head is train-time-only scaffolding; the DAS "
+                         "(100,250) input geometry cannot host it)")
     args = ap.parse_args()
 
     # torch only for unpickling; everything after is numpy/JAX.
@@ -47,11 +51,28 @@ def main() -> int:
     from dasmtl.config import Config
     from dasmtl.main import build_state
     from dasmtl.models.registry import get_model_spec
-    from dasmtl.models.torch_port import port_two_level_state_dict
+    from dasmtl.models.torch_port import (port_inception_state_dict,
+                                          port_two_level_state_dict)
     from dasmtl.train.checkpoint import state_payload
 
-    variables = port_two_level_state_dict(state_dict,
-                                          tasks=_MODEL_TASKS[args.model])
+    if args.model == "multi_classifier":
+        has_aux = any(k.startswith("AuxLogits.") for k in state_dict)
+        if has_aux and args.strip_aux:
+            state_dict = {k: v for k, v in state_dict.items()
+                          if not k.startswith("AuxLogits.")}
+        elif has_aux:
+            # Without stripping, the ported AuxLogits subtree would fail the
+            # template-structure check below with a misleading "wrong
+            # --model" message — name the actual cause and the way out.
+            raise SystemExit(
+                "checkpoint carries an auxiliary head (trained with "
+                "aux_logits=True); the eval model has no such head — "
+                "re-run with --strip_aux to drop the train-time-only "
+                "AuxLogits.* tensors")
+        variables = port_inception_state_dict(state_dict)
+    else:
+        variables = port_two_level_state_dict(state_dict,
+                                              tasks=_MODEL_TASKS[args.model])
 
     # Fresh TrainState (epoch 0, fresh Adam moments, seeded RNG) carrying the
     # ported weights — the exact shape --model_path's weights-only restore
