@@ -82,12 +82,25 @@ def main() -> int:
     cfg = Config(model=args.model)
     state = build_state(cfg, get_model_spec(args.model))
     for group in ("params", "batch_stats"):
-        tpl = jax.tree.structure(jax.device_get(getattr(state, group)))
-        got = jax.tree.structure(variables[group])
-        if tpl != got:
+        tpl_tree = jax.device_get(getattr(state, group))
+        if jax.tree.structure(tpl_tree) != jax.tree.structure(
+                variables[group]):
             raise SystemExit(f"ported {group} tree does not match the "
                              f"{args.model} template — wrong --model for "
                              "this checkpoint?")
+        # Shapes too, or a key-compatible foreign checkpoint (e.g. a stock
+        # 3-channel/1000-class torchvision inception_v3) would import
+        # "successfully" and only explode much later at restore time.
+        for (path, got), (_, tpl) in zip(
+                jax.tree.flatten_with_path(variables[group])[0],
+                jax.tree.flatten_with_path(tpl_tree)[0]):
+            if got.shape != tpl.shape:
+                name = jax.tree_util.keystr(path)
+                raise SystemExit(
+                    f"ported {group} leaf {name} has shape {got.shape}, "
+                    f"but the {args.model} template expects {tpl.shape} — "
+                    "this checkpoint was trained for a different "
+                    "input/class geometry")
     state = state.replace(params=variables["params"],
                           batch_stats=variables["batch_stats"])
 

@@ -232,3 +232,33 @@ def test_import_cli_aux_checkpoint_requires_strip(tmp_path, monkeypatch):
 
     monkeypatch.setattr(sys, "argv", argv + ["--strip_aux"])
     assert import_torch_checkpoint.main() == 0
+
+
+def test_import_cli_rejects_shape_mismatched_checkpoint(tmp_path, monkeypatch,
+                                                        synth_sd):
+    """A key-compatible but shape-mismatched checkpoint (the stock
+    torchvision inception_v3 case: 3-channel stem, 1000-class fc) must fail
+    fast at import with the offending leaf named, not at a later restore."""
+    import os
+    import sys
+
+    import torch
+
+    sd = dict(synth_sd)
+    sd["fc.weight"] = (0.05 * np.random.default_rng(1).normal(
+        size=(1000, 2048))).astype(np.float32)
+    sd["fc.bias"] = np.zeros(1000, np.float32)
+    pth = tmp_path / "foreign.pth"
+    torch.save({k: torch.from_numpy(np.asarray(v)) for k, v in sd.items()},
+               pth)
+
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    monkeypatch.syspath_prepend(scripts)
+    import import_torch_checkpoint
+
+    monkeypatch.setattr(sys, "argv", [
+        "import_torch_checkpoint.py", "--pth", str(pth),
+        "--model", "multi_classifier", "--out", str(tmp_path / "ckpt")])
+    with pytest.raises(SystemExit, match="geometry"):
+        import_torch_checkpoint.main()
