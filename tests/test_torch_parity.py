@@ -225,6 +225,25 @@ def _assert_tree_close(ported, ours, what, atol, rtol, outlier_abs=None):
                     f"first-step sign-flip envelope")
 
 
+def _assert_tree_tracks(ported, ours, what, median_rel, max_abs):
+    """Statistical trajectory-tracking assertion: per leaf, the median
+    relative error (floor 1e-3 so near-zero elements don't dominate) stays
+    under ``median_rel`` and the worst element under ``max_abs``."""
+    import jax
+
+    flat_a, tdef_a = jax.tree.flatten_with_path(ported)
+    flat_b, tdef_b = jax.tree.flatten_with_path(ours)
+    assert tdef_a == tdef_b
+    for (path_a, a), (_, b) in zip(flat_a, flat_b):
+        a, b = np.asarray(a), np.asarray(b)
+        d = np.abs(b - a)
+        med = float(np.median(d / (np.abs(a) + 1e-3)))
+        assert med <= median_rel, \
+            f"{what} at {path_a}: median relative error {med:.2e}"
+        assert float(d.max()) <= max_abs, \
+            f"{what} at {path_a}: max absolute error {d.max():.2e}"
+
+
 def test_mtl_one_train_step_parity(torch_ref):
     """One full optimizer step agrees across stacks (the last numerical-
     parity gap, r04 verdict missing #4): ported weights + the identical
@@ -294,3 +313,96 @@ def test_single_task_one_train_step_parity(torch_ref):
                        "params", atol=5e-5, rtol=1e-3, outlier_abs=2.5e-3)
     _assert_tree_close(expected["batch_stats"], new_state.batch_stats,
                        "BN running stats", atol=1e-5, rtol=1e-3)
+
+
+def test_mtl_training_trajectory_parity(torch_ref):
+    """THREE optimizer steps with distinct batches and a per-step LR change
+    (the stepped schedule arrives as a traced argument in our stack):
+    extends one-step parity to trajectory parity — Adam's bias correction
+    past step 1, BN running-stat accumulation across steps, and the
+    lr-as-argument design all have to agree for the final states to match.
+    Tolerances: the per-step sign-flip envelope (see _assert_tree_close)
+    can accumulate across steps, so the outlier bound is 3x the one-step
+    envelope."""
+    import jax
+
+    from dasmtl.config import Config
+    from dasmtl.main import build_state
+    from dasmtl.models.registry import get_model_spec
+    from dasmtl.train.steps import make_train_step
+
+    torch, MTL_Net, _ = torch_ref
+    torch.manual_seed(9)
+    net = _randomized(torch, MTL_Net())
+    variables = port_two_level_state_dict(net.state_dict())
+
+    rng = np.random.default_rng(21)
+    # The decay lands on step TWO so the (pre-update) step-3 loss
+    # observes its effect: a stack that ignored the traced lr and used
+    # a baked-in constant would produce a different step-2 update and a
+    # visibly different step-3 loss, not just a tolerance-absorbed
+    # final-param delta.
+    B, lrs = 4, (1e-3, 1e-3 / 1.5, 1e-3 / 2.25)
+    batches = [
+        {"x": rng.normal(size=(B, 100, 250, 1)).astype(np.float32),
+         "distance": rng.integers(0, 16, size=B),
+         "event": rng.integers(0, 2, size=B),
+         "weight": np.ones(B, np.float32)}
+        for _ in lrs
+    ]
+
+    net.train()
+    opt = torch.optim.Adam(net.parameters(), lr=lrs[0], weight_decay=1e-5)
+    crit = torch.nn.NLLLoss()
+    t_losses = []
+    for lr, b in zip(lrs, batches):
+        for group in opt.param_groups:
+            group["lr"] = lr
+        out1, out2 = net(torch.from_numpy(
+            np.transpose(b["x"], (0, 3, 1, 2))))
+        loss = (crit(out1, torch.from_numpy(b["distance"]))
+                + crit(out2, torch.from_numpy(b["event"])))
+        opt.zero_grad()
+        loss.backward()
+        opt.step()
+        t_losses.append(float(loss.item()))
+
+    spec = get_model_spec("MTL")
+    state = build_state(Config(model="MTL"), spec)
+    state = state.replace(params=variables["params"],
+                          batch_stats=variables["batch_stats"])
+    train_step = make_train_step(spec)
+    f_losses = []
+    for lr, b in zip(lrs, batches):
+        state, metrics = train_step(
+            state, {k: jnp.asarray(v) for k, v in b.items()},
+            jnp.float32(lr))
+        f_losses.append(float(metrics["loss_sum"] / metrics["count"]))
+
+    np.testing.assert_allclose(f_losses, t_losses, atol=5e-4, rtol=1e-4)
+    final = jax.device_get(state)
+    expected = port_two_level_state_dict(net.state_dict())
+    # Elementwise tolerance counting is the wrong tool once chaos spreads
+    # the per-step sign-flip noise (measured here: per-leaf median relative
+    # error <= 3.4e-3, max absolute <= 4.4e-3 across both groups).  Assert
+    # tracking statistically instead: the per-leaf MEDIAN relative error
+    # catches any systematic bug (wrong bias correction shifts every
+    # element by ~lr, median-rel ~1 vs the observed 3e-3), and the MAX
+    # absolute error bounds the chaos tail.
+    _assert_tree_tracks(expected["params"], final.params, "params",
+                        median_rel=1e-2, max_abs=1e-2)
+    _assert_tree_tracks(expected["batch_stats"], final.batch_stats,
+                        "BN running stats", median_rel=1e-2, max_abs=1e-2)
+
+    # Belt-and-braces: the post-trajectory eval-mode forwards still agree
+    # (uses the final lr's update through both stacks).
+    net.eval()
+    xe = batches[0]["x"]
+    with torch.no_grad():
+        t_out = net(torch.from_numpy(np.transpose(xe, (0, 3, 1, 2))))
+    f_out = final.apply_fn({"params": final.params,
+                            "batch_stats": final.batch_stats},
+                           jnp.asarray(xe), train=False)
+    for t, f in zip(t_out, f_out):
+        np.testing.assert_allclose(np.asarray(f), t.numpy(),
+                                   atol=2e-2, rtol=1e-2)
