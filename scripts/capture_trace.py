@@ -24,14 +24,16 @@ def main() -> int:
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--dtype", type=str, default="bfloat16")
     ap.add_argument("--steps", type=int, default=10)
-    ap.add_argument("--out", type=str,
-                    default=("artifacts/trace_" + os.environ["DASMTL_ROUND"]
-                             if "DASMTL_ROUND" in os.environ
-                             else "artifacts/trace"),
-                    help="trace output dir; round-stamped only when "
-                         "DASMTL_ROUND is set (run_tpu_measurements.sh "
-                         "always passes --out explicitly)")
+    ap.add_argument("--out", type=str, default=None,
+                    help="trace output dir; defaults to "
+                         "artifacts/trace_<round> via the shared round "
+                         "resolver (scripts/roundinfo.py)")
     args = ap.parse_args()
+    if args.out is None:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from roundinfo import resolve_round
+
+        args.out = f"artifacts/trace_{resolve_round()}"
 
     import jax
     import numpy as np

@@ -193,16 +193,19 @@ def _one_flax_step(model_name, variables, batch, lr=1e-3):
     return jax.device_get(new_state), loss
 
 
-def _assert_tree_close(ported, ours, what, atol, rtol, outlier_abs=None):
+def _assert_tree_close(ported, ours, what, atol, rtol, outlier_abs=None,
+                       outlier_floor=2, outlier_fraction=1 / 200):
     """Leaf-wise allclose with an optional two-tier rule: Adam's first-step
     update is ~lr*sign(g), so elements whose true gradient sits at the
     cross-framework reduction noise floor can legitimately move differently
     by up to ~2*lr.  That floor is *absolute*, set by the reduction's
     typical element magnitude (~1e-5 here for summands of ~1e-2 over ~1e5
     terms, plus the 1e-5*w coupled-decay term), so gradients as large as
-    ~1e-5 can flip sign between stacks.  Permit a <=0.5% fraction of such
-    outliers per leaf, each bounded by ``outlier_abs`` (the sign-flip
-    envelope); everything else must meet the tight tolerance."""
+    ~1e-5 can flip sign between stacks.  Permit a small fraction of such
+    outliers per leaf (``outlier_floor``/``outlier_fraction`` — call sites
+    with smaller per-replica reductions raise them), each bounded by
+    ``outlier_abs`` (the sign-flip envelope); everything else must meet
+    the tight tolerance."""
     import jax
 
     flat_a, tdef_a = jax.tree.flatten_with_path(ported)
@@ -217,7 +220,8 @@ def _assert_tree_close(ported, ours, what, atol, rtol, outlier_abs=None):
             continue
         close = np.isclose(b, a, atol=atol, rtol=rtol)
         n_out = int((~close).sum())
-        assert n_out <= max(2, a.size // 200), \
+        assert n_out <= max(outlier_floor,
+                            int(a.size * outlier_fraction)), \
             f"{what} at {path_a}: {n_out}/{a.size} outside tight tolerance"
         np.testing.assert_allclose(
             b[~close], a[~close], atol=outlier_abs,
@@ -406,3 +410,85 @@ def test_mtl_training_trajectory_parity(torch_ref):
     for t, f in zip(t_out, f_out):
         np.testing.assert_allclose(np.asarray(f), t.numpy(),
                                    atol=2e-2, rtol=1e-2)
+
+
+def test_per_replica_step_matches_torch_multi_gpu_semantics(torch_ref):
+    """The ``bn_sync=per_replica`` shard_map step IS the reference's
+    multi-GPU training semantic, proven against torch autograd: torch
+    emulates data-parallel training the way DDP computes it — each of
+    ``R`` replicas forwards its own batch shard in train mode (so
+    BatchNorm normalizes with shard-local statistics), losses combine as
+    the global weighted mean, ONE backward accumulates the averaged
+    gradient — then one coupled-Adam step.  Our side runs the real
+    ``shard_map`` step over a dp=R virtual-device mesh on the identical
+    global batch.  Updated parameters and the loss must agree.
+
+    (BN *running* stats intentionally differ: torch's sequential shard
+    forwards compound the momentum update R times, while the shard_map
+    step takes the replica mean — the documented design choice, pinned by
+    tests/test_bn_sync.py.)
+    """
+    import jax
+
+    from dasmtl.config import Config
+    from dasmtl.main import build_state
+    from dasmtl.models.registry import get_model_spec
+    from dasmtl.parallel.mesh import (create_mesh, replicated_sharding,
+                                      shard_batch)
+    from dasmtl.train.steps import make_train_step
+
+    R = 4
+    if len(jax.devices()) < R:
+        pytest.skip(f"needs {R} virtual devices")
+
+    torch, MTL_Net, _ = torch_ref
+    torch.manual_seed(13)
+    net = _randomized(torch, MTL_Net())
+    variables = port_two_level_state_dict(net.state_dict())
+
+    rng = np.random.default_rng(31)
+    B = 2 * R
+    x = rng.normal(size=(B, 100, 250, 1)).astype(np.float32)
+    d = rng.integers(0, 16, size=B)
+    e = rng.integers(0, 2, size=B)
+
+    # Torch: DDP-equivalent accumulation over per-shard train-mode forwards.
+    net.train()
+    opt = torch.optim.Adam(net.parameters(), lr=1e-3, weight_decay=1e-5)
+    crit = torch.nn.NLLLoss()
+    opt.zero_grad()
+    t_loss = 0.0
+    for r in range(R):
+        sl = slice(r * B // R, (r + 1) * B // R)
+        out1, out2 = net(torch.from_numpy(
+            np.transpose(x[sl], (0, 3, 1, 2))))
+        loss_r = (crit(out1, torch.from_numpy(d[sl]))
+                  + crit(out2, torch.from_numpy(e[sl]))) / R
+        loss_r.backward()
+        t_loss += float(loss_r.item())
+    opt.step()
+
+    # Ours: the real shard_map per-replica step on the dp=R mesh.
+    plan = create_mesh(dp=R, sp=1, devices=jax.devices()[:R])
+    spec = get_model_spec("MTL")
+    state = build_state(Config(model="MTL", batch_size=B), spec)
+    state = state.replace(params=variables["params"],
+                          batch_stats=variables["batch_stats"])
+    state = jax.device_put(state, replicated_sharding(plan))
+    step = make_train_step(spec, mesh_plan=plan, bn_sync="per_replica")
+    batch = shard_batch(plan, {
+        "x": x, "distance": d.astype(np.int32),
+        "event": e.astype(np.int32), "weight": np.ones(B, np.float32)})
+    with plan.mesh:
+        new_state, metrics = step(state, batch, np.float32(1e-3))
+    f_loss = float(jax.device_get(metrics["loss_sum"])
+                   / jax.device_get(metrics["count"]))
+
+    assert abs(f_loss - t_loss) < 1e-4, (f_loss, t_loss)
+    expected = port_two_level_state_dict(net.state_dict())
+    # Per-shard (batch 2) reductions have a higher noise floor than the
+    # full-batch one-step tests: allow floor 4 / 1% here only.
+    _assert_tree_close(expected["params"],
+                       jax.device_get(new_state.params),
+                       "params", atol=5e-5, rtol=1e-3, outlier_abs=2.5e-3,
+                       outlier_floor=4, outlier_fraction=1 / 100)
