@@ -149,11 +149,12 @@ def main() -> int:
         proc = subprocess.Popen(worker_cmd, cwd=_REPO)
         relay_restarted = False
 
-        def reap(why: str) -> None:
+        def reap(why: str, grace: float | None = None) -> None:
             log(f"{why} — TERM worker")
             proc.terminate()
             try:
-                proc.wait(timeout=args.term_grace_s)
+                proc.wait(timeout=args.term_grace_s if grace is None
+                          else grace)
             except subprocess.TimeoutExpired:
                 log("worker ignored TERM (blocked in native read) — KILL")
                 proc.kill()
@@ -179,7 +180,12 @@ def main() -> int:
                 # opened a short window: dial fresh immediately.
                 last_relay = now_relay
                 relay_restarted = True
-                reap("relay restarted — fresh dial to catch its window")
+                # Short TERM grace: the restart killed this worker's
+                # upstream, so it holds no chip claim (the kill-safety
+                # model above) and every second of grace burns the window
+                # the restart just opened.
+                reap("relay restarted — fresh dial to catch its window",
+                     grace=5.0)
                 break
             age, allow = heartbeat_state()
             budget = allow or args.stale_s
