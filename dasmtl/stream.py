@@ -55,7 +55,8 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
                    out_csv: Optional[str] = None,
                    process_index: int = 0, process_count: int = 1,
                    resident: str = "auto",
-                   exported_path: Optional[str] = None) -> list:
+                   exported_path: Optional[str] = None,
+                   dp: int = 1) -> list:
     """Run the restored ``model`` over every window of ``record``.
 
     Returns the prediction rows (and writes ``out_csv`` when given).  Library
@@ -69,6 +70,14 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
     multiplied).  "auto" uses it on accelerator backends whenever the record
     is at least window-sized; records smaller than the window keep the
     zero-padding host path.
+
+    ``dp`` shards every batch's window axis over a data-parallel device
+    mesh (single-process multi-chip serving — the in-process counterpart of
+    the per-host window sharding above; ``-1`` = all visible devices).  The
+    forward is the same jitted computation with GSPMD partitioning it;
+    per-window predictions are identical to the single-device sweep
+    (asserted by the multichip dry run and ``tests/test_stream.py``).
+    Requires ``batch_size`` divisible by ``dp``.
 
     ``exported_path`` streams from a self-contained StableHLO artifact
     (:mod:`dasmtl.export`) instead of a checkpoint: no model rebuild, no
@@ -87,6 +96,29 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
     if resident not in ("auto", "on", "off"):
         raise ValueError(f"unknown resident mode {resident!r}")
     spec = get_model_spec(model)
+
+    mesh_plan = None
+    if dp != 1:
+        if dp < 1 and dp != -1:
+            raise ValueError(f"dp must be a positive device count or -1 "
+                             f"(all local devices), got {dp}")
+        if exported_path is not None:
+            raise ValueError(
+                "dp shards the in-framework computation; an exported "
+                "artifact's computation is fixed at export time — stream "
+                "it single-device, or stream from a checkpoint")
+        from dasmtl.parallel.mesh import create_mesh
+
+        # Host-LOCAL devices: the mesh never spans processes, so per-host
+        # window sharding (process_index/process_count above) composes
+        # with intra-host dp — each host partitions its own shard's
+        # batches over its own chips.
+        mesh_plan = create_mesh(dp=dp, sp=1, devices=jax.local_devices())
+        if mesh_plan.dp == 1:
+            mesh_plan = None  # one device visible: plain path
+        elif batch_size % mesh_plan.dp:
+            raise ValueError(f"batch_size {batch_size} must be divisible "
+                             f"by dp={mesh_plan.dp}")
 
     if exported_path is not None:
         if model_path:
@@ -136,6 +168,18 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
     plan = plan_windows(record.shape, window=window,
                         stride=_resolve_stride(stride, window))
     variables = {"params": state.params, "batch_stats": state.batch_stats}
+    if mesh_plan is not None:
+        # Replicate the weights onto the mesh once, up front — GSPMD would
+        # otherwise treat them as transfer-on-first-use constants.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from dasmtl.parallel.mesh import replicated_sharding
+
+        variables = jax.device_put(variables, replicated_sharding(mesh_plan))
+        _x_sharding = NamedSharding(mesh_plan.mesh,
+                                    PartitionSpec("dp", None, None, None))
+        _origin_sharding = NamedSharding(mesh_plan.mesh,
+                                         PartitionSpec("dp", None))
 
     fits = (record.shape[0] >= window[0] and record.shape[1] >= window[1])
     use_resident = fits and (
@@ -156,13 +200,20 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
             xs = jax.vmap(slice_one)(origin)[..., None]
             return spec.decode(state.apply_fn(variables, xs, train=False))
 
-        record_dev = jax.device_put(np.asarray(record, np.float32))
+        if mesh_plan is not None:
+            record_dev = jax.device_put(np.asarray(record, np.float32),
+                                        replicated_sharding(mesh_plan))
+        else:
+            record_dev = jax.device_put(np.asarray(record, np.float32))
         batches = window_index_batches(plan, batch_size,
                                        process_index=process_index,
                                        process_count=process_count)
 
         def run(batch):
-            return forward_resident(record_dev, batch["origin"])
+            origin = batch["origin"]
+            if mesh_plan is not None:
+                origin = jax.device_put(origin, _origin_sharding)
+            return forward_resident(record_dev, origin)
     else:
         @jax.jit
         def forward(x):
@@ -173,7 +224,10 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
                                  process_count=process_count)
 
         def run(batch):
-            return forward(batch["x"])
+            x = batch["x"]
+            if mesh_plan is not None:
+                x = jax.device_put(x, _x_sharding)
+            return forward(x)
 
     return _emit(spec, plan, batches, run, out_csv,
                  process_index, process_count)
@@ -241,6 +295,10 @@ def main(argv=None) -> int:
                         "inside the jitted computation")
     p.add_argument("--device", type=str, default="auto",
                    choices=["tpu", "cpu", "auto"])
+    p.add_argument("--dp", type=int, default=1,
+                   help="shard each batch's window axis over this many "
+                        "devices (single-process multi-chip serving; "
+                        "-1 = all visible devices)")
     args = p.parse_args(argv)
     if bool(args.model_path) == bool(args.exported):
         p.error("exactly one of --model_path / --exported is required")
@@ -269,7 +327,7 @@ def main(argv=None) -> int:
         np.asarray(record), args.model_path, model=args.model,
         batch_size=args.batch_size, stride=stride, out_csv=out_csv,
         process_index=pi, process_count=pc, resident=args.resident,
-        exported_path=args.exported)
+        exported_path=args.exported, dp=args.dp)
     print(f"streamed {len(rows)} windows from {record.shape} record "
           f"-> {shard_csv_path(out_csv, pi, pc)}")
     return 0

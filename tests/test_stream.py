@@ -194,3 +194,40 @@ def test_stream_exported_default_stride_is_artifact_window(mtl_artifact):
                           exported_path=artifact)
     assert len(rows) == 3  # non-overlapping full coverage at stride=window
     assert sorted(r["time_origin"] for r in rows) == [0, 64, 128]
+
+
+def test_dp_sharded_stream_matches_single_device(tmp_path):
+    """Single-process multi-chip serving: dp=4 shards each batch's window
+    axis over the virtual mesh; predictions must equal the single-device
+    sweep window-for-window on both the host and resident paths."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    ckpt = _checkpointed_state(tmp_path)
+    rec = np.random.default_rng(2).normal(size=(52, 64 * 4 + 13))
+    kwargs = dict(model="MTL", batch_size=4, window=HW, stride=(52, 40))
+    want = stream_predict(rec, ckpt, dp=1, resident="off", **kwargs)
+    got_host = stream_predict(rec, ckpt, dp=4, resident="off", **kwargs)
+    got_res = stream_predict(rec, ckpt, dp=4, resident="on", **kwargs)
+    assert want == got_host == got_res
+    assert len(want) > 4  # several batches, incl. a padded tail batch
+
+
+def test_dp_stream_rejects_bad_configs(tmp_path):
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    ckpt = _checkpointed_state(tmp_path)
+    rec = np.random.default_rng(3).normal(size=(52, 130))
+    with pytest.raises(ValueError, match="divisible"):
+        stream_predict(rec, ckpt, model="MTL", batch_size=3, window=HW,
+                       dp=4)
+    with pytest.raises(ValueError, match="exported"):
+        stream_predict(rec, None, model="MTL", batch_size=4, window=HW,
+                       dp=4, exported_path="whatever.stablehlo")
+    for bad in (0, -2):
+        with pytest.raises(ValueError, match="positive device count"):
+            stream_predict(rec, ckpt, model="MTL", batch_size=4, window=HW,
+                           dp=bad)
