@@ -302,6 +302,11 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     if bool(args.model_path) == bool(args.exported):
         p.error("exactly one of --model_path / --exported is required")
+    if args.dp != 1 and args.exported:
+        p.error("--dp is unavailable with --exported (the artifact's "
+                "computation is fixed at export time)")
+    if args.dp != -1 and args.dp < 1:
+        p.error(f"--dp must be a positive device count or -1, got {args.dp}")
     # Honor --device even when this module is the entry point (the root
     # stream.py wrapper also pre-applies it before any import).
     from dasmtl.utils.platform import apply_device

@@ -214,7 +214,20 @@ def test_dp_sharded_stream_matches_single_device(tmp_path):
     assert len(want) > 4  # several batches, incl. a padded tail batch
 
 
-def test_dp_stream_rejects_bad_configs(tmp_path):
+def test_dp_stream_rejects_bad_configs_any_device_count(tmp_path):
+    """These rejections need no mesh, so they must hold on single-device
+    runners too."""
+    rec = np.random.default_rng(3).normal(size=(52, 130))
+    with pytest.raises(ValueError, match="exported"):
+        stream_predict(rec, None, model="MTL", batch_size=4, window=HW,
+                       dp=4, exported_path="whatever.stablehlo")
+    for bad in (0, -2):
+        with pytest.raises(ValueError, match="positive device count"):
+            stream_predict(rec, None, model="MTL", batch_size=4, window=HW,
+                           dp=bad)
+
+
+def test_dp_stream_rejects_indivisible_batch(tmp_path):
     import jax
 
     if len(jax.devices()) < 4:
@@ -224,10 +237,3 @@ def test_dp_stream_rejects_bad_configs(tmp_path):
     with pytest.raises(ValueError, match="divisible"):
         stream_predict(rec, ckpt, model="MTL", batch_size=3, window=HW,
                        dp=4)
-    with pytest.raises(ValueError, match="exported"):
-        stream_predict(rec, None, model="MTL", batch_size=4, window=HW,
-                       dp=4, exported_path="whatever.stablehlo")
-    for bad in (0, -2):
-        with pytest.raises(ValueError, match="positive device count"):
-            stream_predict(rec, ckpt, model="MTL", batch_size=4, window=HW,
-                           dp=bad)
