@@ -231,3 +231,81 @@ def test_two_process_train_step_matches_single_process(tmp_path):
             np.testing.assert_allclose(
                 got[str(i)], e, atol=5e-3,
                 err_msg=f"{key} diverged between 2-process mesh and single")
+
+
+# ---------------------------------------------------------------------------
+# Streaming composition across 2 REAL processes x 2 local devices each:
+# per-host window sharding (process_index/process_count) + intra-host dp
+# (host-LOCAL mesh, stream.py) — the merged shards must equal the
+# single-process single-device sweep row-for-row.
+# ---------------------------------------------------------------------------
+
+_STREAM_CHILD = """
+import json
+import sys
+
+import numpy as np
+
+from dasmtl.parallel.mesh import initialize_distributed
+
+addr, pid, rec_path, out_json = (sys.argv[1], int(sys.argv[2]),
+                                 sys.argv[3], sys.argv[4])
+initialize_distributed(coordinator_address=addr, num_processes=2,
+                       process_id=pid)
+import jax
+assert jax.local_device_count() == 2, jax.local_device_count()
+assert jax.process_count() == 2
+
+from dasmtl.data import matio
+from dasmtl.stream import stream_predict
+
+rec = np.asarray(matio.load_mat(rec_path))
+rows = stream_predict(rec, None, model="MTL", batch_size=4,
+                      window=(52, 64), stride=(52, 40), resident="off",
+                      dp=2, process_index=jax.process_index(),
+                      process_count=jax.process_count())
+with open(out_json + f".p{pid}", "w") as f:
+    json.dump(rows, f)
+print(f"stream multihost ok {pid}")
+"""
+
+
+@pytest.mark.slow  # two subprocess JAX imports + compiles + rendezvous
+def test_two_process_stream_dp_composition(tmp_path):
+    import json
+
+    import numpy as np
+
+    from dasmtl.data import matio
+    from dasmtl.stream import stream_predict
+
+    rec = np.random.default_rng(7).normal(size=(52, 64 * 3 + 19))
+    rec_path = str(tmp_path / "rec.mat")
+    matio.save_mat(rec_path, rec)
+    out_json = str(tmp_path / "rows.json")
+
+    env = cpu_pinned_env(n_devices=2)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    addr = f"localhost:{_free_port()}"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _STREAM_CHILD, addr, str(i), rec_path,
+             out_json],
+            cwd=_REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for i in range(2)
+    ]
+    # Reference sweep runs concurrently with the children's (dominant)
+    # JAX import + compile; the conftest pins this process to CPU, the
+    # same backend the children are pinned to, so exact equality holds.
+    want = stream_predict(rec, None, model="MTL", batch_size=4,
+                          window=(52, 64), stride=(52, 40), resident="off")
+    _join_children(procs, "stream multihost ok", timeout=300)
+
+    merged = []
+    for i in range(2):
+        with open(out_json + f".p{i}") as f:
+            merged += json.load(f)
+    assert ({r["window_index"]: r for r in merged}
+            == {r["window_index"]: r for r in want})
+    assert len(merged) == len(want) > 0
