@@ -1,0 +1,123 @@
+"""Preflight validation of a field-dataset tree (docs/REPRODUCE.md).
+
+A user arriving from the reference with the Google Drive download should
+learn about layout problems BEFORE a training run dies minutes in (or,
+worse, silently trains on a half-discovered tree).  Checks, per dataset
+directory:
+
+- the directory exists and contains ``<k>m`` category subdirectories
+  (the layout the reference's DataCollector walks,
+  reference dataset_preparation.py:19-49);
+- the category set is exactly ``0m..15m`` (16 radial-distance classes,
+  reference utils.py:128) — warn, don't fail, on a different count so
+  subsetted experiments still pass with ``--allow_any_categories``;
+- every category holds at least one ``.mat`` file;
+- a sample of files per category loads under the expected key and has
+  the ``(100, 250)`` sample geometry (reference dataset_preparation.py:
+  247-248); every failure lists the offending file.
+
+Run:  python scripts/validate_dataset.py dataset/striking_train \
+          dataset/excavating_train [--mat_key data] [--sample 2]
+Exit 0 = ready to train; 1 = problems found (all printed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+EXPECTED_CATEGORIES = [f"{k}m" for k in range(16)]
+EXPECTED_SHAPE = (100, 250)
+
+
+def validate_tree(root: str, mat_key: str = "data", sample: int = 2,
+                  allow_any_categories: bool = False) -> list:
+    """Returns a list of problem strings (empty = valid)."""
+    from dasmtl.data.collector import DataCollector
+    from dasmtl.data import matio
+
+    problems = []
+    if not os.path.isdir(root):
+        return [f"{root}: directory does not exist"]
+    # Junk directories from zip extraction (__MACOSX/, notes/, ...) crash
+    # the digit-sorting category walk (collector.py) — exactly the layout
+    # problem this preflight exists to turn into a readable diagnostic.
+    junk = [d for d in sorted(os.listdir(root))
+            if os.path.isdir(os.path.join(root, d))
+            and not any(ch.isdigit() for ch in d)]
+    if junk:
+        return [f"{root}: non-category subdirectories {junk} — remove "
+                "them (zip-extraction leftovers?); categories must be "
+                "named like '0m'..'15m'"]
+    c = DataCollector(root, key_list=(mat_key,))
+    cats = c.get_all_categories()
+    if not cats:
+        return [f"{root}: no '<k>m' category subdirectories found — "
+                "expected 0m/ .. 15m/ holding .mat files"]
+    if sorted(cats) != sorted(EXPECTED_CATEGORIES):
+        msg = (f"{root}: categories {cats} != expected "
+               f"{EXPECTED_CATEGORIES[0]}..{EXPECTED_CATEGORIES[-1]}")
+        if allow_any_categories:
+            print(f"warning: {msg} (allowed)")
+        else:
+            problems.append(msg + " (pass --allow_any_categories for "
+                            "subsetted experiments)")
+    for cat in cats:
+        files = c.files_by_category[cat]
+        if not files:
+            problems.append(f"{root}/{cat}: no .mat files")
+            continue
+        for path in files[:sample]:
+            try:
+                arr = matio.load_mat(path, key_list=(mat_key,))
+            except KeyError as exc:
+                problems.append(f"{exc.args[0]} — pass --mat_key for a "
+                                "different variable name")
+                continue
+            except Exception as exc:  # noqa: BLE001 — report, keep going
+                problems.append(f"{path}: unreadable ({exc!r})")
+                continue
+            if tuple(arr.shape) != EXPECTED_SHAPE:
+                problems.append(
+                    f"{path}: shape {tuple(arr.shape)} != expected "
+                    f"{EXPECTED_SHAPE} (channels x time samples)")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("roots", nargs="+",
+                    help="dataset directories (striking/excavating "
+                         "train/test trees)")
+    ap.add_argument("--mat_key", default="data")
+    ap.add_argument("--sample", type=int, default=2,
+                    help="files per category to open and shape-check")
+    ap.add_argument("--allow_any_categories", action="store_true",
+                    help="warn instead of fail on a non-0m..15m "
+                         "category set")
+    args = ap.parse_args(argv)
+
+    all_problems = []
+    for root in args.roots:
+        probs = validate_tree(root, mat_key=args.mat_key,
+                              sample=args.sample,
+                              allow_any_categories=args.allow_any_categories)
+        if probs:
+            all_problems += probs
+        else:
+            print(f"ok: {root}")
+    for p in all_problems:
+        print(f"PROBLEM: {p}", file=sys.stderr)
+    if all_problems:
+        print(f"{len(all_problems)} problem(s) found", file=sys.stderr)
+        return 1
+    print("dataset ready")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""Preflight dataset validator (scripts/validate_dataset.py)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+
+
+@pytest.fixture
+def validate(monkeypatch):
+    monkeypatch.syspath_prepend(_SCRIPTS)
+    import validate_dataset
+
+    return validate_dataset
+
+
+@pytest.fixture
+def good_tree(tmp_path):
+    from dasmtl.data.synthetic import make_synthetic_dataset
+
+    make_synthetic_dataset(str(tmp_path), files_per_category=2)
+    return str(tmp_path / "striking_train")
+
+
+def test_good_tree_passes(validate, good_tree):
+    assert validate.validate_tree(good_tree) == []
+    assert validate.main([good_tree]) == 0
+
+
+def test_missing_dir_and_empty_category(validate, good_tree, tmp_path):
+    assert validate.validate_tree(str(tmp_path / "nope")) \
+        == [f"{tmp_path / 'nope'}: directory does not exist"]
+    empty = tmp_path / "striking_train" / "3m"
+    for f in empty.iterdir():
+        f.unlink()
+    probs = validate.validate_tree(good_tree)
+    assert any("3m: no .mat files" in p for p in probs)
+
+
+def test_wrong_shape_and_key_reported(validate, good_tree):
+    from dasmtl.data import matio
+
+    bad = os.path.join(good_tree, "5m", "bad_shape.mat")
+    matio.save_mat(bad, np.zeros((10, 20), np.float32))
+    weird = os.path.join(good_tree, "6m", "wrong_key.mat")
+    matio.save_mat(weird, np.zeros((100, 250), np.float32), key="other")
+    probs = validate.validate_tree(good_tree, sample=10)
+    assert any("shape (10, 20)" in p for p in probs)
+    assert any("wrong_key.mat" in p and "mat_key" in p for p in probs)
+    assert validate.main([good_tree]) == 1
+
+
+def test_subset_categories_gated(validate, tmp_path):
+    from dasmtl.data.synthetic import make_synthetic_dataset
+
+    make_synthetic_dataset(str(tmp_path), files_per_category=1,
+                           num_categories=4)
+    root = str(tmp_path / "striking_train")
+    probs = validate.validate_tree(root)
+    assert any("categories" in p for p in probs)
+    assert validate.validate_tree(root, allow_any_categories=True) == []
+
+
+def test_junk_subdirectory_reported_not_crashed(validate, good_tree):
+    os.makedirs(os.path.join(good_tree, "__MACOSX"))
+    probs = validate.validate_tree(good_tree)
+    assert len(probs) == 1 and "__MACOSX" in probs[0]
