@@ -59,3 +59,31 @@ def test_export_decodes_every_task(tmp_path):
         np.zeros((3, 52, 64, 1), np.float32))
     assert set(out) == {"event", "log_probs_0"}
     assert out["event"].shape == (3,)
+
+
+def test_export_roundtrip_multi_classifier(tmp_path):
+    """Model C exports like the two-level families: the spec-driven
+    artifact decodes the 32-way head into mixed/distance/event and its
+    log_probs head normalizes (raw Inception logits are log_softmaxed at
+    export, dasmtl/export.py make_infer_fn)."""
+    cfg = Config(model="multi_classifier")
+    spec = get_model_spec(cfg.model)
+    state = build_state(cfg, spec, input_hw=(100, 250))
+
+    blob = dexport.export_infer(spec, state, input_hw=(100, 250))
+    path = tmp_path / "mc.stablehlo"
+    path.write_bytes(blob)
+
+    call = dexport.load_exported(str(path))
+    reference = jax.jit(dexport.make_infer_fn(spec, state))
+
+    x = np.random.default_rng(1).normal(size=(3, 100, 250, 1)) \
+        .astype(np.float32)
+    got, want = call(x), reference(x)
+    assert set(got) == set(want)
+    for task in ("mixed", "distance", "event"):
+        assert got[task].shape == (3,)
+        np.testing.assert_array_equal(got[task], want[task])
+    assert (got["mixed"] == got["distance"] + 16 * got["event"]).all()
+    np.testing.assert_allclose(np.exp(got["log_probs_0"]).sum(-1), 1.0,
+                               rtol=1e-5)
