@@ -45,6 +45,20 @@ def collect() -> dict:
     from dasmtl.utils.platform import tunnel_probe
 
     info["tpu_tunnel"] = tunnel_probe()
+    # Evidence-round tag + harvest progress (scripts/roundinfo.py is the
+    # single source of truth; absent = not an error for doctor, just n/a).
+    try:
+        import importlib.util as _ilu
+        _spec = _ilu.spec_from_file_location(
+            "roundinfo", os.path.join(
+                os.path.dirname(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))), "scripts", "roundinfo.py"))
+        _ri = _ilu.module_from_spec(_spec)
+        _spec.loader.exec_module(_ri)
+        info["round"] = _ri.resolve_round()
+    except Exception as exc:  # noqa: BLE001 — diagnostic only
+        info["round"] = f"unresolved ({exc})"
+
 
     tunnel_down = str(info["tpu_tunnel"]).startswith("unreachable")
     tunnel_configured = info["tpu_tunnel"] != "not-configured"
@@ -132,6 +146,7 @@ def main(argv=None) -> int:
         for k, v in info["env"].items():
             print(f"  env {k}={v}")
     print(f"  TPU tunnel: {info.get('tpu_tunnel')}")
+    print(f"  evidence round: {info.get('round')}")
     if "compilation_cache_entries" in info:
         n = info["compilation_cache_entries"]
         print(f"  compilation cache: "
