@@ -45,8 +45,8 @@ def collect() -> dict:
     from dasmtl.utils.platform import tunnel_probe
 
     info["tpu_tunnel"] = tunnel_probe()
-    # Evidence-round tag + harvest progress (scripts/roundinfo.py is the
-    # single source of truth; absent = not an error for doctor, just n/a).
+    # Evidence-round tag (scripts/roundinfo.py is the single source of
+    # truth; absent = not an error for doctor, just n/a).
     try:
         import importlib.util as _ilu
         _spec = _ilu.spec_from_file_location(
