@@ -200,11 +200,10 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
             xs = jax.vmap(slice_one)(origin)[..., None]
             return spec.decode(state.apply_fn(variables, xs, train=False))
 
-        if mesh_plan is not None:
-            record_dev = jax.device_put(np.asarray(record, np.float32),
-                                        replicated_sharding(mesh_plan))
-        else:
-            record_dev = jax.device_put(np.asarray(record, np.float32))
+        record_dev = jax.device_put(
+            np.asarray(record, np.float32),
+            replicated_sharding(mesh_plan) if mesh_plan is not None
+            else None)
         batches = window_index_batches(plan, batch_size,
                                        process_index=process_index,
                                        process_count=process_count)

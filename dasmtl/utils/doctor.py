@@ -59,7 +59,6 @@ def collect() -> dict:
     except Exception as exc:  # noqa: BLE001 — diagnostic only
         info["round"] = f"unresolved ({exc})"
 
-
     tunnel_down = str(info["tpu_tunnel"]).startswith("unreachable")
     tunnel_configured = info["tpu_tunnel"] != "not-configured"
     platforms = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS")
