@@ -45,17 +45,12 @@ def collect() -> dict:
     from dasmtl.utils.platform import tunnel_probe
 
     info["tpu_tunnel"] = tunnel_probe()
-    # Evidence-round tag (scripts/roundinfo.py is the single source of
+    # Evidence-round tag (dasmtl.utils.roundinfo is the single source of
     # truth; absent = not an error for doctor, just n/a).
     try:
-        import importlib.util as _ilu
-        _spec = _ilu.spec_from_file_location(
-            "roundinfo", os.path.join(
-                os.path.dirname(os.path.dirname(os.path.dirname(
-                    os.path.abspath(__file__)))), "scripts", "roundinfo.py"))
-        _ri = _ilu.module_from_spec(_spec)
-        _spec.loader.exec_module(_ri)
-        info["round"] = _ri.resolve_round()
+        from dasmtl.utils.roundinfo import resolve_round
+
+        info["round"] = resolve_round()
     except Exception as exc:  # noqa: BLE001 — diagnostic only
         info["round"] = f"unresolved ({exc})"
 

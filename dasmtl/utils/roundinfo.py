@@ -1,0 +1,60 @@
+"""Single source of truth for the evidence round tag (r01, r02, ...).
+
+Round-4 verdict (weak #2): the harvester defaulted its round to a
+hard-coded previous value, so launching the supervisor without
+``DASMTL_ROUND`` set silently filed a new round's evidence under the old
+round's artifact names.  Resolution order here makes that impossible:
+
+1. ``DASMTL_ROUND`` env var, when set (explicit override for tests and
+   scratch runs) — a mismatch against a present ``ROUND`` file is warned
+   to stderr, so a stale shell export can't silently misfile either;
+2. the committed ``ROUND`` file at the repo root (authoritative — bumped
+   once at round start, travels with the commit history);
+3. otherwise ``RuntimeError`` — no silent default.
+
+Lives in the package so both the repo scripts (via the
+``scripts/roundinfo.py`` shim) and ``dasmtl.utils.doctor`` import it the
+normal way.  The ROUND file is repo-tooling state: when the package runs
+outside the repo checkout there is no file to read and only the env var
+resolves.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_ROUND_FILE = os.path.join(_REPO, "ROUND")
+_PATTERN = re.compile(r"^r\d{2}$")
+
+
+def _file_tag() -> str | None:
+    try:
+        with open(_ROUND_FILE) as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+def resolve_round() -> str:
+    env_tag = os.environ.get("DASMTL_ROUND", "").strip()
+    file_tag = _file_tag()
+    tag, source = env_tag, "DASMTL_ROUND"
+    if not tag:
+        if file_tag is None:
+            raise RuntimeError(
+                "no round tag: set DASMTL_ROUND or commit a ROUND file "
+                "at the repo root (e.g. containing 'r05')")
+        tag, source = file_tag, _ROUND_FILE
+    elif file_tag is not None and file_tag != env_tag:
+        print(f"roundinfo: DASMTL_ROUND={env_tag!r} overrides committed "
+              f"ROUND file {file_tag!r} — evidence will file as "
+              f"{env_tag!r}; unset the env var if that is a stale export",
+              file=sys.stderr)
+    if not _PATTERN.match(tag):
+        raise RuntimeError(
+            f"invalid round tag {tag!r} from {source}: expected e.g. 'r05'")
+    return tag

@@ -11,9 +11,7 @@
 #
 # Usage:  DASMTL_ROUND=r03 setsid nohup sh scripts/claim_watch.sh &
 set -u
-R="${DASMTL_ROUND:-$(cat "$(dirname "$0")/../ROUND" 2>/dev/null)}"
-[ -n "$R" ] || { echo "no round: set DASMTL_ROUND or commit ROUND file" >&2; exit 1; }
-case "$R" in r[0-9][0-9]) ;; *) echo "invalid round tag '$R': expected e.g. r05" >&2; exit 1;; esac
+R="$(python "$(dirname "$0")/roundinfo.py")" || exit 1
 LOG="artifacts/claim_watch_${R}.log"
 mkdir -p artifacts
 # Single-instance lock: two watchers would both fire the measurement chain

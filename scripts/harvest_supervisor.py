@@ -180,12 +180,17 @@ def main() -> int:
                 # opened a short window: dial fresh immediately.
                 last_relay = now_relay
                 relay_restarted = True
-                # Short TERM grace: the restart killed this worker's
-                # upstream, so it holds no chip claim (the kill-safety
-                # model above) and every second of grace burns the window
-                # the restart just opened.
+                # Short TERM grace ONLY when the worker is also beat-stale
+                # (the blocked-in-init signature, where it holds no chip
+                # claim — kill-safety model above): every second of grace
+                # burns the window the restart just opened.  A worker that
+                # heartbeated recently may be mid-measure on a still-live
+                # claim (e.g. the relay file was rewritten without its
+                # upstream dying), and SIGKILLing a claimed client wedges
+                # the chip — keep the full grace for it.
+                age, _ = heartbeat_state()
                 reap("relay restarted — fresh dial to catch its window",
-                     grace=5.0)
+                     grace=5.0 if age > 60 else None)
                 break
             age, allow = heartbeat_state()
             budget = allow or args.stale_s

@@ -248,6 +248,14 @@ def _run_incremental(configs: list, keys: tuple, partial: str, final: str,
     every config, and promoting to the final artifact BEFORE removing the
     partial (a kill between those two steps must never lose settled
     rows)."""
+    # Resolve the backend-honesty rename up front so EVERY path of the
+    # resume protocol — progress read, partial rewrite, final promotion,
+    # partial removal — agrees on one name per file.  write_artifact's own
+    # rename is a no-op on an already-resolved name, and artifact_done
+    # still watches the canonical (*_tpu) name so the stage stays pending
+    # for a real window.
+    partial = honest_name(partial, _backend())
+    final = honest_name(final, _backend())
     rows, pending = ([], {}) if FORCE else _stage_progress(partial, final,
                                                            keys)
     done = {tuple(r[k] for k in keys) for r in rows}

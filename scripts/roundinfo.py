@@ -1,42 +1,22 @@
-"""Single source of truth for the evidence round tag (r01, r02, ...).
+"""Shim: the round resolver lives in the package (dasmtl.utils.roundinfo)
+so library code imports it normally; repo scripts keep importing it from
+here (their directory is on sys.path when they run).
 
-Round-4 verdict (weak #2): ``harvest_tpu.py`` defaulted its round to a
-hard-coded previous value, so launching the supervisor without
-``DASMTL_ROUND`` set silently filed a new round's evidence under the old
-round's artifact names.  Resolution order here makes that impossible:
-
-1. ``DASMTL_ROUND`` env var, when set (explicit override for tests and
-   scratch runs);
-2. the committed ``ROUND`` file at the repo root (authoritative — bumped
-   once at round start, travels with the commit history);
-3. otherwise ``RuntimeError`` — no silent default.
+``python scripts/roundinfo.py`` prints the resolved tag — the one shell
+entry point (claim_watch.sh, run_tpu_measurements.sh), so resolution and
+validation are never duplicated in shell.
 """
 
-from __future__ import annotations
-
 import os
-import re
+import sys
 
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_ROUND_FILE = os.path.join(_REPO, "ROUND")
-_PATTERN = re.compile(r"^r\d{2}$")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from dasmtl.utils.roundinfo import resolve_round  # noqa: E402,F401
 
-def resolve_round() -> str:
-    tag = os.environ.get("DASMTL_ROUND", "").strip()
-    source = "DASMTL_ROUND"
-    if not tag:
-        try:
-            with open(_ROUND_FILE) as f:
-                tag = f.read().strip()
-            source = _ROUND_FILE
-        except OSError:
-            raise RuntimeError(
-                "no round tag: set DASMTL_ROUND or commit a ROUND file "
-                "at the repo root (e.g. containing 'r05')"
-            ) from None
-    if not _PATTERN.match(tag):
-        raise RuntimeError(
-            f"invalid round tag {tag!r} from {source}: expected e.g. 'r05'"
-        )
-    return tag
+if __name__ == "__main__":
+    try:
+        print(resolve_round())
+    except RuntimeError as exc:
+        print(f"roundinfo: {exc}", file=sys.stderr)
+        sys.exit(1)

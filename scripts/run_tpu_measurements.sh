@@ -11,9 +11,7 @@
 # written via tmp+mv so a failed re-run can never truncate a good artifact
 # recorded earlier in the round.
 set -x
-R="${DASMTL_ROUND:-$(cat "$(dirname "$0")/../ROUND" 2>/dev/null)}"
-[ -n "$R" ] || { echo "no round: set DASMTL_ROUND or commit ROUND file" >&2; exit 1; }
-case "$R" in r[0-9][0-9]) ;; *) echo "invalid round tag '$R': expected e.g. r05" >&2; exit 1;; esac
+R="$(python "$(dirname "$0")/roundinfo.py")" || exit 1
 mkdir -p artifacts
 FAILLOG="artifacts/chain_failures_${R}.log"
 : > "$FAILLOG"

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import re
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -43,12 +44,14 @@ def validate_tree(root: str, mat_key: str = "data", sample: int = 2,
     problems = []
     if not os.path.isdir(root):
         return [f"{root}: directory does not exist"]
-    # Junk directories from zip extraction (__MACOSX/, notes/, ...) crash
-    # the digit-sorting category walk (collector.py) — exactly the layout
-    # problem this preflight exists to turn into a readable diagnostic.
+    # Any subdirectory that isn't a '<k>m' category is junk (zip
+    # leftovers like __MACOSX/, or digit-bearing strays like backup2/):
+    # the digit-sorting category walk (collector.py) would either crash on
+    # it or silently consume it as a distance class, corrupting labels —
+    # exactly the layout problems this preflight exists to surface.
     junk = [d for d in sorted(os.listdir(root))
             if os.path.isdir(os.path.join(root, d))
-            and not any(ch.isdigit() for ch in d)]
+            and not re.fullmatch(r"\d+m", d)]
     if junk:
         return [f"{root}: non-category subdirectories {junk} — remove "
                 "them (zip-extraction leftovers?); categories must be "

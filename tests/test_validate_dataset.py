@@ -69,3 +69,11 @@ def test_junk_subdirectory_reported_not_crashed(validate, good_tree):
     os.makedirs(os.path.join(good_tree, "__MACOSX"))
     probs = validate.validate_tree(good_tree)
     assert len(probs) == 1 and "__MACOSX" in probs[0]
+
+
+def test_digit_bearing_junk_dir_reported(validate, good_tree):
+    """'backup2/' sorts into the category walk by its embedded digit and
+    would be consumed as a distance class — must be reported as junk."""
+    os.makedirs(os.path.join(good_tree, "backup2"))
+    probs = validate.validate_tree(good_tree)
+    assert len(probs) == 1 and "backup2" in probs[0]
