@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import sys
 from typing import Optional
 
 MODEL_TYPES = ("MTL", "single_event", "single_distance", "multi_classifier")
@@ -174,15 +175,62 @@ class Config:
         return cls(**json.loads(text))
 
 
+class _CompatBoolAction(argparse.Action):
+    """``--flag`` / ``--no-flag`` / ``--flag False`` — BooleanOptionalAction
+    plus the reference's valued form (reference train.py:18 ``type=bool``,
+    whose only way to disable was ``--dataset_ram False`` — which that trap
+    actually parsed as True; here the value parses properly)."""
+
+    def __init__(self, option_strings, dest, default=None, help=None,  # noqa: A002
+                 **kwargs):
+        opts = list(option_strings)
+        opts += ["--no-" + o[2:] for o in option_strings
+                 if o.startswith("--") and not o.startswith("--no-")]
+        super().__init__(opts, dest, nargs="?", const=True,
+                         default=default, metavar="BOOL", help=help)
+
+    def __call__(self, parser, namespace, values, option_string=None):
+        if option_string and option_string.startswith("--no-"):
+            value = False
+        elif values is None:
+            value = True
+        else:
+            value = str(values).strip().lower() in ("1", "true", "yes",
+                                                    "y", "t")
+        setattr(namespace, self.dest, value)
+
+
 def _add_shared_args(p: argparse.ArgumentParser) -> None:
     """Flag surface preserving the reference CLI (train.py:7-26) plus the
     hyperparameters the reference hard-codes, with clean boolean handling."""
     d = Config()
     p.add_argument("--model", type=str, default=d.model,
                    help=f"model type: {', '.join(MODEL_TYPES)}")
-    p.add_argument("--device", type=str, default=d.device,
+    # Sentinel default: _resolve_compat must distinguish an explicit
+    # "--device auto" (which beats the deprecated alias below) from the
+    # flag being absent; it fills in the Config default afterwards.
+    p.add_argument("--device", type=str, default=None,
                    choices=["tpu", "cpu", "auto"],
-                   help="accelerator (replaces the reference --GPU_device)")
+                   help="accelerator (replaces the reference --GPU_device; "
+                        f"default {d.device})")
+    # Migration alias for reference scripts (reference train.py:10).  The
+    # reference's `type=bool` made ANY string truthy ("--GPU_device False"
+    # still meant GPU); here the value parses properly, with a deprecation
+    # warning so the user knows both about --device and about the
+    # semantic fix.
+    p.add_argument("--GPU_device", dest="gpu_device_compat", type=str,
+                   default=None, metavar="BOOL",
+                   help="DEPRECATED reference alias for --device: truthy "
+                        "-> auto (accelerator when available), falsy -> "
+                        "cpu; unlike the reference, 'False' means False")
+    # Declared by both reference CLIs and used by neither (reference
+    # train.py:9 / test.py:9 — the mode IS the CLI you run, there and
+    # here); accepted so reference launch lines parse, then dropped.
+    p.add_argument("--running_mode", dest="running_mode_compat", type=str,
+                   default=None,
+                   help="DEPRECATED reference flag, ignored (as the "
+                        "reference itself does): train.py trains, "
+                        "test.py evaluates")
     p.add_argument("--batch_size", type=int, default=d.batch_size)
     p.add_argument("--epoch_num", type=int, default=d.epoch_num)
     p.add_argument("--lr", type=float, default=d.lr)
@@ -213,7 +261,7 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--output_savedir", type=str, default=d.output_savedir)
     p.add_argument("--model_path", type=str, default=None,
                    help="checkpoint directory to restore weights from")
-    p.add_argument("--dataset_ram", action=argparse.BooleanOptionalAction,
+    p.add_argument("--dataset_ram", action=_CompatBoolAction,
                    default=d.dataset_ram,
                    help="preload all .mat files into host RAM")
     p.add_argument("--trainVal_set_striking", dest="trainval_set_striking",
@@ -259,15 +307,36 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--profile_dir", type=str, default=None)
 
 
+def _resolve_compat(ns: argparse.Namespace) -> dict:
+    """Apply deprecated reference aliases, then drop their namespace keys."""
+    kw = vars(ns)
+    if kw.pop("running_mode_compat") is not None:
+        print("--running_mode is a deprecated reference flag and is "
+              "ignored (as the reference itself does): train.py trains, "
+              "test.py evaluates", file=sys.stderr)
+    gpu = kw.pop("gpu_device_compat")
+    # An explicit --device (any value, incl. "auto") beats the alias: the
+    # parser's sentinel default None means "--device was not given".
+    if gpu is not None and kw["device"] is None:
+        wanted = "auto" if gpu.strip().lower() in (
+            "1", "true", "yes", "y", "t") else "cpu"
+        print(f"--GPU_device is deprecated (reference alias): mapping "
+              f"{gpu!r} -> --device {wanted}; note the reference's "
+              f"type=bool treated every string as True — here "
+              f"{gpu!r} parses as {wanted != 'cpu'}", file=sys.stderr)
+        kw["device"] = wanted
+    if kw["device"] is None:
+        kw["device"] = "auto"  # the Config field default
+    return kw
+
+
 def parse_train_args(argv=None) -> Config:
     p = argparse.ArgumentParser(description="dasmtl model training (TPU-native)")
     _add_shared_args(p)
-    ns = p.parse_args(argv)
-    return Config(**vars(ns))
+    return Config(**_resolve_compat(p.parse_args(argv)))
 
 
 def parse_test_args(argv=None) -> Config:
     p = argparse.ArgumentParser(description="dasmtl model evaluation (TPU-native)")
     _add_shared_args(p)
-    ns = p.parse_args(argv)
-    return Config(**vars(ns))
+    return Config(**_resolve_compat(p.parse_args(argv)))

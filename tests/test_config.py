@@ -1,0 +1,62 @@
+"""CLI flag-surface compatibility (dasmtl/config.py parse_*_args)."""
+
+
+def test_gpu_device_reference_alias(capsys):
+    """--GPU_device (reference train.py:10) maps onto --device with a
+    deprecation warning, parsing its value properly (the reference's
+    type=bool treated every string as True); an explicit --device wins."""
+    from dasmtl.config import parse_train_args
+
+    cfg = parse_train_args(["--GPU_device", "False"])
+    assert cfg.device == "cpu"
+    assert "deprecated" in capsys.readouterr().err
+
+    cfg = parse_train_args(["--GPU_device", "True"])
+    assert cfg.device == "auto"
+
+    cfg = parse_train_args(["--GPU_device", "True", "--device", "tpu"])
+    assert cfg.device == "tpu"
+
+
+def test_reference_flag_surface_accepted():
+    """A reference launch line parses VERBATIM — every flag the reference
+    CLIs expose (reference train.py:7-26, test.py:7-26), in their valued
+    forms, including the declared-but-unused --running_mode."""
+    from dasmtl.config import parse_test_args, parse_train_args
+
+    cfg = parse_train_args([
+        "--model", "MTL", "--running_mode", "train",
+        "--GPU_device", "True", "--batch_size", "16",
+        "--epoch_num", "2", "--random_state", "1", "--fold_index", "0",
+        "--output_savedir", "/tmp/x",
+        "--dataset_ram", "True", "--trainVal_set_striking", "a",
+        "--trainVal_set_excavating", "b"])
+    assert (cfg.batch_size, cfg.epoch_num) == (16, 2)
+    assert cfg.trainval_set_striking == "a" and cfg.dataset_ram
+
+    cfg = parse_test_args([
+        "--model", "multi_classifier", "--model_path", "ck",
+        "--GPU_device", "False", "--output_savedir", "/tmp/x",
+        "--test_set_striking", "c", "--test_set_excavating", "d"])
+    assert cfg.model_path == "ck" and cfg.device == "cpu"
+
+
+def test_valued_boolean_compat_forms():
+    """--dataset_ram accepts bare, --no-, and the reference's valued form
+    — with 'False' actually meaning False (the reference's type=bool trap
+    parsed it as True)."""
+    from dasmtl.config import parse_train_args
+
+    assert parse_train_args(["--dataset_ram"]).dataset_ram is True
+    assert parse_train_args(["--no-dataset_ram"]).dataset_ram is False
+    assert parse_train_args(["--dataset_ram", "False"]).dataset_ram is False
+    assert parse_train_args(["--dataset_ram", "True"]).dataset_ram is True
+
+
+def test_explicit_device_auto_beats_alias():
+    """'--device auto --GPU_device False' keeps auto: an explicitly given
+    --device (any value) wins over the deprecated alias."""
+    from dasmtl.config import parse_train_args
+
+    cfg = parse_train_args(["--device", "auto", "--GPU_device", "False"])
+    assert cfg.device == "auto"

@@ -23,10 +23,15 @@ def test_every_config_field_has_a_cli_flag():
 
     from dasmtl.config import _add_shared_args
 
+    from dasmtl.config import _resolve_compat
+
     fields = {f.name for f in dataclasses.fields(Config)}
     p = argparse.ArgumentParser()
     _add_shared_args(p)
-    exposed = set(vars(p.parse_args([])).keys())
+    # Deprecated reference aliases (--GPU_device) are consumed by
+    # _resolve_compat before Config construction — the invariant is that
+    # what REACHES Config matches Config's fields exactly.
+    exposed = set(_resolve_compat(p.parse_args([])).keys())
     assert fields == exposed, (
         f"CLI/Config drift: missing flags {fields - exposed}, "
         f"unknown args {exposed - fields}")
