@@ -61,7 +61,7 @@ _PEAK_BF16 = {"v6e": 918e12, "trillium": 918e12, "v5p": 459e12,
               "v5e": 197e12, "v5 lite": 197e12, "v4": 275e12}
 
 
-def _measure_config(batch_size: int, dtype: str, use_pallas: bool,
+def _measure_config(batch_size: int, dtype: str,
                     warmup: int, measure: int, model: str = "MTL",
                     repeats: int = 3) -> dict:
     """One compile + noise-aware measure of the jitted train step (jax
@@ -83,8 +83,7 @@ def _measure_config(batch_size: int, dtype: str, use_pallas: bool,
     device_kind = jax.devices()[0].device_kind
     on_accel = backend not in ("cpu",)
 
-    cfg = Config(model=model, batch_size=batch_size, compute_dtype=dtype,
-                 use_pallas=use_pallas)
+    cfg = Config(model=model, batch_size=batch_size, compute_dtype=dtype)
     spec = get_model_spec(cfg.model)
     state = build_state(cfg, spec)
     train_step = make_train_step(spec)
@@ -133,7 +132,6 @@ def _measure_config(batch_size: int, dtype: str, use_pallas: bool,
         "device_kind": device_kind,
         "batch_size": batch_size,
         "compute_dtype": dtype,
-        "use_pallas": use_pallas,
         "step_time_ms": round(elapsed / measure * 1e3, 3),
         "compile_s": round(compile_s, 1),
         "repeats": len(windows),
@@ -189,16 +187,15 @@ def _child_measure() -> None:
     dtype = "bfloat16" if on_accel else "float32"
     print(f"bench child: backend={backend} batch={batch_size} dtype={dtype}",
           file=sys.stderr)
-    result = _measure_config(batch_size, dtype, use_pallas=False,
+    result = _measure_config(batch_size, dtype,
                              warmup=3, measure=measure, repeats=repeats)
     print(_MARK + json.dumps(result))
 
 
 def _child_sweep() -> None:
-    """Perf-lever sweep (f32 / bf16 / +pallas, two batch sizes) — the
-    measurement behind BASELINE.md's dtype/kernel table.  Not the driver
-    path; run manually:  python bench.py --sweep  (or --child-sweep with a
-    pinned platform)."""
+    """Perf-lever sweep (f32 / bf16, batch scaling) — the measurement
+    behind BASELINE.md's dtype table.  Not the driver path; run manually:
+    python bench.py --sweep  (or --child-sweep with a pinned platform)."""
     import jax
 
     on_accel = jax.default_backend() not in ("cpu",)
@@ -206,27 +203,26 @@ def _child_sweep() -> None:
     configs = []
     for batch_size in (32, 256) if on_accel else (32,):
         for dtype in ("float32", "bfloat16"):
-            for use_pallas in (False, True):
-                configs.append((batch_size, dtype, use_pallas))
+            configs.append((batch_size, dtype))
     if on_accel:
         # Scaling probe: does a larger batch push MFU past the bs=256 point?
-        configs.append((512, "bfloat16", False))
+        configs.append((512, "bfloat16"))
     rows = []
-    for batch_size, dtype, use_pallas in configs:
+    for batch_size, dtype in configs:
         # One config failing (e.g. the bs=512 probe OOMing HBM — the exact
         # risk a scaling probe explores) must not discard the completed rows.
         try:
-            r = _measure_config(batch_size, dtype, use_pallas,
+            r = _measure_config(batch_size, dtype,
                                 warmup=2, measure=measure)
         except Exception as exc:  # noqa: BLE001 — record and continue
             rows.append({"batch_size": batch_size, "compute_dtype": dtype,
-                         "use_pallas": use_pallas, "error": repr(exc)[:300]})
-            print(f"sweep: bs={batch_size} {dtype} pallas={use_pallas} "
+                         "error": repr(exc)[:300]})
+            print(f"sweep: bs={batch_size} {dtype} "
                   f"FAILED: {exc!r}", file=sys.stderr)
             continue
         rows.append(r)
-        print(f"sweep: bs={batch_size} {dtype} "
-              f"pallas={use_pallas}: {r['value']} samples/s "
+        print(f"sweep: bs={batch_size} {dtype}: "
+              f"{r['value']} samples/s "
               f"({r['step_time_ms']} ms/step, "
               f"mfu={r.get('mfu', '-')})", file=sys.stderr)
     print(_MARK + json.dumps(rows))
@@ -247,7 +243,7 @@ def _child_models() -> None:
     for model in ("MTL", "single_distance", "single_event",
                   "multi_classifier"):
         try:
-            r = _measure_config(batch_size, dtype, use_pallas=False,
+            r = _measure_config(batch_size, dtype,
                                 warmup=2, measure=measure, model=model)
         except Exception as exc:  # noqa: BLE001 — record and continue
             rows.append({"model": model, "batch_size": batch_size,

@@ -122,7 +122,6 @@ class Config:
     # ---- misc ----
     seed: int = 1
     log_every_steps: int = 100  # metric-line cadence (reference utils.py:376)
-    use_pallas: bool = False  # fused sigmoid-gate Pallas kernel on TPU
     debug_nans: bool = False
     profile_dir: Optional[str] = None  # jax.profiler trace output
 
@@ -172,7 +171,17 @@ class Config:
 
     @classmethod
     def from_json(cls, text: str) -> "Config":
-        return cls(**json.loads(text))
+        """Tolerant of fields written by other versions (e.g. the removed
+        ``use_pallas``): unknown keys are dropped with a note instead of
+        failing resume on an older run's ``config.json``."""
+        known = {f.name for f in dataclasses.fields(cls)}
+        data = json.loads(text)
+        dropped = sorted(set(data) - known)
+        if dropped:
+            print(f"Config.from_json: ignoring unknown fields {dropped} "
+                  "(written by a different dasmtl version)",
+                  file=sys.stderr)
+        return cls(**{k: v for k, v in data.items() if k in known})
 
 
 class _CompatBoolAction(argparse.Action):
@@ -300,8 +309,6 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
                    default=d.cv_parallel,
                    help="train all 5 CV folds simultaneously in one vmapped "
                         "computation (vs one --fold_index run per fold)")
-    p.add_argument("--use_pallas", action=argparse.BooleanOptionalAction,
-                   default=d.use_pallas)
     p.add_argument("--resume", action=argparse.BooleanOptionalAction,
                    default=d.resume)
     p.add_argument("--profile_dir", type=str, default=None)

@@ -59,8 +59,7 @@ def _decode_mixed(outputs) -> Dict[str, jnp.ndarray]:
 _REGISTRY = {
     "MTL": ModelSpec(
         name="MTL",
-        build=lambda cfg: MTLNet(dtype=_dtype(cfg),
-                                 use_pallas=cfg.use_pallas),
+        build=lambda cfg: MTLNet(dtype=_dtype(cfg)),
         loss_fn=losses.mtl_loss,
         report_tasks=(("distance", NUM_DISTANCE_CLASSES),
                       ("event", NUM_EVENT_CLASSES)),
@@ -68,8 +67,7 @@ _REGISTRY = {
     ),
     "single_distance": ModelSpec(
         name="single_distance",
-        build=lambda cfg: SingleTaskNet("distance", dtype=_dtype(cfg),
-                                        use_pallas=cfg.use_pallas),
+        build=lambda cfg: SingleTaskNet("distance", dtype=_dtype(cfg)),
         loss_fn=lambda outputs, batch: losses.single_task_loss(
             outputs, batch, "distance"),
         report_tasks=(("distance", NUM_DISTANCE_CLASSES),),
@@ -77,8 +75,7 @@ _REGISTRY = {
     ),
     "single_event": ModelSpec(
         name="single_event",
-        build=lambda cfg: SingleTaskNet("event", dtype=_dtype(cfg),
-                                        use_pallas=cfg.use_pallas),
+        build=lambda cfg: SingleTaskNet("event", dtype=_dtype(cfg)),
         loss_fn=lambda outputs, batch: losses.single_task_loss(
             outputs, batch, "event"),
         report_tasks=(("event", NUM_EVENT_CLASSES),),

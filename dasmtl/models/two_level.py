@@ -44,7 +44,6 @@ class TwoLevelNet(nn.Module):
     res_num: int = 8
     first_ch: int = 16
     dtype: Any = jnp.float32
-    use_pallas: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False):
@@ -72,8 +71,7 @@ class TwoLevelNet(nn.Module):
                 mask_logits = AttentionGate(
                     ch[k] // 2, ch[k], dtype=self.dtype,
                     name=f"{task}_att{k}")(inp, train)
-                a = gate_apply(mask_logits, shared[2 * k - 1],
-                               use_pallas=self.use_pallas)
+                a = gate_apply(mask_logits, shared[2 * k - 1])
                 if k < 4:
                     a = OutputLayer(ch[k + 1], dtype=self.dtype,
                                     name=f"{task}_out{k}")(a, train)
@@ -84,15 +82,13 @@ class TwoLevelNet(nn.Module):
         return tuple(preds)
 
 
-def MTLNet(dtype: Any = jnp.float32, use_pallas: bool = False) -> TwoLevelNet:
+def MTLNet(dtype: Any = jnp.float32) -> TwoLevelNet:
     """Model A: both tasks (reference model/modelA_MTL.py:53)."""
-    return TwoLevelNet(tasks=("distance", "event"), dtype=dtype,
-                       use_pallas=use_pallas)
+    return TwoLevelNet(tasks=("distance", "event"), dtype=dtype)
 
 
-def SingleTaskNet(task: str, dtype: Any = jnp.float32,
-                  use_pallas: bool = False) -> TwoLevelNet:
+def SingleTaskNet(task: str, dtype: Any = jnp.float32) -> TwoLevelNet:
     """Model B: one task branch (reference model/modelB_singleTask.py:53)."""
     if task not in TASK_NUM_CLASSES:
         raise ValueError(f"unknown task {task!r}")
-    return TwoLevelNet(tasks=(task,), dtype=dtype, use_pallas=use_pallas)
+    return TwoLevelNet(tasks=(task,), dtype=dtype)

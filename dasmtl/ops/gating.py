@@ -1,77 +1,25 @@
-"""Fused sigmoid-gate application: ``sigmoid(mask_logits) * features``.
+"""Sigmoid-gate application: ``sigmoid(mask_logits) * features``.
 
 This is the hot elementwise pattern of the two-level network — every attention
 stage computes a sigmoid mask and multiplies it into the shared features
-(reference model/modelA_MTL.py:142-163).  XLA fuses the portable composition
-into the surrounding convolutions already; the Pallas path exists as the
-explicit TPU-kernel form (single VMEM-resident pass, one HBM read per operand,
-one write) and as the template for later fusions.
+(reference model/modelA_MTL.py:142-163).  XLA fuses this composition into the
+surrounding convolutions, so it is THE implementation.
 
-``gate_apply(..., use_pallas=True)`` uses the Pallas kernel on TPU and
-transparently falls back to the XLA composition elsewhere (CPU tests run the
-kernel in interpreter mode via ``force_interpret``).
+History (round-5 decision): rounds 2-4 also carried a hand-written Pallas
+kernel for this pattern (single VMEM-resident pass, custom VJP), selectable
+via ``use_pallas`` and staged for a TPU on/off sweep to justify keeping it or
+making it the default.  Three rounds of tunnel outages meant the sweep never
+ran on hardware, and an elementwise fusion XLA already performs is exactly
+the kernel one should NOT hand-write on spec — so per the round-4 verdict the
+kernel was removed (git history ``dasmtl/ops/gating.py`` before this commit
+preserves the custom-VJP pattern for when a measured win justifies one).
 """
 
 from __future__ import annotations
 
-import functools
-
 import jax
 
 
-def _gate_reference(mask_logits: jax.Array, features: jax.Array) -> jax.Array:
-    return jax.nn.sigmoid(mask_logits) * features
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=())
-def _gate_fused(mask_logits: jax.Array, features: jax.Array) -> jax.Array:
-    return _gate_pallas_fwd_impl(mask_logits, features)
-
-
-def _gate_fwd(mask_logits, features):
-    out = _gate_pallas_fwd_impl(mask_logits, features)
-    return out, (mask_logits, features)
-
-
-def _gate_bwd(res, g):
-    mask_logits, features = res
-    s = jax.nn.sigmoid(mask_logits)
-    d_features = s * g
-    d_logits = g * features * s * (1.0 - s)
-    return d_logits, d_features
-
-
-_gate_fused.defvjp(_gate_fwd, _gate_bwd)
-
-
-def _gate_kernel(l_ref, f_ref, o_ref):
-    o_ref[...] = jax.nn.sigmoid(l_ref[...]) * f_ref[...]
-
-
-def _gate_pallas_fwd_impl(mask_logits: jax.Array,
-                          features: jax.Array) -> jax.Array:
-    from jax.experimental import pallas as pl
-
-    # Compiled kernel on real TPU platforms ("tpu", or "axon" — this
-    # container's TPU-tunnel PJRT plugin); interpreter elsewhere (CPU tests).
-    interpret = jax.default_backend() not in ("tpu", "axon")
-    b = mask_logits.shape[0]
-    inner = mask_logits.shape[1:]
-    grid = (b,)
-    spec = pl.BlockSpec((1,) + inner, lambda i: (i,) + (0,) * len(inner))
-    return pl.pallas_call(
-        _gate_kernel,
-        grid=grid,
-        in_specs=[spec, spec],
-        out_specs=spec,
-        out_shape=jax.ShapeDtypeStruct(mask_logits.shape, features.dtype),
-        interpret=interpret,
-    )(mask_logits, features)
-
-
-def gate_apply(mask_logits: jax.Array, features: jax.Array,
-               use_pallas: bool = False) -> jax.Array:
+def gate_apply(mask_logits: jax.Array, features: jax.Array) -> jax.Array:
     """Apply the sigmoid attention gate to shared features."""
-    if use_pallas:
-        return _gate_fused(mask_logits, features)
-    return _gate_reference(mask_logits, features)
+    return jax.nn.sigmoid(mask_logits) * features

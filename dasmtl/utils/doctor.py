@@ -108,7 +108,6 @@ def collect() -> dict:
         "device_data": d.device_data,
         "steps_per_dispatch": d.steps_per_dispatch,
         "prefetch_batches": d.prefetch_batches,
-        "use_pallas": d.use_pallas,
         "bn_sync": d.bn_sync,
     }
     return info

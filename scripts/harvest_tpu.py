@@ -290,7 +290,7 @@ def stage_bench():
     """The driver headline: flagship train-step throughput (bf16, b256)."""
     from bench import _measure_config
 
-    row = _measure_config(256, "bfloat16", use_pallas=False,
+    row = _measure_config(256, "bfloat16",
                           warmup=3, measure=20, repeats=5)
     row["vs_baseline"] = _vs_baseline(row["value"], row.get("backend"))
     row["tpu_measured"] = row.get("backend") == "tpu"
@@ -308,28 +308,22 @@ def stage_sweep():
     the stage as done (or starting over)."""
     from bench import _measure_config
 
-    configs = [  # (batch, dtype, pallas) — pallas decision + scaling first
-        (256, "bfloat16", False),
-        (256, "bfloat16", True),
-        (512, "bfloat16", False),
-        (512, "bfloat16", True),
+    configs = [  # (batch, dtype) — production config + scaling first
+        (256, "bfloat16"),
+        (512, "bfloat16"),
         # Scaling probe past the headline batch: does MFU keep climbing?
         # (An OOM here is itself a finding; the row settles after retries.)
-        (1024, "bfloat16", False),
-        (256, "float32", False),
-        (32, "bfloat16", False),
-        (32, "float32", False),
-        (256, "float32", True),
-        (32, "bfloat16", True),
-        (32, "float32", True),
+        (1024, "bfloat16"),
+        (256, "float32"),
+        (32, "bfloat16"),
+        (32, "float32"),
     ]
     return _run_incremental(
-        configs, ("batch_size", "compute_dtype", "use_pallas"),
+        configs, ("batch_size", "compute_dtype"),
         f"sweep_{ROUND}.partial.json", f"sweep_{ROUND}.json",
-        lambda batch, dtype, pallas: _measure_config(
-            batch, dtype, pallas, warmup=2, measure=20),
-        lambda batch, dtype, pallas: f"sweep {batch}/{dtype}/"
-                                     f"pallas={pallas}")
+        lambda batch, dtype: _measure_config(
+            batch, dtype, warmup=2, measure=20),
+        lambda batch, dtype: f"sweep {batch}/{dtype}")
 
 
 def stage_models():
@@ -343,7 +337,7 @@ def stage_models():
         ("model",),
         f"models_bench_{ROUND}.partial.json",
         f"models_bench_{ROUND}.json",
-        lambda model: _measure_config(256, "bfloat16", use_pallas=False,
+        lambda model: _measure_config(256, "bfloat16",
                                       warmup=2, measure=20, model=model),
         lambda model: f"models {model}")
 

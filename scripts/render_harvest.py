@@ -3,7 +3,7 @@
 Written so evidence is self-describing even when nobody is around to edit
 BASELINE.md by hand: the harvest supervisor runs this after every worker
 exit, so ``artifacts/HARVEST_SUMMARY_<round>.md`` always reflects the
-current state of the round's capture — including the Pallas-gate decision
+current state of the round's capture — including the sweep table
 (round-2 verdict item 3) computed mechanically from the sweep rows, and
 the vs-published comparison for the headline bench row.  Partial captures
 render partially; missing stages are listed as missing.
@@ -57,57 +57,20 @@ def _tag(r: dict) -> str:
 
 
 def _sweep_table(rows: list) -> list:
-    out = ["| batch | dtype | pallas | samples/s | ms/step | MFU |",
-           "|---|---|---|---|---|---|"]
+    out = ["| batch | dtype | samples/s | ms/step | MFU |",
+           "|---|---|---|---|---|"]
     for r in rows:
         if "error" in r:
             out.append(f"| {r.get('batch_size')} | {r.get('compute_dtype')}"
-                       f" | {r.get('use_pallas')} | FAILED ×"
+                       f" | FAILED ×"
                        f"{r.get('attempts', 1)} | — | "
                        f"{r.get('error', '')[:60]} |")
         else:
             out.append(f"| {r.get('batch_size')} | {r.get('compute_dtype')}"
-                       f" | {r.get('use_pallas')} | {_fmt(r.get('value'))}"
+                       f" | {_fmt(r.get('value'))}"
                        f"{_tag(r)} | {_fmt(r.get('step_time_ms'), 3)}"
                        f" | {_fmt(r.get('mfu'), 4)} |")
     return out
-
-
-def _pallas_verdict(rows: list) -> str:
-    """Mechanical decision from paired sweep rows: does the Pallas gate
-    kernel beat plain XLA fusion at the production configs?"""
-    paired = {}
-    for r in rows:
-        if "error" in r or "value" not in r:
-            continue
-        key = (r.get("batch_size"), r.get("compute_dtype"))
-        paired.setdefault(key, {})[bool(r.get("use_pallas"))] = r["value"]
-    verdicts, production_gains = [], []
-    for (batch, dtype), vals in sorted(paired.items()):
-        if True in vals and False in vals and vals[False]:
-            gain = vals[True] / vals[False] - 1.0
-            verdicts.append(f"batch {batch}/{dtype}: pallas "
-                            f"{'+' if gain >= 0 else ''}{gain * 100:.1f}%")
-            # The decision is about the production config specifically
-            # (batch ≥256 AND bfloat16): a float32-only Pallas win must not
-            # flip the default the production dtype would regress under.
-            if batch >= 256 and dtype == "bfloat16":
-                production_gains.append(gain)
-    if not verdicts:
-        return ("No paired pallas-on/off rows captured yet — decision "
-                "pending.")
-    if not production_gains:
-        # Small-batch or off-dtype pairs alone must not produce a confident
-        # default — the decision is about the production config.
-        return (f"{'; '.join(verdicts)}.  No ≥256-batch bfloat16 pairs "
-                "captured yet — decision pending.")
-    # Default flips ON only when EVERY production pair clears the bar — a
-    # win at one batch size must not override a regression at another.
-    decision = ("MAKE DEFAULT ON" if min(production_gains) >= 0.02 else
-                "KEEP DEFAULT OFF")
-    return (f"{'; '.join(verdicts)}.  Decision at the production config "
-            f"(batch ≥256, bfloat16): **{decision}** (threshold: ≥2% win "
-            "at every ≥256-batch bfloat16 pair).")
 
 
 def render() -> str:
@@ -139,7 +102,10 @@ def render() -> str:
     sweep = _rows(_load(f"sweep_{ROUND}.json"))
     if sweep:
         lines += ["## Perf-lever sweep", ""] + _sweep_table(sweep) + [
-            "", f"Pallas gate: {_pallas_verdict(sweep)}", ""]
+            "", "Pallas gate: resolved round 5 — the kernel was removed "
+            "(zero tunnel windows in rounds 3-5 meant the on/off sweep "
+            "never ran; the XLA composition is THE implementation; "
+            "see BASELINE.md and dasmtl/ops/gating.py).", ""]
     else:
         missing.append("sweep (dtype/kernel/batch levers)")
 

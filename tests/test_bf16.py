@@ -80,28 +80,6 @@ def test_bf16_close_to_f32_on_one_step():
         results["float32"])
 
 
-@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
-def test_pallas_gate_trains(dtype):
-    """use_pallas=True through a full train step (interpret mode on CPU):
-    finite loss, grads flow through the custom-VJP gate."""
-    cfg = Config(model="MTL", batch_size=4, compute_dtype=dtype,
-                 use_pallas=True)
-    spec = get_model_spec(cfg.model)
-    state = build_state(cfg, spec, input_hw=HW)
-    params_before = jax.device_get(state.params)  # state is donated below
-    step = make_train_step(spec)
-    batch = jax.device_put(_batch(4, seed=9))
-    new_state, metrics = step(state, batch, np.float32(1e-3))
-    loss = float(metrics["loss_sum"]) / float(metrics["count"])
-    assert np.isfinite(loss)
-    # Params must have moved (gradients nonzero through the gate).
-    moved = any(
-        float(np.max(np.abs(np.asarray(a) - np.asarray(b)))) > 0
-        for a, b in zip(jax.tree.leaves(params_before),
-                        jax.tree.leaves(jax.device_get(new_state.params))))
-    assert moved
-
-
 def test_bf16_device_data_scan_path_trains():
     """The two TPU perf levers compose: bfloat16 compute through the
     device-resident scan-fused path trains (loss drops over dispatches,

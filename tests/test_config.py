@@ -60,3 +60,19 @@ def test_explicit_device_auto_beats_alias():
 
     cfg = parse_train_args(["--device", "auto", "--GPU_device", "False"])
     assert cfg.device == "auto"
+
+
+def test_from_json_tolerates_other_versions(capsys):
+    """An older run's config.json (e.g. carrying the removed use_pallas
+    field) must still load for resume, with a note."""
+    from dasmtl.config import Config
+
+    cfg = Config(model="MTL")
+    blob = cfg.to_json()
+    import json as _json
+
+    data = _json.loads(blob)
+    data["use_pallas"] = True
+    restored = Config.from_json(_json.dumps(data))
+    assert restored.model == "MTL"
+    assert "ignoring unknown fields" in capsys.readouterr().err

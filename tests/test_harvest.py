@@ -104,32 +104,31 @@ def test_stage_progress_resume_protocol(harvest, tmp_path):
     settled, fresh error rows come back as pending (with their attempt
     counts), CPU smoke rows are in neither, a missing partial falls back
     to the final artifact."""
-    keys = ("batch_size", "compute_dtype", "use_pallas")
+    keys = ("batch_size", "compute_dtype")
     assert harvest._stage_progress("none.partial.json", "none.json",
                                    keys) == ([], {})
     rows = [
         {"batch_size": 256, "compute_dtype": "bfloat16",
-         "use_pallas": False, "backend": "tpu", "value": 9.0},
+         "backend": "tpu", "value": 9.0},
         {"batch_size": 512, "compute_dtype": "bfloat16",
-         "use_pallas": False, "error": "OOM", "attempts": 1},
+         "error": "OOM", "attempts": 1},
         {"batch_size": 64, "compute_dtype": "bfloat16",
-         "use_pallas": False, "error": "OOM",
-         "attempts": harvest.MAX_ATTEMPTS},
+         "error": "OOM", "attempts": harvest.MAX_ATTEMPTS},
         {"batch_size": 32, "compute_dtype": "float32",
-         "use_pallas": False, "backend": "cpu", "value": 1.0},
+         "backend": "cpu", "value": 1.0},
     ]
     (tmp_path / "s.partial.json").write_text(json.dumps(rows))
     settled, pending = harvest._stage_progress("s.partial.json", "s.json",
                                                keys)
     assert sorted(r["batch_size"] for r in settled) == [64, 256]
-    assert list(pending) == [(512, "bfloat16", False)]
-    assert pending[(512, "bfloat16", False)]["attempts"] == 1
+    assert list(pending) == [(512, "bfloat16")]
+    assert pending[(512, "bfloat16")]["attempts"] == 1
     # No partial -> the promoted final artifact seeds the same way.
     (tmp_path / "s.partial.json").rename(tmp_path / "s.json")
     settled, pending = harvest._stage_progress("s.partial.json", "s.json",
                                                keys)
     assert sorted(r["batch_size"] for r in settled) == [64, 256]
-    assert list(pending) == [(512, "bfloat16", False)]
+    assert list(pending) == [(512, "bfloat16")]
 
 
 def test_run_incremental_survives_interrupted_windows(harvest, tmp_path):
@@ -224,73 +223,6 @@ def test_unknown_stage_name_errors(harvest, monkeypatch, capsys):
         harvest.main()
     assert exc.value.code == 2
     assert "unknown stage" in capsys.readouterr().err
-
-
-def test_pallas_verdict_mechanical_decision(harvest, monkeypatch):
-    """The round-2 verdict asked for the sweep to DECIDE the Pallas gate
-    default; render_harvest computes that decision mechanically from
-    paired on/off rows at production batch sizes."""
-    monkeypatch.syspath_prepend(_SCRIPTS)
-    sys.modules.pop("render_harvest", None)
-    rh = importlib.import_module("render_harvest")
-    try:
-        def rows(gain_at_256, batch=256):
-            return [
-                {"batch_size": batch, "compute_dtype": "bfloat16",
-                 "use_pallas": False, "value": 100.0, "backend": "tpu"},
-                {"batch_size": batch, "compute_dtype": "bfloat16",
-                 "use_pallas": True, "value": 100.0 * (1 + gain_at_256),
-                 "backend": "tpu"},
-            ]
-
-        assert "KEEP DEFAULT OFF" in rh._pallas_verdict(rows(-0.016))
-        assert "KEEP DEFAULT OFF" in rh._pallas_verdict(rows(0.01))
-        assert "MAKE DEFAULT ON" in rh._pallas_verdict(rows(0.05))
-        assert "pending" in rh._pallas_verdict(
-            [{"batch_size": 512, "error": "OOM"}])
-        # Small-batch pairs alone must not produce a confident default.
-        small_only = rh._pallas_verdict(rows(0.5, batch=32))
-        assert "pending" in small_only and "DEFAULT" not in small_only
-    finally:
-        sys.modules.pop("render_harvest", None)
-
-
-def test_pallas_verdict_keys_on_production_dtype(harvest, monkeypatch):
-    """A float32-only Pallas win must not flip the default: the decision is
-    keyed on the production config (batch >=256, bfloat16) specifically."""
-    monkeypatch.syspath_prepend(_SCRIPTS)
-    sys.modules.pop("render_harvest", None)
-    rh = importlib.import_module("render_harvest")
-    try:
-        f32_win_bf16_loss = [
-            {"batch_size": 256, "compute_dtype": "float32",
-             "use_pallas": False, "value": 100.0, "backend": "tpu"},
-            {"batch_size": 256, "compute_dtype": "float32",
-             "use_pallas": True, "value": 110.0, "backend": "tpu"},
-            {"batch_size": 256, "compute_dtype": "bfloat16",
-             "use_pallas": False, "value": 200.0, "backend": "tpu"},
-            {"batch_size": 256, "compute_dtype": "bfloat16",
-             "use_pallas": True, "value": 190.0, "backend": "tpu"},
-        ]
-        assert "KEEP DEFAULT OFF" in rh._pallas_verdict(f32_win_bf16_loss)
-        # A f32-only pair (no bf16 pair at >=256) leaves the decision pending.
-        pending = rh._pallas_verdict(f32_win_bf16_loss[:2])
-        assert "pending" in pending and "DEFAULT" not in pending
-        # A 256-batch win must not override a 512-batch regression: the
-        # default flips only when every production pair clears the bar.
-        mixed_batches = [
-            {"batch_size": 256, "compute_dtype": "bfloat16",
-             "use_pallas": False, "value": 100.0, "backend": "tpu"},
-            {"batch_size": 256, "compute_dtype": "bfloat16",
-             "use_pallas": True, "value": 105.0, "backend": "tpu"},
-            {"batch_size": 512, "compute_dtype": "bfloat16",
-             "use_pallas": False, "value": 100.0, "backend": "tpu"},
-            {"batch_size": 512, "compute_dtype": "bfloat16",
-             "use_pallas": True, "value": 80.0, "backend": "tpu"},
-        ]
-        assert "KEEP DEFAULT OFF" in rh._pallas_verdict(mixed_batches)
-    finally:
-        sys.modules.pop("render_harvest", None)
 
 
 def test_honest_name_for_non_tpu_captures(harvest):
