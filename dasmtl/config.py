@@ -172,8 +172,10 @@ class Config:
     @classmethod
     def from_json(cls, text: str) -> "Config":
         """Tolerant of fields written by other versions (e.g. the removed
-        ``use_pallas``): unknown keys are dropped with a note instead of
-        failing resume on an older run's ``config.json``."""
+        ``use_pallas``): unknown keys are dropped with a note.  Resume
+        itself restores through Orbax (never through this), but
+        ``config.json`` is the documented way to reconstruct a prior
+        run's settings, and an older run's file must stay loadable."""
         known = {f.name for f in dataclasses.fields(cls)}
         data = json.loads(text)
         dropped = sorted(set(data) - known)

@@ -234,7 +234,11 @@ def _stage_progress(partial_filename: str, final_filename: str,
     if not isinstance(rows, list):
         return [], {}
     rows = [r for r in rows
-            if isinstance(r, dict) and all(k in r for k in keys)]
+            if isinstance(r, dict) and all(k in r for k in keys)
+            # Pre-round-5 sweep rows carry the retired use_pallas axis;
+            # a kernel measurement must not be adopted as the settled row
+            # for a pallas-free config of the same (batch, dtype).
+            and "use_pallas" not in r]
     settled = [r for r in rows if _row_settled(r)]
     pending = {tuple(r[k] for k in keys): r
                for r in rows if "error" in r and not _row_settled(r)}

@@ -352,3 +352,21 @@ def test_write_artifact_renames_non_tpu_capture(harvest, tmp_path):
     assert (tmp_path / expected).exists()
     if backend != "tpu":
         assert not (tmp_path / f"bench_{harvest.ROUND}_tpu.json").exists()
+
+
+def test_stage_progress_rejects_pre_removal_pallas_rows(harvest, tmp_path):
+    """A partial written before the round-5 kernel removal can hold
+    use_pallas=True rows whose (batch, dtype) collide with the pallas-free
+    config — they must not be adopted as settled."""
+    rows = [
+        {"batch_size": 256, "compute_dtype": "bfloat16",
+         "use_pallas": True, "backend": "tpu", "value": 9.0},
+        {"batch_size": 256, "compute_dtype": "bfloat16",
+         "backend": "tpu", "value": 8.0},
+    ]
+    (tmp_path / "old.partial.json").write_text(json.dumps(rows))
+    settled, pending = harvest._stage_progress("old.partial.json",
+                                               "old.json",
+                                               ("batch_size",
+                                                "compute_dtype"))
+    assert [r["value"] for r in settled] == [8.0] and not pending
