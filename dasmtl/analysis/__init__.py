@@ -1,0 +1,38 @@
+"""Static + runtime tracing-discipline analysis for the dasmtl codebase.
+
+JAX-specific defects — stray host syncs inside the step path, per-step
+recompilation, PRNG key reuse, donated-buffer reads — pass CPU unit tests
+and only surface as silent wall-clock regressions (or heap corruption) on a
+real v4-8.  This package catches them twice:
+
+- :mod:`dasmtl.analysis.lint` — an AST linter with JAX-aware rules
+  (``dasmtl-lint``; rule registry in :mod:`dasmtl.analysis.rules`), run over
+  the package in CI.
+- :mod:`dasmtl.analysis.guards` — runtime guards that wrap the training
+  step: ``jax.transfer_guard("disallow")`` after warmup, an XLA
+  recompilation counter fed by ``jax.monitoring``, and optional NaN
+  checking.  Enabled by ``Config.tracing_guards``.
+
+``docs/STATIC_ANALYSIS.md`` documents every rule id and the
+``# dasmtl: noqa[RULE]`` suppression syntax.
+"""
+
+# Both halves re-export lazily: guards import jax (the linter must stay
+# importable without initializing any backend — dasmtl-lint runs in CI
+# containers with no accelerator and must never touch plugin init), and an
+# eager lint import would shadow `python -m dasmtl.analysis.lint` with a
+# runpy double-import warning.
+_LINT_EXPORTS = ("Finding", "lint_paths", "lint_source")
+_GUARD_EXPORTS = ("StepGuards", "GuardViolation", "RecompileError")
+
+
+def __getattr__(name):
+    if name in _LINT_EXPORTS:
+        from dasmtl.analysis import lint
+
+        return getattr(lint, name)
+    if name in _GUARD_EXPORTS:
+        from dasmtl.analysis import guards
+
+        return getattr(guards, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,182 @@
+"""Runtime tracing-discipline guards for the training step.
+
+The linter (:mod:`dasmtl.analysis.lint`) catches what is visible in the
+source; these guards catch what is only visible at runtime:
+
+- **Transfer guard** — after a warmup, every step body runs under
+  ``jax.transfer_guard("disallow")``: an *implicit* host<->device transfer
+  (a stray numpy operand, a ``float()`` on a device value) raises instead
+  of silently stalling the device pipeline.  Explicit transfers
+  (``jax.device_put`` in the prefetcher, ``jax.device_get`` at metric-window
+  flush) stay legal — the discipline is that the step path must *declare*
+  its transfers.
+- **Recompilation counter** — XLA compilations are counted via the
+  ``jax.monitoring`` event stream; a compilation landing inside a
+  post-warmup step raises :class:`RecompileError` (per-step recompilation
+  is the classic silent 100x slowdown: a shape/dtype/static-arg that
+  changes every step).
+- **NaN check** (optional) — flips ``jax_debug_nans`` for the run.
+
+Usage (what ``Trainer.fit`` does when ``Config.tracing_guards`` is set)::
+
+    guards = StepGuards(warmup_steps=steps_per_epoch)
+    with guards:
+        for step in range(n):
+            with guards.step():
+                state, metrics = train_step(state, batch, lr)
+    print(guards.summary())
+
+``jax.monitoring`` has no listener-removal API, so one module-level
+listener is registered lazily and fans out to whatever guards are active;
+an exited guard costs nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, List
+
+import jax
+
+_COMPILE_EVENT_PREFIX = "/jax/core/compile/backend_compile"
+
+_lock = threading.Lock()
+_listener_registered = False
+_active: List["StepGuards"] = []
+
+
+def _on_event_duration(name: str, duration: float, **_kw: Any) -> None:
+    if name.startswith(_COMPILE_EVENT_PREFIX):
+        with _lock:
+            for guard in _active:
+                guard._compiles += 1
+
+
+def _ensure_listener() -> None:
+    global _listener_registered
+    with _lock:
+        if not _listener_registered:
+            jax.monitoring.register_event_duration_secs_listener(
+                _on_event_duration)
+            _listener_registered = True
+
+
+class GuardViolation(RuntimeError):
+    """A tracing-discipline guard tripped."""
+
+
+class RecompileError(GuardViolation):
+    """An XLA compilation happened inside a post-warmup step."""
+
+
+class StepGuards:
+    """Run-level context manager + per-step :meth:`step` context.
+
+    Parameters
+    ----------
+    warmup_steps:
+        Steps before the guards arm.  The first pass over the data
+        legitimately compiles every program variant (including a ragged
+        final batch), so the natural warmup is one epoch.
+    transfer:
+        ``jax.transfer_guard`` level for post-warmup step bodies —
+        ``"disallow"`` (raise on implicit transfers), ``"log"``, or
+        ``"off"`` to skip the transfer guard entirely.
+    recompile_check:
+        Raise :class:`RecompileError` when a compilation lands in a
+        post-warmup step.
+    nan_check:
+        Enable ``jax_debug_nans`` while the run-level context is active.
+    """
+
+    def __init__(self, warmup_steps: int = 0, transfer: str = "disallow",
+                 recompile_check: bool = True, nan_check: bool = False):
+        if transfer not in ("off", "log", "disallow"):
+            raise ValueError(f"transfer={transfer!r}: expected "
+                             "off | log | disallow")
+        if warmup_steps < 0:
+            raise ValueError("warmup_steps must be >= 0")
+        self.warmup_steps = warmup_steps
+        self.transfer = transfer
+        self.recompile_check = recompile_check
+        self.nan_check = nan_check
+        self._compiles = 0
+        self._steps_seen = 0
+        self._post_warmup_compiles = 0
+        self._prev_debug_nans = None
+        self._entered = False
+
+    # -- run-level context ---------------------------------------------------
+    def __enter__(self) -> "StepGuards":
+        if self._entered:
+            raise RuntimeError("StepGuards is not reentrant")
+        _ensure_listener()
+        with _lock:
+            _active.append(self)
+        if self.nan_check:
+            self._prev_debug_nans = jax.config.jax_debug_nans
+            jax.config.update("jax_debug_nans", True)
+        self._entered = True
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        with _lock:
+            if self in _active:
+                _active.remove(self)
+        if self.nan_check and self._prev_debug_nans is not None:
+            jax.config.update("jax_debug_nans", self._prev_debug_nans)
+        self._entered = False
+
+    # -- per-step context ----------------------------------------------------
+    @contextmanager
+    def step(self, n: int = 1):
+        """Guard one step (or one fused dispatch of ``n`` steps).
+
+        Compilation is synchronous with the Python dispatch (the executable
+        must exist before the call returns), so comparing the counter
+        around the body attributes every compile to the step that caused
+        it even though device execution is asynchronous.
+        """
+        if not self._entered:
+            raise RuntimeError("StepGuards.step() outside the run context — "
+                               "use `with guards:` around the epoch loop")
+        armed = self._steps_seen >= self.warmup_steps
+        first_step = self._steps_seen
+        self._steps_seen += max(n, 1)
+        before = self._compiles
+        if armed and self.transfer != "off":
+            with jax.transfer_guard(self.transfer):
+                yield
+        else:
+            yield
+        if armed:
+            delta = self._compiles - before
+            if delta:
+                self._post_warmup_compiles += delta
+                if self.recompile_check:
+                    raise RecompileError(
+                        f"step {first_step}: {delta} XLA compilation(s) "
+                        f"after a {self.warmup_steps}-step warmup — "
+                        f"something in the step signature (shape / dtype / "
+                        f"static arg) changes per step")
+
+    # -- reporting -----------------------------------------------------------
+    @property
+    def compiles(self) -> int:
+        """Total XLA compilations observed while this guard was active."""
+        return self._compiles
+
+    @property
+    def post_warmup_compiles(self) -> int:
+        return self._post_warmup_compiles
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "steps": self._steps_seen,
+            "warmup_steps": self.warmup_steps,
+            "compiles": self._compiles,
+            "post_warmup_compiles": self._post_warmup_compiles,
+            "transfer_guard": self.transfer,
+            "nan_check": self.nan_check,
+        }

@@ -1,0 +1,331 @@
+"""JAX-aware AST linter — the ``dasmtl-lint`` entry point.
+
+Per module it builds a :class:`ModuleContext`: import-alias resolution
+(``import jax.numpy as jnp`` → ``jnp.take`` resolves to ``jax.numpy.take``),
+the set of functions that are *traced entries* (decorated with / passed to a
+jax transform — ``jit``, ``pjit``, ``vmap``, ``shard_map``, ``grad``,
+``lax.scan`` bodies, …), the module-local call graph, and the closure of
+functions reachable from those entries.  Rules (registered in
+:mod:`dasmtl.analysis.rules`) then walk that context and yield
+:class:`Finding`\\ s with a stable rule id, severity and ``file:line:col``.
+
+Suppression: a ``# dasmtl: noqa[DAS101]`` trailer on the flagged line
+silences that rule there (comma-separate several ids; bare
+``# dasmtl: noqa`` silences every rule on the line).  Plain flake8-style
+``# noqa`` comments are deliberately NOT honored — suppressing a tracing-
+discipline finding should be a visible, searchable decision.
+
+The analysis is intra-module and name-based — it cannot see through
+``self.step = make_train_step(...)`` into another module, and it prefers
+false negatives over false positives (a linter the build ignores is worse
+than a narrower one it trusts).  docs/STATIC_ANALYSIS.md lists each rule's
+exact scope.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import os
+import re
+import sys
+from typing import Dict, Iterator, List, Optional, Sequence, Set
+
+#: jax transforms whose function-valued arguments (and decorated functions)
+#: execute under tracing.  Keys are fully resolved dotted names.
+TRACING_TRANSFORMS = frozenset({
+    "jax.jit", "jax.pjit", "jax.experimental.pjit.pjit",
+    "jax.vmap", "jax.pmap", "jax.grad", "jax.value_and_grad",
+    "jax.jacfwd", "jax.jacrev", "jax.hessian",
+    "jax.shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.checkpoint", "jax.remat",
+    "jax.lax.scan", "jax.lax.map", "jax.lax.cond", "jax.lax.switch",
+    "jax.lax.while_loop", "jax.lax.fori_loop", "jax.lax.associative_scan",
+})
+
+#: Modules whose import aliases we resolve through.  Anything else keeps its
+#: literal spelling (e.g. ``self.cv_step`` stays ``self.cv_step``).
+_KNOWN_ROOTS = ("jax", "numpy", "functools")
+
+_NOQA_RE = re.compile(
+    r"#\s*dasmtl:\s*noqa(?:\[\s*([A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*)\s*\])?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str  # "error" | "warning"
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col + 1}: "
+                f"{self.rule} [{self.severity}] {self.message}")
+
+
+class ModuleContext:
+    """Everything a rule needs to know about one parsed module."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.aliases = _collect_aliases(tree)
+        # name -> all FunctionDef nodes of that name (any nesting level).
+        self.functions: Dict[str, List[ast.AST]] = {}
+        self._parent_fn: Dict[ast.AST, Optional[ast.AST]] = {}
+        for fn in _walk_functions(tree):
+            self.functions.setdefault(fn.name, []).append(fn)
+        self.traced_entries = self._find_traced_entries()
+        self.traced_reachable = self._close_over_calls(self.traced_entries)
+        self.noqa = _collect_noqa(source)
+
+    # -- name resolution -----------------------------------------------------
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted name of a Name/Attribute chain with import aliases applied;
+        None for anything that is not a plain chain (calls, subscripts)."""
+        parts = _dotted(node)
+        if parts is None:
+            return None
+        root, *rest = parts
+        resolved = self.aliases.get(root, root)
+        return ".".join([resolved] + rest)
+
+    # -- tracing scope -------------------------------------------------------
+    def _find_traced_entries(self) -> Set[ast.AST]:
+        entries: Set[ast.AST] = set()
+        for fns in self.functions.values():
+            for fn in fns:
+                for dec in fn.decorator_list:
+                    if self._is_transform_expr(dec):
+                        entries.add(fn)
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            name = self.resolve(call.func)
+            if name in TRACING_TRANSFORMS:
+                for arg in call.args:
+                    if isinstance(arg, ast.Name):
+                        entries.update(self.functions.get(arg.id, ()))
+            elif name == "functools.partial" and call.args:
+                # partial(jax.jit, ...)(f) — too dynamic; but
+                # partial(f, static) passed to a transform is covered by the
+                # Name case above.
+                continue
+        return entries
+
+    def _is_transform_expr(self, dec: ast.AST) -> bool:
+        """Decorator forms: @jax.jit, @partial(jax.jit, ...), @jax.jit(...)."""
+        name = self.resolve(dec)
+        if name in TRACING_TRANSFORMS:
+            return True
+        if isinstance(dec, ast.Call):
+            fname = self.resolve(dec.func)
+            if fname in TRACING_TRANSFORMS:
+                return True
+            if fname == "functools.partial" and dec.args:
+                return self.resolve(dec.args[0]) in TRACING_TRANSFORMS
+        return False
+
+    def _close_over_calls(self, entries: Set[ast.AST]) -> Set[ast.AST]:
+        """BFS over the name-based module-local call graph."""
+        reachable = set(entries)
+        frontier = list(entries)
+        while frontier:
+            fn = frontier.pop()
+            for call in self.calls_in(fn):
+                if isinstance(call.func, ast.Name):
+                    for callee in self.functions.get(call.func.id, ()):
+                        if callee not in reachable:
+                            reachable.add(callee)
+                            frontier.append(callee)
+        return reachable
+
+    # -- tree helpers --------------------------------------------------------
+    def body_walk(self, fn: ast.AST) -> Iterator[ast.AST]:
+        """Walk a function body WITHOUT descending into nested function /
+        class definitions (they are their own reachability nodes)."""
+        stack: List[ast.AST] = list(getattr(fn, "body", []))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested defs are their own reachability nodes
+            stack.extend(ast.iter_child_nodes(node))
+
+    def calls_in(self, fn: ast.AST) -> Iterator[ast.Call]:
+        for node in self.body_walk(fn):
+            if isinstance(node, ast.Call):
+                yield node
+
+    def traced_params(self, fn: ast.AST) -> Set[str]:
+        args = fn.args
+        names = [a.arg for a in (args.posonlyargs + args.args
+                                 + args.kwonlyargs)]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        return {n for n in names if n not in ("self", "cls")}
+
+    def module_level_nodes(self) -> Iterator[ast.AST]:
+        """Statements executed at import time: module body recursively,
+        stopping at function bodies (class bodies DO run at import)."""
+        stack: List[ast.AST] = list(self.tree.body)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue  # function bodies run at call time, not import
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _dotted(node: ast.AST) -> Optional[List[str]]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Alias -> canonical dotted module path, for the roots we resolve."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.split(".")[0] in _KNOWN_ROOTS:
+                    aliases[(a.asname or a.name.split(".")[0])] = (
+                        a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.module.split(".")[0] in _KNOWN_ROOTS:
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _walk_functions(tree: ast.Module) -> Iterator[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _collect_noqa(source: str) -> Dict[int, Optional[Set[str]]]:
+    """line -> suppressed rule ids (None = all rules suppressed there)."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _NOQA_RE.search(line)
+        if not m:
+            continue
+        if m.group(1) is None:
+            out[i] = None
+        else:
+            ids = {s.strip() for s in m.group(1).split(",")}
+            prev = out.get(i)
+            out[i] = None if prev is None else (prev or set()) | ids
+    return out
+
+
+# -- running ----------------------------------------------------------------
+
+def lint_source(source: str, path: str = "<string>",
+                select: Optional[Sequence[str]] = None) -> List[Finding]:
+    from dasmtl.analysis.rules import all_rules
+
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [Finding(rule="DAS000", severity="error", path=path,
+                        line=exc.lineno or 1, col=(exc.offset or 1) - 1,
+                        message=f"syntax error: {exc.msg}")]
+    ctx = ModuleContext(path, source, tree)
+    findings: List[Finding] = []
+    for rule in all_rules():
+        if select and rule.id not in select:
+            continue
+        findings.extend(rule.check(ctx))
+    kept = []
+    for f in findings:
+        suppressed = ctx.noqa.get(f.line)
+        if f.line in ctx.noqa and (suppressed is None or f.rule in suppressed):
+            continue
+        kept.append(f)
+    return sorted(kept, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def lint_paths(paths: Sequence[str],
+               select: Optional[Sequence[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for py in iter_python_files(paths):
+        try:
+            with open(py, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as exc:
+            findings.append(Finding(
+                rule="DAS000", severity="error", path=py, line=1, col=0,
+                message=f"unreadable: {exc}"))
+            continue
+        findings.extend(lint_source(source, py, select=select))
+    return findings
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        else:
+            raise FileNotFoundError(p)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    from dasmtl.analysis.rules import all_rules
+
+    ap = argparse.ArgumentParser(
+        prog="dasmtl-lint",
+        description="JAX-aware tracing-discipline linter "
+                    "(docs/STATIC_ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*", default=["dasmtl"],
+                    help="files or directories (default: dasmtl)")
+    ap.add_argument("--select", type=str, default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule registry and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id}  [{rule.severity:<7}] {rule.summary}")
+        return 0
+
+    select = args.select.split(",") if args.select else None
+    findings = lint_paths(args.paths or ["dasmtl"], select=select)
+    if args.format == "json":
+        print(json.dumps([dataclasses.asdict(f) for f in findings]))
+    else:
+        for f in findings:
+            print(f.render())
+        n_err = sum(1 for f in findings if f.severity == "error")
+        n_warn = len(findings) - n_err
+        if findings:
+            print(f"{len(findings)} finding(s): {n_err} error(s), "
+                  f"{n_warn} warning(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,59 @@
+"""Rule registry for the dasmtl linter.
+
+A rule is a :class:`Rule` with a stable id (``DASnnn`` — renumbering breaks
+``noqa`` trailers in the tree), a severity, a one-line summary, and a
+``check(ctx)`` generator over :class:`~dasmtl.analysis.lint.Finding`.
+Register with :func:`rule`; :func:`all_rules` returns the registry in id
+order.  Importing this package imports every rule module, which is what
+populates the registry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Iterable, List
+
+from dasmtl.analysis.lint import Finding, ModuleContext
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str
+    summary: str
+    check: Callable[[ModuleContext], Iterable[Finding]]
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def rule(id: str, severity: str, summary: str):  # noqa: A002 - mirrors ast
+    """Decorator registering ``check(ctx)`` under a rule id."""
+    if severity not in ("error", "warning"):
+        raise ValueError(f"severity {severity!r} must be error|warning")
+
+    def register(check: Callable[[ModuleContext], Iterable[Finding]]):
+        if id in _REGISTRY:
+            raise ValueError(f"duplicate rule id {id}")
+        _REGISTRY[id] = Rule(id=id, severity=severity, summary=summary,
+                             check=check)
+        return check
+
+    return register
+
+
+def make_finding(ctx: ModuleContext, rule_id: str, node, message: str,
+                 ) -> Finding:
+    r = _REGISTRY[rule_id]
+    return Finding(rule=rule_id, severity=r.severity, path=ctx.path,
+                   line=getattr(node, "lineno", 1),
+                   col=getattr(node, "col_offset", 0), message=message)
+
+
+def all_rules() -> List[Rule]:
+    # Import here (not at module top) so the registry modules can import
+    # this one without a cycle.
+    from dasmtl.analysis.rules import (donation, host_sync,  # noqa: F401
+                                       hygiene, prng, tracing)
+
+    return [r for _, r in sorted(_REGISTRY.items())]

@@ -1,0 +1,139 @@
+"""DAS107 — reading a buffer after donating it.
+
+``step = jax.jit(f, donate_argnums=(0,))`` hands argument 0's buffers to
+XLA for reuse: after ``step(state, ...)`` returns, ``state``'s arrays are
+dead — reading them returns whatever the executable wrote there (garbage
+that *looks* like data) or aborts outright.  The rule tracks names assigned
+from a donating ``jax.jit(...)`` in the same module and flags any read of a
+donated argument after the call without an intervening rebind (the idiom
+``state = step(state, ...)`` rebinds on the same statement and is clean).
+
+Module-local by design: a step constructed in another module
+(``make_train_step``) is invisible here — the runtime transfer/donation
+guards (:mod:`dasmtl.analysis.guards`) cover that half.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from dasmtl.analysis.lint import ModuleContext
+from dasmtl.analysis.rules import make_finding, rule
+
+
+def _chain(node: ast.AST) -> Optional[str]:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(parts[::-1])
+    return None
+
+
+def _donating_callables(ctx: ModuleContext) -> Dict[str, Tuple[int, ...]]:
+    """name -> donated positional indices, for ``x = jax.jit(f,
+    donate_argnums=...)`` assignments anywhere in the module."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        if ctx.resolve(node.value.func) not in ("jax.jit", "jax.pjit",
+                                                "jax.experimental.pjit.pjit"):
+            continue
+        donated: Tuple[int, ...] = ()
+        for kw in node.value.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            if isinstance(kw.value, ast.Constant) and isinstance(
+                    kw.value.value, int):
+                donated = (kw.value.value,)
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                donated = tuple(
+                    e.value for e in kw.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int))
+        if not donated:
+            continue
+        for tgt in node.targets:
+            name = _chain(tgt)
+            if name:
+                out[name] = donated
+    return out
+
+
+@rule("DAS107", "error",
+      "value read after being donated to a jitted call "
+      "(donate_argnums invalidates its buffers)")
+def check_donated_reuse(ctx: ModuleContext):
+    donating = _donating_callables(ctx)
+    if not donating:
+        return
+    for fns in ctx.functions.values():
+        for fn in fns:
+            yield from _check_scope(ctx, fn, donating)
+
+
+def _check_scope(ctx: ModuleContext, fn, donating):
+    # (line, col, kind, payload); kinds: 0 load, 1 donate, 2 rebind.
+    # Donation takes effect at the END of the call (after its argument
+    # loads); a rebinding assignment takes effect at the END of its
+    # statement (after the donating RHS).
+    events: List[Tuple[int, int, int, object]] = []
+    for node in ctx.body_walk(fn):
+        if isinstance(node, ast.Call):
+            name = _chain(node.func)
+            if name in donating:
+                victims = []
+                for pos in donating[name]:
+                    if pos < len(node.args):
+                        victim = _chain(node.args[pos])
+                        if victim:
+                            victims.append(victim)
+                if victims:
+                    events.append((node.end_lineno or node.lineno,
+                                   (node.end_col_offset or 0) + 1, 1,
+                                   (name, victims, node)))
+        if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                getattr(node, "ctx", None), ast.Load):
+            name = _chain(node)
+            if name:
+                events.append((node.lineno, node.col_offset, 0, (name, node)))
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for tgt in targets:
+                elts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) \
+                    else [tgt]
+                for e in elts:
+                    name = _chain(e)
+                    if name:
+                        events.append((node.end_lineno or node.lineno,
+                                       10 ** 6, 2, name))
+        if isinstance(node, ast.For):
+            name = _chain(node.target)
+            if name:
+                events.append((node.lineno, 10 ** 6, 2, name))
+    events.sort(key=lambda e: (e[0], e[1], e[2]))
+    dead: Dict[str, str] = {}  # victim name -> donating callable name
+    for _line, _col, kind, payload in events:
+        if kind == 1:
+            callee, victims, _node = payload
+            for v in victims:
+                dead[v] = callee
+        elif kind == 2:
+            dead.pop(payload, None)
+        else:
+            name, node = payload
+            for victim, callee in dead.items():
+                if name == victim or name.startswith(victim + "."):
+                    yield make_finding(
+                        ctx, "DAS107", node,
+                        f"{victim!r} was donated to {callee!r} above and "
+                        f"its buffers are dead; rebind the result "
+                        f"({victim} = {callee}(...)) before reading it")
+                    dead.pop(victim, None)
+                    break

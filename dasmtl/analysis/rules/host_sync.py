@@ -1,0 +1,89 @@
+"""Host-synchronization rules.
+
+DAS101 — a host-sync call inside traced (jit-reachable) code.  Every one of
+these either fails at trace time or, worse, silently constant-folds a traced
+value and changes semantics; in the step path they stall the device pipeline.
+
+DAS105 — ``jax.devices()`` / ``jax.device_put`` / … at module import time.
+Import-time backend calls initialize the platform before the process has a
+chance to pick one (``--device``, ``JAX_PLATFORMS``), and on this
+container's TPU-tunnel plugin they can block the import forever.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dasmtl.analysis.lint import ModuleContext
+from dasmtl.analysis.rules import make_finding, rule
+
+#: Fully-resolved callables that force a device->host sync (or a host copy
+#: of a traced value) when they appear under tracing.
+_SYNC_CALLS = frozenset({
+    "jax.device_get",
+    "numpy.asarray", "numpy.array", "numpy.copy", "numpy.save",
+})
+
+#: Method names that sync when invoked on an array inside traced code.
+_SYNC_METHODS = frozenset({"block_until_ready", "item", "tolist"})
+
+#: Builtins that pull a traced scalar to the host.
+_SYNC_BUILTINS = frozenset({"float", "int", "bool", "complex"})
+
+#: Backend calls that must not run at module import time.
+_IMPORT_TIME_DEVICE_CALLS = frozenset({
+    "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.default_backend", "jax.device_put",
+    "jax.device_get", "jax.process_count", "jax.process_index",
+})
+
+
+@rule("DAS101", "error",
+      "host-sync call (device_get / np.asarray / .item / float(traced)) "
+      "inside jit-reachable code")
+def check_host_sync(ctx: ModuleContext):
+    for fn in ctx.traced_reachable:
+        params = ctx.traced_params(fn)
+        for call in ctx.calls_in(fn):
+            name = ctx.resolve(call.func)
+            if name in _SYNC_CALLS:
+                yield make_finding(
+                    ctx, "DAS101", call,
+                    f"{name} inside traced function {fn.name!r} forces a "
+                    f"host sync (use jnp / keep data on device)")
+            elif (isinstance(call.func, ast.Attribute)
+                  and call.func.attr in _SYNC_METHODS):
+                yield make_finding(
+                    ctx, "DAS101", call,
+                    f".{call.func.attr}() inside traced function "
+                    f"{fn.name!r} forces a host sync")
+            elif (isinstance(call.func, ast.Name)
+                  and call.func.id in _SYNC_BUILTINS
+                  and _mentions(call.args, params)):
+                yield make_finding(
+                    ctx, "DAS101", call,
+                    f"{call.func.id}() on a traced value inside "
+                    f"{fn.name!r} pulls it to the host (trace error or "
+                    f"silent constant fold)")
+
+
+@rule("DAS105", "warning",
+      "jax device/backend call at module import time")
+def check_import_time_device(ctx: ModuleContext):
+    for node in ctx.module_level_nodes():
+        if isinstance(node, ast.Call):
+            name = ctx.resolve(node.func)
+            if name in _IMPORT_TIME_DEVICE_CALLS:
+                yield make_finding(
+                    ctx, "DAS105", node,
+                    f"{name} at import time initializes the backend before "
+                    f"device selection (and can hang on a plugin platform); "
+                    f"move it inside a function")
+
+
+def _mentions(nodes, names) -> bool:
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Name) and sub.id in names:
+                return True
+    return False

@@ -1,0 +1,40 @@
+"""DAS104 — mutable default arguments.
+
+``def f(x, acc=[])`` shares ONE list across calls.  In jax code the sharper
+version of the trap: a mutable default captured by a jitted function is
+baked into the trace as a constant, so later mutation silently diverges
+from the compiled program.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dasmtl.analysis.lint import ModuleContext
+from dasmtl.analysis.rules import make_finding, rule
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+_MUTABLE_CALLS = frozenset({"list", "dict", "set", "bytearray",
+                            "defaultdict", "Counter", "deque"})
+
+
+@rule("DAS104", "warning",
+      "mutable default argument (shared across calls; baked into jitted "
+      "traces as a constant)")
+def check_mutable_defaults(ctx: ModuleContext):
+    for fns in ctx.functions.values():
+        for fn in fns:
+            args = fn.args
+            for default in list(args.defaults) + [
+                    d for d in args.kw_defaults if d is not None]:
+                bad = isinstance(default, _MUTABLE_LITERALS)
+                if (isinstance(default, ast.Call)
+                        and isinstance(default.func, ast.Name)
+                        and default.func.id in _MUTABLE_CALLS):
+                    bad = True
+                if bad:
+                    yield make_finding(
+                        ctx, "DAS104", default,
+                        f"mutable default in {fn.name!r} is shared across "
+                        f"calls; default to None and create inside")

@@ -1,0 +1,90 @@
+"""DAS103 — PRNG key reuse.
+
+Passing the same key to two consumers gives them *identical* randomness
+(correlated dropout masks, repeated noise draws) — the classic silent JAX
+bug.  Tracked per function scope, in source order: any name passed as the
+key argument of a ``jax.random.*`` call is a key (parameters included);
+consuming one that was already consumed — without an intervening
+reassignment — is flagged.  Derivation calls (``split`` / ``fold_in``) mark
+the parent used (using the parent *after* splitting it is the same bug) but
+are themselves tolerated on a used key, so the ``key = fold_in(key, step)``
+advance idiom stays clean.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from dasmtl.analysis.lint import ModuleContext
+from dasmtl.analysis.rules import make_finding, rule
+
+_KEY_MAKERS = frozenset({
+    "jax.random.PRNGKey", "jax.random.key", "jax.random.split",
+    "jax.random.fold_in", "jax.random.clone",
+})
+
+_DERIVERS = frozenset({"jax.random.split", "jax.random.fold_in"})
+
+
+def _is_random_call(name) -> bool:
+    return (name is not None and name.startswith("jax.random.")
+            and name not in ("jax.random.key_data",
+                             "jax.random.wrap_key_data"))
+
+
+def _scopes(ctx: ModuleContext):
+    yield ctx.tree
+    for fns in ctx.functions.values():
+        yield from fns
+
+
+@rule("DAS103", "error",
+      "PRNG key passed to two consumers without an intervening split "
+      "(identical randomness)")
+def check_key_reuse(ctx: ModuleContext):
+    for scope in _scopes(ctx):
+        nodes = (list(ctx.module_level_nodes())
+                 if isinstance(scope, ast.Module)
+                 else list(ctx.body_walk(scope)))
+        # (line, col, kind, payload): kind 0 = consumption
+        # (name, node, is_deriver), 1 = key-minting assignment (name),
+        # 2 = non-key assignment retiring the name.  Assignments sort after
+        # same-statement consumptions (the RHS evaluates first).
+        events: List[Tuple[int, int, int, object]] = []
+        for node in nodes:
+            if isinstance(node, ast.Assign):
+                value_name = (ctx.resolve(node.value.func)
+                              if isinstance(node.value, ast.Call) else None)
+                kind = 1 if value_name in _KEY_MAKERS else 2
+                for tgt in node.targets:
+                    elts = tgt.elts if isinstance(
+                        tgt, (ast.Tuple, ast.List)) else [tgt]
+                    for e in elts:
+                        if isinstance(e, ast.Name):
+                            events.append((node.end_lineno or node.lineno,
+                                           10 ** 6, kind, e.id))
+            if isinstance(node, ast.Call):
+                name = ctx.resolve(node.func)
+                if _is_random_call(name) and node.args and isinstance(
+                        node.args[0], ast.Name):
+                    events.append((node.lineno, node.col_offset, 0,
+                                   (node.args[0].id, node,
+                                    name in _DERIVERS)))
+        events.sort(key=lambda e: (e[0], e[1]))
+        state: Dict[str, str] = {}  # name -> "used" | "dead"
+        for _line, _col, kind, payload in events:
+            if kind == 1:
+                state.pop(payload, None)  # freshly minted key
+            elif kind == 2:
+                state[payload] = "dead"  # name no longer holds a key
+            else:
+                name, node, is_deriver = payload
+                if state.get(name) == "used" and not is_deriver:
+                    yield make_finding(
+                        ctx, "DAS103", node,
+                        f"key {name!r} already consumed — split it "
+                        f"(jax.random.split) instead of reusing; reuse "
+                        f"gives identical randomness")
+                elif state.get(name) != "dead":
+                    state[name] = "used"

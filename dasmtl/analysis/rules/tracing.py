@@ -1,0 +1,108 @@
+"""Trace-correctness rules.
+
+DAS102 — Python ``if`` / ``while`` / ``for`` over a traced value inside a
+traced function.  Tracing evaluates the condition ONCE with an abstract
+value: either it raises a ``TracerBoolConversionError`` at trace time, or
+(when the condition folds to a concrete Python bool) it silently bakes one
+branch into the program.  Use ``jnp.where`` / ``lax.cond`` / ``lax.scan``.
+
+DAS106 — ``print()`` / f-string interpolation of traced values inside a
+traced function.  These run at trace time (once), not at step time — they
+look like per-step logging and are not; use ``jax.debug.print``.
+
+Both rules only look at the *parameters* of jit-reachable functions (the
+values that are certainly tracers) and skip shape/dtype/static accesses, so
+idiomatic static configuration (``if spec.uses_dropout``, ``x.shape[0]``,
+``if mask is None``) never trips them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from dasmtl.analysis.lint import ModuleContext
+from dasmtl.analysis.rules import make_finding, rule
+
+#: Calls whose results are static even when applied to traced arrays.
+_STATIC_CALLS = frozenset({"len", "isinstance", "hasattr", "getattr",
+                           "callable", "type", "range", "enumerate", "zip"})
+
+
+def _traced_names_in_expr(ctx: ModuleContext, expr: ast.AST,
+                          params: Set[str]) -> Set[str]:
+    """Traced parameter names referenced as VALUES in ``expr`` — pruning
+    attribute accesses (``x.shape``, ``spec.uses_dropout``), static builtin
+    calls, and ``is (not) None`` comparisons, all of which are static under
+    tracing."""
+    hits: Set[str] = set()
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Attribute):
+            continue  # any attribute of a tracer we treat as static-ish
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in _STATIC_CALLS):
+            continue
+        if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            continue  # `x is None` is a static identity check
+        if isinstance(node, ast.Name) and node.id in params:
+            hits.add(node.id)
+        stack.extend(ast.iter_child_nodes(node))
+    return hits
+
+
+@rule("DAS102", "error",
+      "Python control flow (if/while/for) over a traced value inside "
+      "jit-reachable code")
+def check_traced_control_flow(ctx: ModuleContext):
+    for fn in ctx.traced_reachable:
+        params = ctx.traced_params(fn)
+        if not params:
+            continue
+        for node in ctx.body_walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                hits = _traced_names_in_expr(ctx, node.test, params)
+                kind = "if" if isinstance(node, ast.If) else "while"
+            elif isinstance(node, ast.For):
+                hits = _traced_names_in_expr(ctx, node.iter, params)
+                kind = "for"
+            else:
+                continue
+            if hits:
+                yield make_finding(
+                    ctx, "DAS102", node,
+                    f"`{kind}` over traced value(s) {sorted(hits)} in "
+                    f"{fn.name!r}: tracing evaluates this once — use "
+                    f"jnp.where / lax.cond / lax.scan")
+
+
+@rule("DAS106", "warning",
+      "print() / f-string on traced values inside jit-reachable code "
+      "(runs at trace time, not step time)")
+def check_trace_time_side_effects(ctx: ModuleContext):
+    for fn in ctx.traced_reachable:
+        params = ctx.traced_params(fn)
+        for node in ctx.body_walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                yield make_finding(
+                    ctx, "DAS106", node,
+                    f"print() inside traced function {fn.name!r} runs once "
+                    f"at trace time — use jax.debug.print for per-step "
+                    f"output")
+            elif isinstance(node, ast.JoinedStr) and params:
+                for value in node.values:
+                    if not isinstance(value, ast.FormattedValue):
+                        continue
+                    hits = _traced_names_in_expr(ctx, value.value, params)
+                    if hits:
+                        yield make_finding(
+                            ctx, "DAS106", node,
+                            f"f-string interpolates traced value(s) "
+                            f"{sorted(hits)} in {fn.name!r}: formats the "
+                            f"tracer (or trace-time constant), not the "
+                            f"per-step value")
+                        break

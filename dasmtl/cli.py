@@ -40,3 +40,13 @@ def stream_main(argv=None) -> int:
     from dasmtl.stream import main
 
     return main(argv)
+
+
+def lint_main(argv=None) -> int:
+    """``dasmtl-lint`` — the JAX-aware tracing-discipline linter
+    (dasmtl/analysis/lint.py; rules in docs/STATIC_ANALYSIS.md).  Pure AST
+    analysis: no jax import, no backend init, safe anywhere."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from dasmtl.analysis.lint import main
+
+    return main(argv)

@@ -119,6 +119,16 @@ class Config:
     model_path: Optional[str] = None  # checkpoint to restore
     resume: bool = False  # resume full TrainState from latest in run dir
 
+    # ---- tracing-discipline guards (dasmtl/analysis/guards.py) ----
+    # Wrap every post-warmup train step in jax.transfer_guard and an XLA
+    # recompilation counter: an implicit host<->device transfer or a
+    # per-step recompile raises instead of silently serializing the device
+    # pipeline.  CPU-cheap; the defects it catches only *show* on a v4-8.
+    tracing_guards: bool = False
+    guard_warmup_steps: int = -1  # -1 = the whole first epoch
+    guard_transfer: str = "disallow"  # off | log | disallow
+    guard_nan_check: bool = False  # jax_debug_nans while guarded
+
     # ---- misc ----
     seed: int = 1
     log_every_steps: int = 100  # metric-line cadence (reference utils.py:376)
@@ -140,6 +150,9 @@ class Config:
             raise ValueError(f"unknown device_data {self.device_data!r}")
         if self.steps_per_dispatch < 1:
             raise ValueError("steps_per_dispatch must be >= 1")
+        if self.guard_transfer not in ("off", "log", "disallow"):
+            raise ValueError(
+                f"unknown guard_transfer {self.guard_transfer!r}")
         if self.cv_parallel and self.fold_index is not None:
             raise ValueError("cv_parallel trains every fold at once; "
                              "--fold_index selects a single fold — pick one")
@@ -314,6 +327,20 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--resume", action=argparse.BooleanOptionalAction,
                    default=d.resume)
     p.add_argument("--profile_dir", type=str, default=None)
+    p.add_argument("--tracing_guards", action=argparse.BooleanOptionalAction,
+                   default=d.tracing_guards,
+                   help="arm the runtime tracing-discipline guards: "
+                        "transfer_guard + recompile counter on post-warmup "
+                        "train steps (docs/STATIC_ANALYSIS.md)")
+    p.add_argument("--guard_warmup_steps", type=int,
+                   default=d.guard_warmup_steps,
+                   help="steps before the guards arm (-1 = first epoch)")
+    p.add_argument("--guard_transfer", type=str, default=d.guard_transfer,
+                   choices=["off", "log", "disallow"],
+                   help="jax.transfer_guard level inside guarded steps")
+    p.add_argument("--guard_nan_check", action=argparse.BooleanOptionalAction,
+                   default=d.guard_nan_check,
+                   help="enable jax_debug_nans while the guards are active")
 
 
 def _resolve_compat(ns: argparse.Namespace) -> dict:

@@ -72,11 +72,18 @@ class CheckpointManager:
         path = os.path.join(self.root, f"step_{step}")
         self._ckptr.wait_until_finished()  # one write in flight at a time
         self._prune()  # prunes only finalized step dirs, never the in-flight
-        # AsyncCheckpointer.save blocks until the payload is copied off
-        # device, then writes in the background — that contract is what makes
-        # donation safe.  Passing the jax.Arrays (not a device_get'd copy)
-        # also lets Orbax write per-host shards in a multi-host run.
-        self._ckptr.save(path, state_payload(state), force=True)
+        payload = state_payload(state)
+        if jax.process_count() == 1:
+            # Snapshot to owned host copies before the background write: on
+            # the CPU backend "copying off device" is a zero-copy view of the
+            # live buffers, so a train step donating the state right after
+            # save() returns would corrupt the in-flight write (the donated
+            # executable reuses those buffers).  np.array(copy=True) severs
+            # the alias.  Multi-host runs keep the jax.Arrays so Orbax can
+            # write per-host shards; there the D2H copy is real.
+            payload = jax.tree.map(
+                lambda a: np.array(jax.device_get(a), copy=True), payload)
+        self._ckptr.save(path, payload, force=True)
         return path
 
     def wait(self) -> None:

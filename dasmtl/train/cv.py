@@ -29,6 +29,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from dasmtl.config import Config, mixed_label
@@ -165,9 +166,10 @@ class CVTrainer:
         return jax.tree.map(lambda a: jax.device_put(a, fold_sharded), packed)
 
     def _place_plan(self, arr: np.ndarray):
-        """idx/weight plans are [K, F, B]: shard the fold axis."""
+        """idx/weight plans are [K, F, B]: explicit placement (the step
+        path declares its transfers), sharding the fold axis under a mesh."""
         if self.mesh_plan is None:
-            return arr
+            return jax.device_put(arr)
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         return jax.device_put(
@@ -266,7 +268,9 @@ class CVTrainer:
     def _train_epoch(self, epoch: int, lr: float) -> None:
         idx, weight = self._epoch_plan(epoch)
         k_step = dispatch_len(self.cfg.steps_per_dispatch, idx.shape[0])
-        lr_arr = np.float32(lr)
+        # Device-placed scalar — same tracing discipline as Trainer: a
+        # numpy lr argument would be an implicit H2D transfer per dispatch.
+        lr_arr = jnp.float32(lr)
         t0 = time.perf_counter()
         window: Dict[str, Any] = {}
         done = 0
@@ -279,7 +283,10 @@ class CVTrainer:
             for key, v in stacked.items():  # [k, F] sums
                 window[key] = window.get(key, 0.0) + v.sum(axis=0)
             done += k
-        window = {k: np.asarray(jax.device_get(v)) for k, v in window.items()}
+        # ONE device_get of the whole window pytree (not one blocking
+        # transfer per metric) — same fix as Trainer._flush_window.
+        window = {k: np.asarray(v)
+                  for k, v in jax.device_get(window).items()}
         n = np.maximum(window.get("count", np.zeros(self.n_folds)), 1.0)
         mean_loss = window["loss_sum"] / n
         elapsed = time.perf_counter() - t0

@@ -38,11 +38,14 @@ import json
 import os
 import signal
 import time
+from contextlib import ExitStack, nullcontext
 from typing import Any, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
+from dasmtl.analysis.guards import StepGuards
 from dasmtl.config import Config, mixed_label
 from dasmtl.data.device import DeviceDataset, resident_bytes, unwrap_source
 from dasmtl.data.pipeline import BatchIterator, eval_batches, prefetch
@@ -183,6 +186,9 @@ class Trainer:
         self._val_device: Optional[DeviceDataset] = None
         self._gather_eval_step = None
         self._val_device_noticed = False
+        # Runtime tracing-discipline guards (dasmtl/analysis/guards.py),
+        # armed by fit() when cfg.tracing_guards is set.
+        self.guards: Optional[StepGuards] = None
 
     def request_preempt(self) -> None:
         """Ask the running ``fit`` to stop at the next safe point and write a
@@ -400,6 +406,12 @@ class Trainer:
         return dispatch_len(self.cfg.steps_per_dispatch,
                             self.train_iter.steps_per_epoch())
 
+    def _step_guard(self, n: int = 1):
+        """Per-step (or per-dispatch of ``n`` fused steps) guard context;
+        a no-op unless fit() armed the guards."""
+        return self.guards.step(n) if self.guards is not None \
+            else nullcontext()
+
     def _train_epoch_device(self, epoch: int, lr: float) -> None:
         """One epoch on the device-resident path: the training set lives in
         HBM and each dispatch scans ``steps_per_dispatch`` fused train steps
@@ -420,13 +432,21 @@ class Trainer:
         dispatch_k = self._dispatch_k()
         window: Dict[str, Any] = {}
         t0 = time.perf_counter()
-        lr_arr = np.float32(lr)
+        # Device-placed scalar: an np.float32 argument would be an *implicit*
+        # H2D transfer on every dispatch (flagged by the transfer guard);
+        # placing it once per epoch keeps the step call transfer-free.
+        lr_arr = jnp.float32(lr)
         done = last_flush = 0
         while done < steps and not self._preempted:
             k = min(dispatch_k, steps - done)
-            self.state, stacked = self._scan_step(
-                self.state, self._device_data.data,
-                idx[done:done + k], weight[done:done + k], lr_arr)
+            # Explicit placement of the index/validity plan slices — the
+            # step path declares its transfers (tracing-guard discipline).
+            plan_k = jax.device_put((idx[done:done + k],
+                                     weight[done:done + k]))
+            with self._step_guard(k):
+                self.state, stacked = self._scan_step(
+                    self.state, self._device_data.data,
+                    plan_k[0], plan_k[1], lr_arr)
             # Per-step sums arrive stacked [k]; fold into the window without
             # forcing a host sync.
             for key, v in stacked.items():
@@ -448,15 +468,19 @@ class Trainer:
             return
         window: Dict[str, float] = {}
         t0 = time.perf_counter()
-        lr_arr = np.float32(lr)
+        # jnp scalar, not np.float32: a numpy argument is an implicit H2D
+        # transfer on EVERY step — the exact defect the transfer guard
+        # polices.  One explicit placement per epoch instead.
+        lr_arr = jnp.float32(lr)
         batches = prefetch(self.train_iter.epoch(epoch),
                            depth=self.cfg.prefetch_batches,
                            place_fn=self._place)
         last_step = -1
         for i, batch in enumerate(batches):
             last_step = i
-            self.state, step_metrics = self.train_step(
-                self.state, batch, lr_arr)
+            with self._step_guard():
+                self.state, step_metrics = self.train_step(
+                    self.state, batch, lr_arr)
             # Accumulate device scalars without forcing a sync each step.
             for k, v in step_metrics.items():
                 window[k] = window.get(k, 0.0) + v
@@ -477,7 +501,10 @@ class Trainer:
                       window: Dict[str, float], t0: float) -> None:
         # Sync BEFORE reading the clock: the dispatches are asynchronous, so
         # measuring at call time would report enqueue rate, not compute rate.
-        window = {k: float(jax.device_get(v)) for k, v in window.items()}
+        # ONE device_get of the whole window pytree — a per-entry
+        # float(device_get(v)) would round-trip the host N times per flush
+        # (N ≈ 4 + number of loss parts), each a separate blocking transfer.
+        window = {k: float(v) for k, v in jax.device_get(window).items()}
         elapsed = time.perf_counter() - t0
         n = max(window.get("count", 0.0), 1.0)
         # Weighted mean over the window's real examples (exact even when the
@@ -512,6 +539,18 @@ class Trainer:
         results: List[ValidationResult] = []
         start_epoch = int(jax.device_get(self.state.epoch))
         self._preempted = False  # a prior preempted fit() must not stick
+        if cfg.tracing_guards:
+            # Warmup -1 = one full epoch: the first pass legitimately
+            # compiles every program variant (ragged tail batch included);
+            # from epoch 1 on, the shapes repeat and any compile is a bug.
+            warmup = (cfg.guard_warmup_steps if cfg.guard_warmup_steps >= 0
+                      else self.train_iter.steps_per_epoch())
+            self.guards = StepGuards(warmup_steps=warmup,
+                                     transfer=cfg.guard_transfer,
+                                     nan_check=cfg.guard_nan_check)
+            print(f"[guards] armed: warmup={warmup} steps, "
+                  f"transfer={cfg.guard_transfer}, "
+                  f"nan_check={cfg.guard_nan_check}")
         # Preemption safety: TPU pods deliver SIGTERM ahead of maintenance /
         # capacity reclaims — stop at the next step boundary and write a full
         # resumable checkpoint instead of losing the run.
@@ -526,24 +565,29 @@ class Trainer:
         except ValueError:
             pass  # not the main thread (e.g. embedded use); handler skipped
         try:
-            for epoch in range(start_epoch, cfg.epoch_num):
-                lr = stepped_lr(epoch, base_lr=cfg.lr,
-                                factor=cfg.lr_decay_factor,
-                                every=cfg.lr_decay_every,
-                                decay_at_epoch0=cfg.decay_at_epoch0)
-                if epoch % cfg.val_every == 0:
-                    results.append(self._validate_and_checkpoint(epoch))
-                print(f"[epoch {epoch}] lr={lr:.6g}")
-                self._train_epoch(epoch, lr)
-                if self._preempted:
-                    path = self.ckpt.save(self.state)
-                    self.ckpt.wait()  # the process is about to exit
-                    print(f"[preempt] SIGTERM: saved full state at epoch "
-                          f"{epoch} -> {path}; resume with --resume")
-                    return results
-                if cfg.ckpt_every_epochs and (
-                        epoch + 1) % cfg.ckpt_every_epochs == 0:
-                    self.ckpt.save(self.state)
+            with ExitStack() as guard_ctx:
+                if self.guards is not None:
+                    guard_ctx.enter_context(self.guards)
+                for epoch in range(start_epoch, cfg.epoch_num):
+                    lr = stepped_lr(epoch, base_lr=cfg.lr,
+                                    factor=cfg.lr_decay_factor,
+                                    every=cfg.lr_decay_every,
+                                    decay_at_epoch0=cfg.decay_at_epoch0)
+                    if epoch % cfg.val_every == 0:
+                        results.append(self._validate_and_checkpoint(epoch))
+                    print(f"[epoch {epoch}] lr={lr:.6g}")
+                    self._train_epoch(epoch, lr)
+                    if self._preempted:
+                        path = self.ckpt.save(self.state)
+                        self.ckpt.wait()  # the process is about to exit
+                        print(f"[preempt] SIGTERM: saved full state at epoch "
+                              f"{epoch} -> {path}; resume with --resume")
+                        return results
+                    if cfg.ckpt_every_epochs and (
+                            epoch + 1) % cfg.ckpt_every_epochs == 0:
+                        self.ckpt.save(self.state)
+            if self.guards is not None:
+                print(f"[guards] clean run: {self.guards.summary()}")
         finally:
             if handler_installed:
                 # A C-installed prior handler reads back as None and can't be

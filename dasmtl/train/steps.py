@@ -21,6 +21,7 @@ a recompile.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Tuple
 
 import jax
@@ -30,6 +31,44 @@ from jax.sharding import PartitionSpec as P
 from dasmtl.config import mixed_label
 from dasmtl.models.registry import ModelSpec
 from dasmtl.train.state import TrainState
+
+try:  # jax >= 0.5 exposes shard_map at the top level
+    _shard_map = jax.shard_map
+except AttributeError:  # this container's jax 0.4.x keeps it experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def donate_argnums(*argnums: int) -> Tuple[int, ...]:
+    """Donated positions for the jitted step functions — or none when
+    ``DASMTL_DISABLE_DONATION`` is set.
+
+    Escape hatch for a jaxlib defect the test suite hit on this container's
+    CPU backend: an executable *deserialized from the persistent compilation
+    cache* mishandles input-output aliasing for donated buffers, so a
+    donating step loaded from a warm cache writes its outputs into freed
+    memory — parameters turn to garbage (denormals / 1e+30s) and the
+    process can SIGABRT.  Donation is a memory optimization (HBM reuse on
+    TPU), never a semantic one, so tests/conftest.py sets the flag and
+    keeps the (5x) suite-level cache speedup; production TPU runs leave
+    donation on."""
+    if os.environ.get("DASMTL_DISABLE_DONATION"):
+        return ()
+    return argnums
+
+
+def shard_map_compat(f, *, mesh, in_specs, out_specs):
+    """``shard_map`` across the jax 0.4→0.6 API moves: top-level vs
+    experimental module, and the replication-check kwarg rename
+    (``check_rep`` → ``check_vma``).  The check is disabled either way — the
+    per-replica BN step and the fold-sharded CV step both return
+    deliberately unreplicated outputs."""
+    try:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+    except TypeError:  # jax 0.4.x spells it check_rep
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+
 
 Batch = Dict[str, jax.Array]
 
@@ -77,7 +116,7 @@ def make_train_step(spec: ModelSpec, mesh_plan=None,
                    lr: jax.Array) -> Tuple[TrainState, Dict[str, jax.Array]]:
         return _step_body(spec, state, batch, lr)
 
-    return jax.jit(train_step, donate_argnums=(0,))
+    return jax.jit(train_step, donate_argnums=donate_argnums(0))
 
 
 def _step_body(spec: ModelSpec, state: TrainState, batch: Batch,
@@ -157,7 +196,7 @@ def make_scan_train_step(spec: ModelSpec, mesh_plan=None):
 
         return jax.lax.scan(body, state, (idx, weight))
 
-    return jax.jit(scan_step, donate_argnums=(0,))
+    return jax.jit(scan_step, donate_argnums=donate_argnums(0))
 
 
 def make_cv_scan_train_step(spec: ModelSpec, mesh_plan=None):
@@ -214,14 +253,13 @@ def make_cv_scan_train_step(spec: ModelSpec, mesh_plan=None):
         return jax.lax.scan(body, states, (idx, weight))
 
     if mesh_plan is None or mesh_plan.n_devices == 1:
-        return jax.jit(cv_step, donate_argnums=(0,))
+        return jax.jit(cv_step, donate_argnums=donate_argnums(0))
 
-    mapped = jax.shard_map(
+    mapped = shard_map_compat(
         cv_step, mesh=mesh_plan.mesh,
         in_specs=(P("dp"), P(), P(None, "dp"), P(None, "dp"), P()),
-        out_specs=(P("dp"), P(None, "dp")),
-        check_vma=False)
-    return jax.jit(mapped, donate_argnums=(0,))
+        out_specs=(P("dp"), P(None, "dp")))
+    return jax.jit(mapped, donate_argnums=donate_argnums(0))
 
 
 def _make_per_replica_train_step(spec: ModelSpec, mesh_plan):
@@ -278,10 +316,10 @@ def _make_per_replica_train_step(spec: ModelSpec, mesh_plan):
         metrics = {k: jax.lax.psum(v, "dp") for k, v in metrics.items()}
         return new_state, metrics
 
-    mapped = jax.shard_map(local_step, mesh=mesh_plan.mesh,
-                           in_specs=(P(), batch_specs, P()),
-                           out_specs=(P(), P()), check_vma=False)
-    return jax.jit(mapped, donate_argnums=(0,))
+    mapped = shard_map_compat(local_step, mesh=mesh_plan.mesh,
+                              in_specs=(P(), batch_specs, P()),
+                              out_specs=(P(), P()))
+    return jax.jit(mapped, donate_argnums=donate_argnums(0))
 
 
 def _eval_body(spec: ModelSpec, state: TrainState,

@@ -110,6 +110,21 @@ def collect() -> dict:
         "prefetch_batches": d.prefetch_batches,
         "bn_sync": d.bn_sync,
     }
+
+    # Tracing-discipline tooling (dasmtl.analysis): the registered lint
+    # rules and the runtime-guard flag defaults, so "is the linter seeing
+    # rule X" / "are guards on by default" is answerable from one page.
+    from dasmtl.analysis.rules import all_rules
+
+    info["analysis"] = {
+        "lint_rules": [r.id for r in all_rules()],
+        "guard_defaults": {
+            "tracing_guards": d.tracing_guards,
+            "guard_warmup_steps": d.guard_warmup_steps,
+            "guard_transfer": d.guard_transfer,
+            "guard_nan_check": d.guard_nan_check,
+        },
+    }
     return info
 
 
@@ -150,6 +165,11 @@ def main(argv=None) -> int:
           f"({nl['library']})")
     print("  perf defaults: " + ", ".join(
         f"{k}={v}" for k, v in info["perf_defaults"].items()))
+    ana = info.get("analysis", {})
+    print(f"  lint rules: {', '.join(ana.get('lint_rules', []))} "
+          "(dasmtl-lint; docs/STATIC_ANALYSIS.md)")
+    print("  guard defaults: " + ", ".join(
+        f"{k}={v}" for k, v in ana.get("guard_defaults", {}).items()))
     return 0
 
 

@@ -100,7 +100,15 @@ def main() -> int:
                          "for smoke-testing on CPU-only traces")
     args = ap.parse_args()
 
-    from jax.profiler import ProfileData
+    try:
+        from jax.profiler import ProfileData
+    except ImportError:
+        # Older jax builds (this container's 0.4.x) ship no xplane reader;
+        # say so explicitly instead of tracebacking — the capture itself is
+        # still valid and can be analyzed on a host with a newer jax.
+        print("analyze_trace: jax.profiler.ProfileData unavailable in this "
+              "jax build; re-run analysis with jax >= 0.5", file=sys.stderr)
+        return 2
 
     path = find_xplane(args.trace_dir)
     profile = ProfileData.from_file(path)

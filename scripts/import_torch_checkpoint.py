@@ -92,8 +92,8 @@ def main() -> int:
         # 3-channel/1000-class torchvision inception_v3) would import
         # "successfully" and only explode much later at restore time.
         for (path, got), (_, tpl) in zip(
-                jax.tree.flatten_with_path(variables[group])[0],
-                jax.tree.flatten_with_path(tpl_tree)[0]):
+                jax.tree_util.tree_flatten_with_path(variables[group])[0],
+                jax.tree_util.tree_flatten_with_path(tpl_tree)[0]):
             if got.shape != tpl.shape:
                 name = jax.tree_util.keystr(path)
                 raise SystemExit(

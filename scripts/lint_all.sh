@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# One-shot local lint: the JAX-aware dasmtl linter plus (when installed)
+# the ruff subset from pyproject.toml.  Mirrors the CI lint job
+# (.github/workflows/ci.yml); docs/STATIC_ANALYSIS.md documents the rules.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+rc=0
+
+echo "== dasmtl-lint dasmtl/"
+python -m dasmtl.analysis.lint dasmtl/ || rc=1
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff check"
+    ruff check || rc=1
+else
+    echo "== ruff not installed here; skipped (CI runs it — pip install ruff)"
+fi
+
+exit $rc

@@ -15,21 +15,50 @@ spawns (multihost children, native-loader probes) skip plugin registration
 entirely — no test run can ever touch the TPU claim.
 """
 
+import hashlib
+import importlib.metadata
 import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["PALLAS_AXON_POOL_IPS"] = ""
+# Donation off under the persistent cache: this container's jaxlib
+# mishandles input-output aliasing in executables DESERIALIZED from the
+# compilation cache — a donating step loaded from a warm cache writes into
+# freed buffers (garbage params, eventual SIGABRT; that is what killed the
+# seed suite mid-run).  Donation is a TPU memory optimization with no
+# semantic content, so the suite trades it for the cache's 5x speedup.
+# See dasmtl.train.steps.donate_argnums.
+os.environ["DASMTL_DISABLE_DONATION"] = "1"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
 # Persistent compilation cache: the suite compiles many *identical* XLA
 # programs (every make_train_step call is a fresh jit closure), and repeat
 # suite runs recompile everything.  The disk cache dedupes both — measured
 # 17.5s -> 3.3s for a repeated MTL train-step compile on this 1-core host.
 # Subprocess children (multihost tests, the dryrun) inherit it via the env.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dasmtl_jax_cache")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+#
+# The directory name is scoped by (jax, jaxlib, XLA_FLAGS): a cache written
+# under a different jaxlib build or device topology must never be served to
+# this one.  A stale shared dir did exactly that — cached cv_step executables
+# returned garbage parameters and eventually SIGABRT'd the whole suite.
+# (Computed AFTER the XLA_FLAGS pin above so the tag sees the final flags.)
+def _cache_tag() -> str:
+    parts = []
+    for dist in ("jax", "jaxlib"):
+        try:
+            parts.append(f"{dist}={importlib.metadata.version(dist)}")
+        except importlib.metadata.PackageNotFoundError:
+            parts.append(f"{dist}=?")
+    parts.append(os.environ.get("XLA_FLAGS", ""))
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:12]
+
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      f"/tmp/dasmtl_jax_cache_{_cache_tag()}")
 
 # The axon sitecustomize imports jax at interpreter startup, and jax.config
 # snapshots JAX_PLATFORMS at import time — so when jax is already loaded the

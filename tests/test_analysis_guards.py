@@ -1,0 +1,122 @@
+"""Runtime tracing-discipline guards (dasmtl/analysis/guards.py): the
+recompile counter must trip on a shape-changing step, the transfer guard on
+an implicit in-step transfer, and a guarded end-to-end Trainer run must
+complete with zero post-warmup recompilations and zero disallowed
+transfers.  CPU-only and small."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dasmtl.analysis.guards import RecompileError, StepGuards
+
+from tests.test_train_loop import _mk_trainer
+
+
+def test_recompile_counter_trips_on_shape_change():
+    f = jax.jit(lambda x: x * 2.0)
+    x4, x5 = jnp.ones((4,)), jnp.ones((5,))  # placed OUTSIDE the steps
+    guards = StepGuards(warmup_steps=1)
+    with guards:
+        with guards.step():
+            f(x4)                      # warmup step: compile is legal
+        with pytest.raises(RecompileError, match="after a 1-step warmup"):
+            with guards.step():
+                f(x5)                  # new shape -> new executable
+    assert guards.post_warmup_compiles >= 1
+
+
+def test_stable_shapes_pass_post_warmup():
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.ones((8,))
+    guards = StepGuards(warmup_steps=1)
+    with guards:
+        for _ in range(5):
+            with guards.step():
+                f(x)
+    assert guards.post_warmup_compiles == 0
+    summary = guards.summary()
+    assert summary["steps"] == 5
+    assert summary["post_warmup_compiles"] == 0
+
+
+def test_transfer_guard_trips_on_implicit_transfer():
+    f = jax.jit(lambda x: x + 1.0)
+    x = jax.device_put(jnp.ones((4,)))
+    guards = StepGuards(warmup_steps=1)
+    with guards:
+        with guards.step():
+            f(x)
+        with pytest.raises(Exception, match="[Dd]isallowed"):
+            with guards.step():
+                # np operand = implicit H2D transfer inside a guarded step.
+                f(np.ones((4,), np.float32))
+
+
+def test_transfer_guard_allows_explicit_transfers():
+    f = jax.jit(lambda x: x + 1.0)
+    x = jax.device_put(jnp.ones((4,)))
+    f(x)                               # compile outside (warmup_steps=0)
+    guards = StepGuards(warmup_steps=0, recompile_check=False)
+    with guards:
+        with guards.step():
+            y = f(x)
+            host = jax.device_get(y)   # explicit D2H stays legal
+    assert float(np.asarray(host).sum()) == 8.0
+
+
+def test_guard_off_level_skips_transfer_guard():
+    f = jax.jit(lambda x: x + 1.0)
+    guards = StepGuards(warmup_steps=0, transfer="off",
+                        recompile_check=False)
+    with guards:
+        with guards.step():
+            f(np.ones((4,), np.float32))  # implicit transfer tolerated
+
+
+def test_step_outside_run_context_raises():
+    guards = StepGuards()
+    with pytest.raises(RuntimeError, match="outside the run context"):
+        with guards.step():
+            pass
+
+
+def test_nan_check_restores_prior_setting():
+    prev = jax.config.jax_debug_nans
+    with StepGuards(nan_check=True):
+        assert jax.config.jax_debug_nans is True
+    assert jax.config.jax_debug_nans == prev
+
+
+def test_guarded_trainer_run_is_clean(tmp_path, tiny_arrays):
+    """Acceptance: with guards enabled in config, a short synthetic CPU run
+    (epoch 1 fully post-warmup: 4 steps/epoch x 2 epochs, warmup = first
+    epoch) completes with zero post-warmup recompilations and zero
+    disallowed transfers."""
+    tr = _mk_trainer(tmp_path, tiny_arrays, tracing_guards=True,
+                     val_every=5)
+    results = tr.fit()
+    assert np.isfinite(results[-1].loss)
+    assert tr.guards is not None
+    summary = tr.guards.summary()
+    assert summary["steps"] >= 5
+    assert summary["post_warmup_compiles"] == 0
+    assert summary["transfer_guard"] == "disallow"
+
+
+def test_guarded_trainer_catches_planted_recompile(tmp_path, tiny_arrays):
+    """The integration actually polices the loop: plant a step function that
+    recompiles per call (a fresh jit closure every step) and the guarded
+    fit() must raise RecompileError after warmup."""
+    tr = _mk_trainer(tmp_path, tiny_arrays, tracing_guards=True,
+                     guard_warmup_steps=1, val_every=100)
+    real_step = tr.train_step
+
+    def recompiling_step(state, batch, lr):
+        fresh = jax.jit(lambda s, b, r: real_step(s, b, r))
+        return fresh(state, batch, lr)
+
+    tr.train_step = recompiling_step
+    with pytest.raises(RecompileError):
+        tr.fit()

@@ -89,8 +89,8 @@ def test_port_matches_template_tree_and_values(template_vars, synth_sd):
         assert (jax.tree.structure(ported[group])
                 == jax.tree.structure(v[group]))
         for (path, leaf), (_, tpl) in zip(
-                jax.tree.flatten_with_path(ported[group])[0],
-                jax.tree.flatten_with_path(v[group])[0]):
+                jax.tree_util.tree_flatten_with_path(ported[group])[0],
+                jax.tree_util.tree_flatten_with_path(v[group])[0]):
             assert leaf.shape == tpl.shape, path
     # Values land where they came from, layout-transformed: spot-check the
     # stem conv, one deep mixed branch, a BN stat, and the head.

