@@ -3,11 +3,15 @@
 JAX-specific defects — stray host syncs inside the step path, per-step
 recompilation, PRNG key reuse, donated-buffer reads — pass CPU unit tests
 and only surface as silent wall-clock regressions (or heap corruption) on a
-real v4-8.  This package catches them twice:
+real v4-8.  This package catches them three ways:
 
 - :mod:`dasmtl.analysis.lint` — an AST linter with JAX-aware rules
   (``dasmtl-lint``; rule registry in :mod:`dasmtl.analysis.rules`), run over
   the package in CI.
+- :mod:`dasmtl.analysis.audit` — a compile-time auditor (``dasmtl-audit``)
+  that AOT-lowers the jitted train/eval steps on CPU and checks the
+  *compiled artifact*: collective inventory, donation aliasing, dtype
+  discipline, and FLOP/memory budgets against a committed baseline.
 - :mod:`dasmtl.analysis.guards` — runtime guards that wrap the training
   step: ``jax.transfer_guard("disallow")`` after warmup, an XLA
   recompilation counter fed by ``jax.monitoring``, and optional NaN

@@ -1,0 +1,51 @@
+"""Compile-time StableHLO/cost-model auditor (``dasmtl-audit``).
+
+Third leg of ``dasmtl.analysis``: the linter reads Python source, the
+guards police a live run, and this package inspects the **compiled
+artifact** — the defects that actually burn TPU wall-clock (accidental
+all-gathers from a bad PartitionSpec, silently-dropped donation, bf16
+paths that upcast to f32, FLOP/memory regressions) only exist in the
+lowered XLA program, and all of them are visible statically on a CPU.
+
+Layering:
+
+- :mod:`~dasmtl.analysis.audit.hlo` — pure text parsers over StableHLO /
+  optimized HLO (no jax import; unit-testable on literal snippets)
+- :mod:`~dasmtl.analysis.audit.targets` — the audited config matrix and
+  the AOT lowering of the real step factories against abstract inputs
+- :mod:`~dasmtl.analysis.audit.checks` — structural rules AUD101–AUD104
+  over one compiled target
+- :mod:`~dasmtl.analysis.audit.baseline` — committed budgets
+  (``artifacts/audit_baseline.json``) and drift rules AUD105–AUD107
+- :mod:`~dasmtl.analysis.audit.analytic` — jaxpr-derived MXU FLOPs, the
+  independent cross-check on the compiler's cost model
+- :mod:`~dasmtl.analysis.audit.runner` — orchestration + the CLI
+
+``docs/STATIC_ANALYSIS.md`` documents every rule id, the baseline
+workflow and tolerance semantics.
+"""
+
+# Rule/report types re-export lazily for the same reason as the parent
+# package: importing the runner machinery must not pull jax into processes
+# (doctor, lint) that only want the metadata.
+_EXPORTS = {
+    "AuditFinding": "dasmtl.analysis.audit.checks",
+    "TargetReport": "dasmtl.analysis.audit.checks",
+    "audit_target": "dasmtl.analysis.audit.checks",
+    "AuditConfig": "dasmtl.analysis.audit.targets",
+    "full_matrix": "dasmtl.analysis.audit.targets",
+    "PRESETS": "dasmtl.analysis.audit.targets",
+    "run_audit": "dasmtl.analysis.audit.runner",
+    "DEFAULT_BASELINE_PATH": "dasmtl.analysis.audit.baseline",
+    "load_baseline": "dasmtl.analysis.audit.baseline",
+    "update_baseline": "dasmtl.analysis.audit.baseline",
+    "check_reports": "dasmtl.analysis.audit.baseline",
+}
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        return getattr(importlib.import_module(_EXPORTS[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

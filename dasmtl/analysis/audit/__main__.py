@@ -1,0 +1,8 @@
+"""``python -m dasmtl.analysis.audit`` — same surface as ``dasmtl-audit``."""
+
+import sys
+
+from dasmtl.analysis.audit.runner import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,107 @@
+"""Analytic MXU-FLOP counting from the traced jaxpr.
+
+The cost model (``compiled.cost_analysis()["flops"]``) can over-count
+(padding, fusion bookkeeping) — round-2 flagged that the published MFU
+rested solely on it.  This module derives a second, independent count from
+the mathematical operations themselves, walking the jaxpr and summing
+
+- ``conv_general_dilated``: 2 x out_elements x (in_ch / groups) x prod(kernel)
+- ``dot_general``:          2 x out_elements x prod(contracting dims)
+
+Element-wise work is excluded on purpose: MFU measures MXU utilization and
+the elementwise FLOPs are noise at these shapes.  The audit records both
+counts per target, so ``cost_over_analytic`` bounds how much of the cost
+model's figure is real arithmetic.
+
+(Absorbed from ``scripts/flops_audit.py``, which now delegates here — one
+cost-model code path.)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: Peak dense bf16 FLOP/s by TPU generation (public spec sheets), as
+#: bench.py uses for MFU.
+PEAK_BF16_FLOPS = {"v6e": 918e12, "trillium": 918e12, "v5p": 459e12,
+                   "v5e": 197e12, "v5 lite": 197e12, "v4": 275e12}
+
+
+def _subjaxprs(params):
+    for v in params.values():
+        if hasattr(v, "jaxpr"):  # ClosedJaxpr
+            yield v.jaxpr
+        elif hasattr(v, "eqns"):  # raw Jaxpr
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                if hasattr(item, "jaxpr"):
+                    yield item.jaxpr
+                elif hasattr(item, "eqns"):
+                    yield item
+
+
+#: numpy dtype name -> the StableHLO spelling the census in
+#: :mod:`~dasmtl.analysis.audit.hlo` uses, so the two reports line up.
+_DTYPE_SHORT = {"float32": "f32", "bfloat16": "bf16", "float64": "f64",
+                "float16": "f16"}
+
+
+def mxu_flops_by_dtype(jaxpr, out=None) -> dict:
+    """Conv/dot FLOPs per result dtype over a jaxpr, recursing into call
+    sub-jaxprs (pjit, custom_vjp, scan bodies — scan trip counts are NOT
+    multiplied, callers audit unrolled-free computations).  The split lets
+    the dtype-discipline rule weigh an f32 logits head (negligible) against
+    an f32 backbone conv (a halved-throughput regression)."""
+    if out is None:
+        out = {}
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        flops = 0.0
+        if name == "conv_general_dilated":
+            out_elems = 1
+            for d in eqn.outvars[0].aval.shape:
+                out_elems *= d
+            rhs_shape = eqn.invars[1].aval.shape
+            dn = eqn.params["dimension_numbers"]
+            in_ch_per_group = rhs_shape[dn.rhs_spec[1]]
+            k_elems = 1
+            for i in dn.rhs_spec[2:]:
+                k_elems *= rhs_shape[i]
+            flops = 2.0 * out_elems * in_ch_per_group * k_elems
+        elif name == "dot_general":
+            out_elems = 1
+            for d in eqn.outvars[0].aval.shape:
+                out_elems *= d
+            (lhs_c, _), _ = eqn.params["dimension_numbers"]
+            lhs_shape = eqn.invars[0].aval.shape
+            contract = 1
+            for i in lhs_c:
+                contract *= lhs_shape[i]
+            flops = 2.0 * out_elems * contract
+        if flops:
+            dt = str(eqn.outvars[0].aval.dtype)
+            dt = _DTYPE_SHORT.get(dt, dt)
+            out[dt] = out.get(dt, 0.0) + flops
+        for sub in _subjaxprs(eqn.params):
+            mxu_flops_by_dtype(sub, out)
+    return out
+
+
+def mxu_flops(jaxpr) -> float:
+    """Total conv/dot FLOPs over a jaxpr (all dtypes)."""
+    return sum(mxu_flops_by_dtype(jaxpr).values())
+
+
+def analytic_flops_of(fn, *abstract_args) -> dict:
+    """Trace ``fn`` with abstract (ShapeDtypeStruct) arguments and count its
+    MXU FLOPs per dtype — no compile, no execution."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*abstract_args)
+    return mxu_flops_by_dtype(closed.jaxpr)
+
+
+def peak_flops_for_device(device_kind: str) -> Optional[float]:
+    kind = device_kind.lower()
+    return next((v for k, v in PEAK_BF16_FLOPS.items() if k in kind), None)

@@ -1,0 +1,160 @@
+"""Committed cost-model budgets and the drift rules over them.
+
+``artifacts/audit_baseline.json`` pins, per audited target, the compiler's
+own accounting of the step: FLOPs, bytes accessed, memory footprint, and
+the exact collective inventory.  ``dasmtl-audit --check-baseline`` then
+fails CI when a PR moves any metric beyond its tolerance — the CPU-only
+stand-in for "this change made the TPU step slower".
+
+Tolerance semantics (all relative, ``abs(new - old) / max(old, 1)``):
+
+- a metric's tolerance comes from the baseline file's ``tolerances`` map,
+  falling back to :data:`DEFAULT_TOLERANCES`;
+- collective counts are compared **exactly** — one extra all-reduce is a
+  real program change, and the zero-tolerance is what catches a grad leaf
+  falling out of (or into) the synchronized tree;
+- ``alias_bytes`` is skipped when either side recorded donation as
+  disabled (the ``DASMTL_DISABLE_DONATION`` escape hatch changes the
+  executable's aliasing, not the model).
+
+``--update-baseline`` rewrites the measured values while preserving any
+hand-edited tolerances.  Budgets move legitimately (a model change, a jax
+upgrade) — the workflow is: justify the delta in the PR, re-run with
+``--update-baseline``, commit the diff.  Rule ids here continue the
+``checks`` numbering: AUD105 budget regression, AUD106 collective drift,
+AUD107 missing baseline entry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional
+
+from dasmtl.analysis.audit.checks import AuditFinding, TargetReport
+
+DEFAULT_BASELINE_PATH = os.path.join("artifacts", "audit_baseline.json")
+
+#: Relative tolerance per metric.  FLOPs are deterministic arithmetic and
+#: held tight; temp bytes are an XLA scheduling artifact and held loose.
+DEFAULT_TOLERANCES: Dict[str, float] = {
+    "flops": 0.02,
+    "mxu_flops_analytic": 0.02,
+    "bytes_accessed": 0.10,
+    "argument_bytes": 0.02,
+    "output_bytes": 0.02,
+    "temp_bytes": 0.50,
+    "alias_bytes": 0.05,
+    "alias_pairs": 0.0,
+    "peak_bytes": 0.25,
+    "code_bytes": 1.0,
+    "mxu_ops_bf16": 0.0,
+    "mxu_ops_f32": 0.0,
+}
+
+
+def load_baseline(path: str) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def update_baseline(reports: Iterable[TargetReport], path: str,
+                    generated_with: Optional[dict] = None) -> dict:
+    """Merge measured values into the baseline at ``path``: audited targets
+    are overwritten, targets not in this run are kept, hand-edited
+    tolerances survive."""
+    existing = load_baseline(path) or {}
+    tolerances = dict(DEFAULT_TOLERANCES)
+    tolerances.update(existing.get("tolerances", {}))
+    targets = dict(existing.get("targets", {}))
+    for report in reports:
+        targets[report.name] = report.to_baseline_entry()
+    data = {
+        "version": 1,
+        "comment": ("Compile-time budgets for dasmtl-audit --check-baseline;"
+                    " see docs/STATIC_ANALYSIS.md for the update workflow."),
+        "generated_with": generated_with
+        or existing.get("generated_with", {}),
+        "tolerances": tolerances,
+        "targets": {k: targets[k] for k in sorted(targets)},
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return data
+
+
+def check_reports(reports: Iterable[TargetReport],
+                  baseline: Optional[dict],
+                  baseline_path: str = DEFAULT_BASELINE_PATH,
+                  ) -> List[AuditFinding]:
+    findings: List[AuditFinding] = []
+    if baseline is None:
+        return [AuditFinding(
+            "AUD107", "error", "<baseline>",
+            f"no baseline at {baseline_path!r} — generate one with "
+            f"dasmtl-audit --update-baseline and commit it")]
+    tolerances = dict(DEFAULT_TOLERANCES)
+    tolerances.update(baseline.get("tolerances", {}))
+    targets = baseline.get("targets", {})
+    for report in reports:
+        entry = targets.get(report.name)
+        if entry is None:
+            findings.append(AuditFinding(
+                "AUD107", "error", report.name,
+                f"target has no baseline entry in {baseline_path!r} — "
+                f"run dasmtl-audit --update-baseline and commit the diff"))
+            continue
+        findings.extend(_check_metrics(report, entry, tolerances))
+        findings.extend(_check_collectives(report, entry))
+    return findings
+
+
+def _skip_alias(report: TargetReport, entry: dict) -> bool:
+    return report.donation != "requested" or entry.get("donation") != \
+        "requested"
+
+
+def _check_metrics(report: TargetReport, entry: dict,
+                   tolerances: Dict[str, float]) -> Iterable[AuditFinding]:
+    base_metrics = entry.get("metrics", {})
+    for name, old in sorted(base_metrics.items()):
+        if name in ("alias_bytes", "alias_pairs") and _skip_alias(report,
+                                                                  entry):
+            continue
+        new = report.metrics.get(name)
+        if new is None:
+            # A metric this backend/jax no longer reports is not a
+            # regression; --update-baseline will drop it.
+            continue
+        tol = tolerances.get(name, 0.0)
+        dev = abs(new - old) / max(abs(old), 1.0)
+        if dev > tol:
+            direction = "+" if new >= old else "-"
+            yield AuditFinding(
+                "AUD105", "error", report.name,
+                f"{name} {new:.6g} vs baseline {old:.6g} "
+                f"({direction}{dev:.1%} > {tol:.0%} tolerance) — justify "
+                f"and re-commit with --update-baseline, or fix the "
+                f"regression")
+
+
+def _check_collectives(report: TargetReport,
+                       entry: dict) -> Iterable[AuditFinding]:
+    base = {k: int(v) for k, v in entry.get("collectives", {}).items()}
+    now = {k: int(v) for k, v in report.collectives.items()}
+    for kind in sorted(set(base) | set(now)):
+        if base.get(kind, 0) == now.get(kind, 0):
+            continue
+        names = report.collective_ops.get(kind, [])
+        shown = (" (" + ", ".join(names[:3])
+                 + ("…" if len(names) > 3 else "") + ")") if names else ""
+        yield AuditFinding(
+            "AUD106", "error", report.name,
+            f"collective inventory drift: {kind} x{now.get(kind, 0)} vs "
+            f"baseline x{base.get(kind, 0)}{shown} — the partitioned "
+            f"program changed shape; verify the communication is intended, "
+            f"then --update-baseline")

@@ -1,0 +1,211 @@
+"""Rule checks over one AOT-compiled step — the audit analogue of
+``dasmtl.analysis.rules``.
+
+Where the linter reads Python source, these rules read the *compiled
+artifact*: lowered StableHLO, optimized HLO, ``cost_analysis()`` and
+``memory_analysis()``.  Each rule has a stable ``AUDnnn`` id (the baseline
+comparisons in :mod:`dasmtl.analysis.audit.baseline` continue the same
+numbering):
+
+========  ========  =====================================================
+AUD101    error     unexpected collective (all-gather / reduce-scatter /
+                    all-to-all / collective-permute) under the
+                    data-parallel spec — an accidental resharding that
+                    burns ICI bandwidth every step
+AUD102    error     donation requested but dropped by the executable (no
+                    input-output aliasing): HBM cost doubles silently
+AUD103    error     dtype discipline: any f64 tensor, or an f32
+                    convolution / dot_general in a bf16 target
+AUD104    error     no gradient all-reduce in a multi-device train step —
+                    replicas silently diverge
+========  ========  =====================================================
+
+AUD105 (budget regression), AUD106 (collective-inventory drift) and AUD107
+(missing baseline entry) live in :mod:`~dasmtl.analysis.audit.baseline`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional
+
+from dasmtl.analysis.audit import hlo
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditFinding:
+    rule: str
+    severity: str  # "error" | "warning"
+    target: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.target}: {self.rule} [{self.severity}] {self.message}"
+
+
+@dataclasses.dataclass
+class TargetReport:
+    """Everything measured about one compiled step; ``metrics`` and
+    ``collectives`` are what the committed baseline tracks."""
+
+    name: str
+    n_devices: int
+    compute_dtype: str
+    donation: str  # "requested" | "disabled" | "none"
+    metrics: Dict[str, float]
+    collectives: Dict[str, int]
+    # kind -> op names; diagnostic only, never serialized to the baseline.
+    collective_ops: Dict[str, List[str]] = dataclasses.field(
+        default_factory=dict)
+
+    def to_baseline_entry(self) -> dict:
+        return {"n_devices": self.n_devices,
+                "compute_dtype": self.compute_dtype,
+                "donation": self.donation,
+                "metrics": dict(self.metrics),
+                "collectives": dict(self.collectives)}
+
+
+#: Max fraction of analytic MXU FLOPs a bf16 target may spend in f32
+#: before AUD103 fires.  An f32 *logits head* (Inception's fc Dense: ~1e-4
+#: of the step) is a deliberate numerics island; an f32 backbone conv
+#: (>10% immediately) is a halved-throughput regression.
+F32_SHARE_TOLERANCE = 0.005
+
+
+def audit_target(name: str, lowered, *, n_devices: int = 1,
+                 compute_dtype: str = "float32",
+                 donation: str = "none",
+                 expect_grad_sync: bool = False,
+                 allowed_collectives: Iterable[str] = ("all-reduce",),
+                 analytic_by_dtype: Optional[Dict[str, float]] = None,
+                 ) -> "tuple[TargetReport, List[AuditFinding]]":
+    """Compile ``lowered`` (a ``jax.stages.Lowered``) and run every
+    structural rule over the artifacts.  Returns (report, findings).
+
+    ``donation`` is the *requested* state: "requested" arms AUD102,
+    "disabled"/"none" record why the aliasing metric is absent (the
+    ``DASMTL_DISABLE_DONATION`` escape hatch, or a step that never donates).
+    ``analytic_by_dtype`` (dtype -> MXU FLOPs, from
+    :func:`~dasmtl.analysis.audit.analytic.analytic_flops_of`) upgrades the
+    bf16 discipline check from op counts to FLOPs share.
+    """
+    stablehlo = lowered.as_text()
+    compiled = lowered.compile()
+    optimized = compiled.as_text()
+
+    metrics = hlo.parse_cost_analysis(compiled.cost_analysis())
+    try:
+        metrics.update(hlo.memory_metrics(compiled.memory_analysis()))
+    except Exception:  # noqa: BLE001 — older jaxlib / exotic backends
+        pass
+    if analytic_by_dtype:
+        metrics["mxu_flops_analytic"] = float(sum(analytic_by_dtype
+                                                  .values()))
+    inventory = hlo.collective_inventory(optimized)
+    report = TargetReport(
+        name=name, n_devices=n_devices, compute_dtype=compute_dtype,
+        donation=donation, metrics=metrics,
+        collectives={k: len(v) for k, v in inventory.items()},
+        collective_ops=inventory)
+
+    findings: List[AuditFinding] = []
+    findings.extend(_check_collectives(report, set(allowed_collectives),
+                                       optimized))
+    findings.extend(_check_donation(report, optimized))
+    findings.extend(_check_dtypes(report, stablehlo, analytic_by_dtype))
+    if expect_grad_sync:
+        findings.extend(_check_grad_sync(report))
+    return report, findings
+
+
+def _check_collectives(report: TargetReport, allowed: set,
+                       optimized: str) -> Iterable[AuditFinding]:
+    if report.n_devices <= 1:
+        # A 1-device program with ANY collective means the partitioner saw
+        # a sharding it should not have.
+        allowed = set()
+        rng_ok: set = set()
+    else:
+        # GSPMD partitions PRNG bit generation (dropout masks over the
+        # sharded batch) with slice-rebalancing collective-permutes; those
+        # are expected and exempt.  AUD106 still pins their exact count.
+        rng_ok = hlo.rng_collective_ops(optimized)
+    for kind, names in sorted(report.collective_ops.items()):
+        if kind in allowed:
+            continue
+        offending = [n for n in names if n not in rng_ok]
+        if not offending:
+            continue
+        shown = ", ".join(offending[:3]) + ("…" if len(offending) > 3
+                                            else "")
+        yield AuditFinding(
+            "AUD101", "error", report.name,
+            f"{len(offending)} unexpected {kind} op(s) in the optimized "
+            f"HLO ({shown}): the data-parallel contract is all-reduce "
+            f"(plus RNG-sourced permutes) only — a {kind} here reshards "
+            f"tensors every step (bad PartitionSpec, or a sharded leaf "
+            f"the spec meant to replicate)")
+
+
+def _check_donation(report: TargetReport,
+                    optimized: str) -> Iterable[AuditFinding]:
+    pairs = hlo.input_output_alias_pairs(optimized)
+    report.metrics.setdefault("alias_pairs", float(pairs))
+    if report.donation != "requested":
+        return
+    alias_bytes = report.metrics.get("alias_bytes")
+    if pairs == 0 or (alias_bytes is not None and alias_bytes == 0.0):
+        yield AuditFinding(
+            "AUD102", "error", report.name,
+            "donate_argnums was requested but the executable aliases "
+            "nothing (no input_output_alias in the HloModule header): "
+            "the donated state buffers are copied, doubling HBM for the "
+            "train state — check donated shapes/dtypes match the outputs")
+
+
+def _check_dtypes(report: TargetReport, stablehlo: str,
+                  analytic_by_dtype: Optional[Dict[str, float]],
+                  ) -> Iterable[AuditFinding]:
+    f64_line = hlo.first_f64_op(stablehlo)
+    if f64_line is not None:
+        yield AuditFinding(
+            "AUD103", "error", report.name,
+            f"f64 tensor in the lowered program ({f64_line!r}): TPUs have "
+            f"no f64 path — this runs as slow emulation or fails to lower")
+    census = hlo.mxu_dtype_census(stablehlo)
+    if report.compute_dtype == "bfloat16":
+        report.metrics.setdefault("mxu_ops_bf16", float(census.get("bf16",
+                                                                   0)))
+        n_f32 = census.get("f32", 0)
+        if not n_f32:
+            return
+        if analytic_by_dtype and sum(analytic_by_dtype.values()):
+            # FLOPs-weighted verdict: a deliberate f32 logits head is
+            # noise; an f32 backbone conv dominates instantly.
+            total = sum(analytic_by_dtype.values())
+            share = analytic_by_dtype.get("f32", 0.0) / total
+            report.metrics.setdefault("f32_mxu_flops_share", share)
+            if share <= F32_SHARE_TOLERANCE:
+                return
+            detail = (f"{share:.2%} of analytic MXU FLOPs in f32 "
+                      f"(> {F32_SHARE_TOLERANCE:.1%} tolerance)")
+        else:
+            detail = f"{n_f32} f32 op(s), no analytic FLOPs to weigh them"
+        offenders = ", ".join(hlo.f32_mxu_ops(stablehlo))
+        yield AuditFinding(
+            "AUD103", "error", report.name,
+            f"f32 convolution/dot_general work in a bf16 target — {detail} "
+            f"({offenders}): an upcast before the MXU halves throughput; "
+            f"a cast is missing on that path (census: {dict(census)})")
+    else:
+        report.metrics.setdefault("mxu_ops_f32", float(census.get("f32", 0)))
+
+
+def _check_grad_sync(report: TargetReport) -> Iterable[AuditFinding]:
+    if report.n_devices > 1 and not report.collectives.get("all-reduce"):
+        yield AuditFinding(
+            "AUD104", "error", report.name,
+            f"train step partitioned over {report.n_devices} devices "
+            f"contains no all-reduce: gradients (and BN statistics) are "
+            f"never synchronized — replicas diverge from step one")

@@ -1,0 +1,170 @@
+"""Text-level parsers over the two compiler artifacts the auditor reads.
+
+Two different programs describe one computation here, and each answers a
+different question:
+
+- **Lowered StableHLO** (``jax.jit(f).lower(...).as_text()``) is the
+  backend-independent program: the dtypes it shows are the dtypes the model
+  *asked for*.  This is where dtype discipline is checked — XLA:CPU
+  legalizes bf16 math to f32 during optimization, so the compiled text
+  would claim every bf16 model upcasts.
+- **Optimized HLO** (``.compile().as_text()``) is what actually executes:
+  post-GSPMD partitioning, so the collectives (``all-reduce`` for the grad
+  tree, any accidental ``all-gather``) exist only in this text, as does the
+  ``input_output_alias`` header recording which donations the executable
+  honored.
+
+Everything in this module is pure string parsing — no jax import — so the
+rule layer stays unit-testable against literal HLO snippets.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Dict, List, Optional, Tuple
+
+#: Cross-device ops GSPMD may insert; the inventory names each occurrence.
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "all-to-all", "collective-permute")
+
+# An HLO op *definition* line: `%name = type kind(...)` (async collectives
+# split into -start/-done pairs — the -start carries the communication, the
+# -done is bookkeeping and would double the census).
+_OP_DEF_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*\S+\s+"
+    r"(?P<kind>" + "|".join(COLLECTIVE_KINDS) + r")"
+    r"(?P<suffix>-start|-done)?\(",
+    re.MULTILINE)
+
+# A StableHLO MXU op and its result element type:
+#   %5 = stablehlo.convolution(...) ... -> tensor<64x100x250x8xbf16>
+_MXU_RESULT_RE = re.compile(
+    r"stablehlo\.(?P<op>convolution|dot_general)"
+    r"[^\n]*->\s*tensor<(?:[0-9?x]*x)?(?P<dtype>[a-z0-9]+)>")
+
+_F64_TENSOR_RE = re.compile(r"tensor<(?:[0-9?x]*x)?f64>")
+
+
+def collective_inventory(optimized_hlo: str) -> Dict[str, List[str]]:
+    """kind -> op names, over op definitions in the optimized HLO module."""
+    out: Dict[str, List[str]] = {}
+    for m in _OP_DEF_RE.finditer(optimized_hlo):
+        if m.group("suffix") == "-done":
+            continue
+        out.setdefault(m.group("kind"), []).append(m.group("name"))
+    return out
+
+
+def collective_counts(optimized_hlo: str) -> Dict[str, int]:
+    return {k: len(v) for k, v in collective_inventory(optimized_hlo).items()}
+
+
+#: op_name metadata markers of GSPMD-partitioned PRNG bit generation.
+#: Partitioning a threefry counter array inserts slice-rebalancing
+#: collective-permutes (observed: Dropout's `_bernoulli`/`_uniform` under a
+#: dp-sharded batch) — expected communication, unlike a resharding permute.
+_RNG_OP_MARKERS = ("threefry", "_uniform", "_bernoulli", "random_bits",
+                   "fold_in", "rand")
+
+
+def rng_collective_ops(optimized_hlo: str) -> set:
+    """Names of collective ops whose ``metadata={op_name=...}`` attributes
+    them to PRNG bit generation."""
+    out = set()
+    for line in optimized_hlo.splitlines():
+        m = _OP_DEF_RE.match(line)
+        if m is None or m.group("suffix") == "-done":
+            continue
+        meta = re.search(r'metadata=\{[^}]*op_name="([^"]*)"', line)
+        if meta and any(marker in meta.group(1)
+                        for marker in _RNG_OP_MARKERS):
+            out.add(m.group("name"))
+    return out
+
+
+def mxu_dtype_census(stablehlo: str) -> Counter:
+    """Result element types of every convolution / dot_general in the
+    lowered StableHLO — the dtype the model computes its MXU work in."""
+    return Counter(m.group("dtype") for m in _MXU_RESULT_RE.finditer(stablehlo))
+
+
+def first_f64_op(stablehlo: str) -> Optional[str]:
+    """The first StableHLO line producing/consuming an f64 tensor, or None.
+    Integer 64-bit (i64/ui64 loop counters, gather indices) is fine and not
+    matched."""
+    for line in stablehlo.splitlines():
+        if _F64_TENSOR_RE.search(line):
+            return line.strip()[:160]
+    return None
+
+
+def f32_mxu_ops(stablehlo: str, limit: int = 3) -> List[str]:
+    """Op names of f32-result convolutions/dot_generals (for naming the
+    offenders in a bf16-discipline finding)."""
+    hits: List[str] = []
+    for line in stablehlo.splitlines():
+        m = _MXU_RESULT_RE.search(line)
+        if m and m.group("dtype") == "f32":
+            name = line.strip().split("=", 1)[0].strip()
+            hits.append(f"{name} ({m.group('op')})")
+            if len(hits) >= limit:
+                break
+    return hits
+
+
+def input_output_alias_pairs(optimized_hlo: str) -> int:
+    """Donated-parameter aliases the executable honored, parsed from the
+    ``input_output_alias={ {}: (0, {}, may-alias), ... }`` HloModule header.
+    0 means every requested donation was silently dropped."""
+    header, _, _ = optimized_hlo.partition("\n")
+    if "input_output_alias=" not in header:
+        return 0
+    # Entries render as `{out_idx}: (param, {idx}, may-alias|must-alias)`;
+    # counting the closing kind tokens sidesteps the nested-brace grammar.
+    return header.count("may-alias)") + header.count("must-alias)")
+
+
+def parse_cost_analysis(cost) -> Dict[str, float]:
+    """Normalize ``compiled.cost_analysis()`` across jax versions (dict vs
+    [dict]) into the scalar metrics the budgets track."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    cost = cost or {}
+    out: Dict[str, float] = {}
+    for key, name in (("flops", "flops"),
+                      ("bytes accessed", "bytes_accessed")):
+        if key in cost:
+            out[name] = float(cost[key])
+    return out
+
+
+def memory_metrics(mem) -> Dict[str, float]:
+    """Flatten ``compiled.memory_analysis()`` (CompiledMemoryStats) into the
+    budget metrics; absent attributes (older jaxlib) are skipped."""
+    out: Dict[str, float] = {}
+    for attr, name in (("argument_size_in_bytes", "argument_bytes"),
+                       ("output_size_in_bytes", "output_bytes"),
+                       ("temp_size_in_bytes", "temp_bytes"),
+                       ("alias_size_in_bytes", "alias_bytes"),
+                       ("generated_code_size_in_bytes", "code_bytes")):
+        if hasattr(mem, attr):
+            out[name] = float(getattr(mem, attr))
+    if {"argument_bytes", "output_bytes", "temp_bytes"} <= out.keys():
+        # Peak device residency proxy: everything the executable holds at
+        # once minus buffers it reuses via donation aliasing.
+        out["peak_bytes"] = (out["argument_bytes"] + out["output_bytes"]
+                             + out["temp_bytes"] + out.get("code_bytes", 0.0)
+                             - out.get("alias_bytes", 0.0))
+    return out
+
+
+def split_shardings(optimized_hlo: str) -> Tuple[int, int]:
+    """(num_partitions, replica_count) from the HloModule header when
+    present — a cheap cross-check that the mesh the auditor asked for is the
+    mesh GSPMD partitioned over."""
+    header, _, _ = optimized_hlo.partition("\n")
+    parts = re.search(r"num_partitions=(\d+)", header)
+    reps = re.search(r"replica_count=(\d+)", header)
+    return (int(parts.group(1)) if parts else 1,
+            int(reps.group(1)) if reps else 1)

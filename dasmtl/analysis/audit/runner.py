@@ -1,0 +1,263 @@
+"""Orchestration + CLI for the compile-time auditor (``dasmtl-audit``).
+
+Flow: resolve the config matrix → AOT-lower each cell's train/eval steps
+(:mod:`targets`) → compile on CPU and run the structural rules
+(:mod:`checks`) → optionally compare against / rewrite the committed
+budgets (:mod:`baseline`).  Everything happens on the host CPU — no
+accelerator, no data, no training step executed — so the gate runs in CI
+and catches sharding/donation/dtype/cost regressions before any hardware
+ever sees the change.
+
+The CLI pins the CPU backend and a virtual multi-device host *before* jax
+initializes (same trick as tests/conftest.py): collective checks need
+``dp`` devices, and this container's TPU-tunnel plugin must never be
+touched by a static analysis.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import List, Optional, Sequence, Tuple
+
+from dasmtl.analysis.audit.baseline import (DEFAULT_BASELINE_PATH,
+                                            check_reports, load_baseline,
+                                            update_baseline)
+from dasmtl.analysis.audit.checks import (AuditFinding, TargetReport,
+                                          audit_target)
+
+
+def _pin_cpu_backend(min_devices: int) -> None:
+    """Force a CPU backend with >= ``min_devices`` virtual devices and NO
+    persistent compile cache.  Must run before the backend initializes;
+    when jax is already live (this container's sitecustomize) re-pin
+    through jax.config and verify the device count instead.
+
+    The cache disable is load-bearing, not an optimization miss: on this
+    jaxlib an executable *deserialized* from ``JAX_COMPILATION_CACHE_DIR``
+    comes back without its ``input_output_alias`` table (the same defect
+    family that corrupts donated buffers in executing tests — see
+    ``dasmtl.train.steps.donate_argnums``).  A warm cache would make
+    AUD102 report every donation as dropped, and mask a real drop on the
+    next cold run.  The audit must always inspect a *freshly compiled*
+    executable."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+    os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{max(2, min_devices)}").strip()
+    import jax
+
+    for key, value in (("jax_platforms", os.environ["JAX_PLATFORMS"]),
+                       ("jax_compilation_cache_dir", None)):
+        try:
+            jax.config.update(key, value)
+        except Exception:  # noqa: BLE001 — backend already up is fine
+            pass
+    n = len(jax.devices())
+    if n < min_devices:
+        raise SystemExit(
+            f"dasmtl-audit: need {min_devices} devices for the sharded "
+            f"configs, have {n} — set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={min_devices} before "
+            f"anything imports jax")
+
+
+def run_audit(configs, *, kinds: Tuple[str, ...] = ("train", "eval"),
+              ) -> Tuple[List[TargetReport], List[AuditFinding]]:
+    """Lower + compile + structurally check every target of ``configs``."""
+    from dasmtl.analysis.audit.targets import lower_config
+
+    reports: List[TargetReport] = []
+    findings: List[AuditFinding] = []
+    for acfg in configs:
+        for tgt in lower_config(acfg, kinds=kinds):
+            report, found = audit_target(
+                tgt.name, tgt.lowered, n_devices=tgt.n_devices,
+                compute_dtype=tgt.compute_dtype, donation=tgt.donation,
+                expect_grad_sync=(tgt.kind == "train"),
+                analytic_by_dtype=tgt.analytic_by_dtype)
+            reports.append(report)
+            findings.extend(found)
+    return reports, findings
+
+
+def _generated_with() -> dict:
+    import importlib.metadata
+
+    out = {}
+    for dist in ("jax", "jaxlib"):
+        try:
+            out[dist] = importlib.metadata.version(dist)
+        except importlib.metadata.PackageNotFoundError:
+            out[dist] = "?"
+    return out
+
+
+def summary_line(reports: Sequence[TargetReport],
+                 findings: Sequence[AuditFinding]) -> str:
+    n_err = sum(1 for f in findings if f.severity == "error")
+    n_warn = len(findings) - n_err
+    status = "clean" if not findings else (f"{n_err} error(s), "
+                                           f"{n_warn} warning(s)")
+    return (f"audit: {len(reports)} target(s) compiled, {status}")
+
+
+def legacy_flops_report(batch: int, dtype: str,
+                        samples_per_s: Optional[float] = None,
+                        peak_flops: Optional[float] = None) -> dict:
+    """The ``scripts/flops_audit.py`` JSON, produced from the audit target
+    machinery (same keys, one cost-model code path)."""
+    import jax
+
+    from dasmtl.analysis.audit import hlo
+    from dasmtl.analysis.audit.analytic import (analytic_flops_of,
+                                                peak_flops_for_device)
+    from dasmtl.analysis.audit.targets import AuditConfig, lower_config
+    from dasmtl.config import INPUT_HEIGHT, INPUT_WIDTH, Config
+    from dasmtl.main import build_state
+    from dasmtl.models.registry import get_model_spec
+
+    acfg = AuditConfig(model="MTL", compute_dtype=dtype, dp=1,
+                       batch_size=batch)
+    (train_tgt,) = lower_config(acfg, kinds=("train",))
+    step_cost = hlo.parse_cost_analysis(
+        train_tgt.lowered.compile().cost_analysis()).get("flops")
+
+    cfg = Config(model="MTL", batch_size=batch, compute_dtype=dtype)
+    spec = get_model_spec(cfg.model)
+    state_sds = jax.eval_shape(lambda: build_state(cfg, spec))
+
+    def forward(variables, x):
+        return spec.build(cfg).apply(variables, x, train=False)
+
+    variables = {"params": state_sds.params,
+                 "batch_stats": state_sds.batch_stats}
+    x_sds = jax.ShapeDtypeStruct((batch, INPUT_HEIGHT, INPUT_WIDTH, 1),
+                                 jax.numpy.float32)
+    fwd_analytic = sum(analytic_flops_of(forward, variables, x_sds).values())
+    fwd_cost = hlo.parse_cost_analysis(
+        jax.jit(forward).lower(variables, x_sds).compile().cost_analysis()
+    ).get("flops")
+    step_analytic = sum((train_tgt.analytic_by_dtype or {}).values())
+
+    result = {
+        "metric": "mxu_flops_audit",
+        "batch_size": batch,
+        "compute_dtype": dtype,
+        "backend": jax.default_backend(),
+        "forward_flops_analytic": fwd_analytic,
+        "forward_flops_cost_model": fwd_cost,
+        "train_step_flops_analytic": step_analytic,
+        "train_step_flops_cost_model": step_cost,
+        "bwd_fwd_ratio_analytic": round(step_analytic / fwd_analytic, 3),
+    }
+    if fwd_cost:
+        result["cost_over_analytic_forward"] = round(fwd_cost / fwd_analytic,
+                                                     4)
+    if step_cost:
+        result["cost_over_analytic_step"] = round(step_cost / step_analytic,
+                                                  4)
+    if samples_per_s:
+        peak = peak_flops
+        if peak is None:
+            peak = peak_flops_for_device(jax.devices()[0].device_kind)
+        if peak:
+            per_sample = step_analytic / batch
+            result["mfu_analytic"] = round(samples_per_s * per_sample / peak,
+                                           4)
+            if step_cost:
+                result["mfu_cost_model"] = round(
+                    samples_per_s * step_cost / batch / peak, 4)
+    return result
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dasmtl-audit",
+        description="Compile-time StableHLO/cost-model auditor: lowers the "
+                    "jitted train/eval steps on CPU and checks collectives, "
+                    "donation aliasing, dtype discipline and cost budgets "
+                    "(docs/STATIC_ANALYSIS.md)")
+    ap.add_argument("--preset", choices=sorted(targets_presets()),
+                    default="ci",
+                    help="config subset (default: ci; full = whole matrix, "
+                    "use for --update-baseline)")
+    ap.add_argument("--configs", type=str, default=None,
+                    help="comma-separated config names (overrides --preset; "
+                    "see --list-configs)")
+    ap.add_argument("--check-baseline", action="store_true",
+                    help="compare budgets against the committed baseline "
+                    "and fail on drift")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline entries for the audited "
+                    "targets (tolerances are preserved)")
+    ap.add_argument("--baseline", type=str, default=DEFAULT_BASELINE_PATH)
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-configs", action="store_true",
+                    help="print the config matrix and presets, then exit")
+    args = ap.parse_args(argv)
+
+    if args.list_configs:
+        from dasmtl.analysis.audit.targets import PRESETS, full_matrix
+
+        for c in full_matrix():
+            print(c.name)
+        for name, cfgs in sorted(PRESETS.items()):
+            print(f"preset {name}: {', '.join(c.name for c in cfgs)}")
+        return 0
+
+    from dasmtl.analysis.audit.targets import resolve_configs
+
+    try:
+        configs = resolve_configs(args.preset, args.configs)
+    except ValueError as exc:
+        ap.error(str(exc))
+    _pin_cpu_backend(max(c.n_devices for c in configs))
+
+    reports, findings = run_audit(configs)
+    if args.update_baseline:
+        update_baseline(reports, args.baseline,
+                        generated_with=_generated_with())
+        print(f"baseline written: {args.baseline} "
+              f"({len(reports)} target(s))", file=sys.stderr)
+    elif args.check_baseline:
+        findings = list(findings) + check_reports(
+            reports, load_baseline(args.baseline),
+            baseline_path=args.baseline)
+
+    if args.format == "json":
+        print(json.dumps({
+            "reports": [dataclasses.asdict(r) for r in reports],
+            "findings": [dataclasses.asdict(f) for f in findings],
+        }, default=str))
+    else:
+        for report in reports:
+            colls = ", ".join(f"{k} x{v}"
+                              for k, v in sorted(report.collectives.items()))
+            print(f"{report.name}: devices={report.n_devices} "
+                  f"dtype={report.compute_dtype} "
+                  f"donation={report.donation} "
+                  f"flops={report.metrics.get('flops', 0):.4g} "
+                  f"peak_bytes={report.metrics.get('peak_bytes', 0):.4g} "
+                  f"[{colls or 'no collectives'}]")
+        for f in findings:
+            print(f.render())
+        print(summary_line(reports, findings), file=sys.stderr)
+    return 1 if findings else 0
+
+
+def targets_presets():
+    from dasmtl.analysis.audit.targets import PRESETS
+
+    return PRESETS
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,170 @@
+"""The audited config matrix and the AOT lowering of its steps.
+
+A *target* is one jitted step (train or eval) of one configuration, lowered
+against abstract ``ShapeDtypeStruct`` inputs — shapes, dtypes and shardings
+only, no parameters initialized, no data loaded, no step executed.  The
+lowering path is deliberately the production one: the same
+``make_train_step`` / ``make_eval_step`` factories the trainer dispatches
+(via :func:`dasmtl.train.steps.lowerable_steps`), the same
+``batch_sharding`` / ``replicated_sharding`` layout from
+``dasmtl.parallel.mesh`` — so the StableHLO the rules inspect is the
+program a v4-8 would run, not a simplified twin.
+
+The matrix crosses the three reference model families (A: MTL, B:
+single-task, C: the Inception multi-classifier) with compute dtype and
+sharding.  Compiling Inception on one CPU core costs ~30 s, so presets
+bound the default cost: ``quick`` is one sharded config, ``ci`` the
+four-config contract CI gates on, ``full`` the whole matrix (use it when
+regenerating the committed baseline).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional, Tuple
+
+from dasmtl.config import INPUT_HEIGHT, INPUT_WIDTH, Config
+
+#: model A / B / C of the reference, in audit-matrix order.
+MATRIX_MODELS = ("MTL", "single_event", "multi_classifier")
+MATRIX_DTYPES = ("float32", "bfloat16")
+MATRIX_DP = (1, 2)
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditConfig:
+    """One cell of the audit matrix (both its train and eval steps)."""
+
+    model: str
+    compute_dtype: str = "float32"
+    dp: int = 1
+    batch_size: int = 32  # per-device, as Config.batch_size
+
+    @property
+    def name(self) -> str:
+        dt = "bf16" if self.compute_dtype == "bfloat16" else "f32"
+        return f"{self.model}-{dt}-dp{self.dp}"
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp
+
+
+def full_matrix(batch_size: int = 32) -> List[AuditConfig]:
+    return [AuditConfig(model=m, compute_dtype=dt, dp=dp,
+                        batch_size=batch_size)
+            for m in MATRIX_MODELS for dt in MATRIX_DTYPES
+            for dp in MATRIX_DP]
+
+
+def _named(names: Tuple[str, ...]) -> List[AuditConfig]:
+    by_name = {c.name: c for c in full_matrix()}
+    return [by_name[n] for n in names]
+
+
+#: quick: the one config exercising sharding + donation + budgets at once.
+#: ci: adds the 1-device contract, the bf16 discipline check and model B.
+#: full: every cell, including the ~30 s Inception compiles — baseline
+#: regeneration and pre-release sweeps.
+PRESETS: Dict[str, List[AuditConfig]] = {
+    "quick": _named(("MTL-f32-dp2",)),
+    "ci": _named(("MTL-f32-dp1", "MTL-f32-dp2", "MTL-bf16-dp2",
+                  "single_event-f32-dp1")),
+    "full": full_matrix(),
+}
+
+
+@dataclasses.dataclass
+class LoweredTarget:
+    """A lowered-but-not-yet-compiled step plus the expectations the rule
+    layer checks it against."""
+
+    name: str
+    kind: str  # "train" | "eval"
+    lowered: object  # jax.stages.Lowered
+    n_devices: int
+    compute_dtype: str
+    donation: str  # "requested" | "disabled" | "none"
+    # dtype -> analytic MXU FLOPs (None when the jaxpr walk failed).
+    analytic_by_dtype: Optional[Dict[str, float]] = None
+
+
+def donation_state() -> str:
+    """What the step factories will request right now (the
+    ``DASMTL_DISABLE_DONATION`` escape hatch is read at factory time)."""
+    return ("disabled" if os.environ.get("DASMTL_DISABLE_DONATION")
+            else "requested")
+
+
+def lower_config(acfg: AuditConfig, kinds: Tuple[str, ...] = ("train",
+                                                              "eval"),
+                 ) -> List[LoweredTarget]:
+    """Lower the requested step kinds of one matrix cell.
+
+    Uses ``jax.eval_shape`` to derive the TrainState tree abstractly (the
+    model is never initialized) and the canonical mesh/sharding layout for
+    ``dp > 1`` — requires ``dp`` visible devices (CPU: set
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N``; the CLI does
+    this automatically)."""
+    import jax
+
+    from dasmtl.main import build_state
+    from dasmtl.models.registry import get_model_spec
+    from dasmtl.parallel.mesh import (abstract_batch, abstract_replicated,
+                                      create_mesh)
+    from dasmtl.train.steps import lowerable_steps
+
+    cfg = Config(model=acfg.model, batch_size=acfg.batch_size,
+                 compute_dtype=acfg.compute_dtype)
+    spec = get_model_spec(cfg.model)
+    plan = create_mesh(dp=acfg.dp, sp=1) if acfg.dp > 1 else None
+
+    state_sds = jax.eval_shape(lambda: build_state(cfg, spec))
+    state_sds = abstract_replicated(state_sds, plan)
+    global_batch = acfg.batch_size * acfg.dp
+    batch_sds = abstract_batch(global_batch, (INPUT_HEIGHT, INPUT_WIDTH),
+                               plan)
+    lr_sds = jax.ShapeDtypeStruct((), jax.numpy.float32)
+
+    steps = lowerable_steps(spec, mesh_plan=plan)
+    donation = donation_state()
+    out: List[LoweredTarget] = []
+    for kind in kinds:
+        step = steps[kind]
+        args = ((state_sds, batch_sds, lr_sds) if kind == "train"
+                else (state_sds, batch_sds))
+        analytic = None
+        try:
+            from dasmtl.analysis.audit.analytic import analytic_flops_of
+
+            analytic = analytic_flops_of(step, *args)
+        except Exception:  # noqa: BLE001 — analytic count is best-effort
+            pass
+        out.append(LoweredTarget(
+            name=f"{acfg.name}-{kind}", kind=kind,
+            lowered=step.lower(*args), n_devices=acfg.dp,
+            compute_dtype=acfg.compute_dtype,
+            donation=donation if kind == "train" else "none",
+            analytic_by_dtype=analytic))
+    return out
+
+
+def resolve_configs(preset: Optional[str] = None,
+                    names: Optional[str] = None) -> List[AuditConfig]:
+    """CLI selection: ``names`` (comma-separated target-cell names from
+    :func:`full_matrix`) beats ``preset``; default preset is ``ci``."""
+    if names:
+        wanted = [n.strip() for n in names.split(",") if n.strip()]
+        by_name = {c.name: c for c in full_matrix()}
+        unknown = sorted(set(wanted) - set(by_name))
+        if unknown:
+            raise ValueError(
+                f"unknown audit config(s) {unknown}; known: "
+                f"{sorted(by_name)}")
+        return [by_name[n] for n in wanted]
+    preset = preset or "ci"
+    if preset not in PRESETS:
+        raise ValueError(f"unknown preset {preset!r}; "
+                         f"choose from {sorted(PRESETS)}")
+    return PRESETS[preset]

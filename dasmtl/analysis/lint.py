@@ -218,25 +218,45 @@ def _walk_functions(tree: ast.Module) -> Iterator[ast.AST]:
 
 
 def _collect_noqa(source: str) -> Dict[int, Optional[Set[str]]]:
-    """line -> suppressed rule ids (None = all rules suppressed there)."""
+    """line -> suppressed rule ids (None = all rules suppressed there).
+
+    Tokenizes so only real ``#`` comments count — a docstring or string
+    literal that merely *mentions* ``# dasmtl: noqa`` (this module's own
+    docs, the DAS199 messages) must neither suppress findings nor be
+    reported as a dead suppression.  Falls back to a line scan when the
+    file does not tokenize (the DAS000 path handles the parse error)."""
+    import io
+    import tokenize
+
+    comments: List[tuple] = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                comments.append((tok.start[0], tok.string))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        comments = list(enumerate(source.splitlines(), start=1))
     out: Dict[int, Optional[Set[str]]] = {}
-    for i, line in enumerate(source.splitlines(), start=1):
-        m = _NOQA_RE.search(line)
+    _absent = object()  # distinct from None: None means "bare noqa seen"
+    for i, text in comments:
+        m = _NOQA_RE.search(text)
         if not m:
             continue
         if m.group(1) is None:
             out[i] = None
         else:
             ids = {s.strip() for s in m.group(1).split(",")}
-            prev = out.get(i)
-            out[i] = None if prev is None else (prev or set()) | ids
+            prev = out.get(i, _absent)
+            if prev is None:
+                continue  # a bare noqa on the line already covers all
+            out[i] = ids if prev is _absent else (prev | ids)
     return out
 
 
 # -- running ----------------------------------------------------------------
 
 def lint_source(source: str, path: str = "<string>",
-                select: Optional[Sequence[str]] = None) -> List[Finding]:
+                select: Optional[Sequence[str]] = None,
+                report_unused_noqa: bool = False) -> List[Finding]:
     from dasmtl.analysis.rules import all_rules
 
     try:
@@ -247,21 +267,60 @@ def lint_source(source: str, path: str = "<string>",
                         message=f"syntax error: {exc.msg}")]
     ctx = ModuleContext(path, source, tree)
     findings: List[Finding] = []
+    checked_ids = set()
     for rule in all_rules():
         if select and rule.id not in select:
             continue
+        checked_ids.add(rule.id)
         findings.extend(rule.check(ctx))
     kept = []
+    used: Dict[int, Set[str]] = {}
     for f in findings:
         suppressed = ctx.noqa.get(f.line)
         if f.line in ctx.noqa and (suppressed is None or f.rule in suppressed):
+            used.setdefault(f.line, set()).add(f.rule)
             continue
         kept.append(f)
+    if report_unused_noqa:
+        # DAS199 findings bypass the noqa filter on purpose: a suppression
+        # must not be able to hide the report that it is itself dead.
+        kept.extend(_unused_noqa_findings(ctx, used, checked_ids,
+                                          full_run=select is None))
     return sorted(kept, key=lambda f: (f.path, f.line, f.col, f.rule))
 
 
+def _unused_noqa_findings(ctx: ModuleContext, used: Dict[int, Set[str]],
+                          checked_ids: Set[str],
+                          full_run: bool) -> List[Finding]:
+    """DAS199: ``# dasmtl: noqa[...]`` trailers whose rule no longer fires
+    on that line.  A bare noqa is only judged when every rule ran (a
+    --select run cannot prove it dead); listed ids are judged per id,
+    restricted to the rules that actually ran."""
+    out: List[Finding] = []
+    for line, rules in sorted(ctx.noqa.items()):
+        if rules is None:
+            if full_run and not used.get(line):
+                out.append(Finding(
+                    rule="DAS199", severity="warning", path=ctx.path,
+                    line=line, col=0,
+                    message="bare `# dasmtl: noqa` suppresses nothing on "
+                            "this line — remove it (dead suppressions hide "
+                            "future findings)"))
+            continue
+        for rid in sorted(rules & checked_ids):
+            if rid not in used.get(line, set()):
+                out.append(Finding(
+                    rule="DAS199", severity="warning", path=ctx.path,
+                    line=line, col=0,
+                    message=f"`# dasmtl: noqa[{rid}]` is unused — {rid} no "
+                            f"longer fires on this line; remove the "
+                            f"suppression"))
+    return out
+
+
 def lint_paths(paths: Sequence[str],
-               select: Optional[Sequence[str]] = None) -> List[Finding]:
+               select: Optional[Sequence[str]] = None,
+               report_unused_noqa: bool = False) -> List[Finding]:
     findings: List[Finding] = []
     for py in iter_python_files(paths):
         try:
@@ -272,7 +331,8 @@ def lint_paths(paths: Sequence[str],
                 rule="DAS000", severity="error", path=py, line=1, col=0,
                 message=f"unreadable: {exc}"))
             continue
-        findings.extend(lint_source(source, py, select=select))
+        findings.extend(lint_source(source, py, select=select,
+                                    report_unused_noqa=report_unused_noqa))
     return findings
 
 
@@ -305,6 +365,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule registry and exit")
+    ap.add_argument("--report-unused-noqa", action="store_true",
+                    help="additionally flag `# dasmtl: noqa[RULE]` trailers "
+                         "whose rule no longer fires there (DAS199)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -313,7 +376,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 0
 
     select = args.select.split(",") if args.select else None
-    findings = lint_paths(args.paths or ["dasmtl"], select=select)
+    findings = lint_paths(args.paths or ["dasmtl"], select=select,
+                          report_unused_noqa=args.report_unused_noqa)
     if args.format == "json":
         print(json.dumps([dataclasses.asdict(f) for f in findings]))
     else:

@@ -1,0 +1,117 @@
+"""DAS108 — float64 in jax code.
+
+TPUs have no f64 MXU path, and without ``jax_enable_x64`` jax silently
+*downgrades* every f64 request to f32 — so ``jnp.float64`` either lies
+about the dtype you got or (x64 enabled) drops the program onto a slow
+emulated path.  Host-side numpy f64 is fine and deliberately not flagged
+(metric aggregation wants the precision); the rule only fires on
+
+- any ``jnp.float64`` / ``jnp.double`` reference (the request is wrong
+  whether or not x64 is on),
+- a ``dtype=`` argument resolving to f64 (``np.float64``, ``"float64"``,
+  ``"f8"``) in a call into ``jax.*`` / ``jax.numpy.*``,
+- a ``.astype(...)`` to f64 inside jit-reachable code (the receiver is a
+  tracer there),
+- ``jax.config.update("jax_enable_x64", ...)`` — the global switch that
+  makes every accidental promotion above real.
+
+The compile-time twin is AUD103 (``dasmtl-audit``), which catches f64
+tensors that reach the lowered program through paths this AST rule cannot
+see.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from dasmtl.analysis.lint import ModuleContext
+from dasmtl.analysis.rules import make_finding, rule
+
+_JNP_F64 = frozenset({"jax.numpy.float64", "jax.numpy.double",
+                      "jax.numpy.float_"})
+_NP_F64 = frozenset({"numpy.float64", "numpy.double", "numpy.float_"})
+_F64_STRINGS = frozenset({"float64", "f8", "<f8", ">f8", "=f8", "double"})
+
+
+def _f64_spelling(ctx: ModuleContext, node: ast.AST,
+                  allow_numpy: bool, allow_str: bool) -> Optional[str]:
+    """How ``node`` names float64, or None.  ``jnp.float64`` is always a
+    hit; numpy spellings / string dtypes only where the caller says the
+    context is a jax one."""
+    name = ctx.resolve(node)
+    if name in _JNP_F64:
+        return name
+    if allow_numpy and name in _NP_F64:
+        return name
+    if (allow_str and isinstance(node, ast.Constant)
+            and isinstance(node.value, str) and node.value in _F64_STRINGS):
+        return repr(node.value)
+    return None
+
+
+def _is_jax_call(ctx: ModuleContext, call: ast.Call) -> bool:
+    name = ctx.resolve(call.func)
+    return bool(name) and (name == "jax" or name.startswith("jax."))
+
+
+@rule("DAS108", "error",
+      "float64 dtype in jax code (no TPU f64 path; silently downgraded "
+      "to f32 unless jax_enable_x64 — either way not what you asked for)")
+def check_float64(ctx: ModuleContext):
+    flagged = set()
+
+    def emit(node, spelling, where):
+        key = (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+        if key in flagged:
+            return None
+        flagged.add(key)
+        return make_finding(
+            ctx, "DAS108", node,
+            f"{spelling} {where}: f64 never runs on the MXU — use f32 (or "
+            f"bf16 via compute_dtype) and keep f64 on the host numpy side")
+
+    for node in ast.walk(ctx.tree):
+        # jax.config.update("jax_enable_x64", ...)
+        if isinstance(node, ast.Call):
+            fname = ctx.resolve(node.func)
+            if (fname == "jax.config.update" and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value == "jax_enable_x64"):
+                f = emit(node, '"jax_enable_x64"',
+                         "enables global f64 promotion")
+                if f:
+                    yield f
+                continue
+            if _is_jax_call(ctx, node):
+                for kw in node.keywords:
+                    if kw.arg != "dtype":
+                        continue
+                    spelling = _f64_spelling(ctx, kw.value, allow_numpy=True,
+                                             allow_str=True)
+                    if spelling:
+                        f = emit(kw.value, spelling,
+                                 f"as dtype of {fname}(...)")
+                        if f:
+                            yield f
+        # Bare jnp.float64 reference anywhere (argument, astype, annotation).
+        spelling = _f64_spelling(ctx, node, allow_numpy=False,
+                                 allow_str=False)
+        if spelling:
+            f = emit(node, spelling, "referenced")
+            if f:
+                yield f
+
+    # .astype("float64") / .astype(np.float64) where the receiver is traced.
+    for fn in ctx.traced_reachable:
+        for call in ctx.calls_in(fn):
+            if not (isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "astype" and call.args):
+                continue
+            spelling = _f64_spelling(ctx, call.args[0], allow_numpy=True,
+                                     allow_str=True)
+            if spelling:
+                f = emit(call, spelling,
+                         f"in .astype() inside traced {fn.name!r}")
+                if f:
+                    yield f

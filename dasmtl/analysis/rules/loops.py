@@ -1,0 +1,78 @@
+"""DAS109 — jnp/lax ops inside a Python loop over a traced dimension.
+
+``for i in range(x.shape[0])`` is *legal* under tracing (shapes are
+static, so DAS102 rightly allows it) — but every jax op in the body is
+traced once **per iteration**: the program unrolls to O(N) HLO ops,
+compile time explodes with the dimension, and XLA fuses none of it the
+way a ``lax.scan``/``fori_loop``/``vmap`` body would.  The reference's
+per-batch Python loops are exactly the pattern this framework exists to
+remove.
+
+The rule fires when, inside jit-reachable code, a ``for`` iterates a
+bound derived from a traced parameter (``range(len(x))``,
+``range(x.shape[i])``, ``enumerate(x)``) AND the loop body contains a
+call into ``jax.*``.  Loops DAS102 already flags (iterating the traced
+value itself) are skipped — one finding per defect.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Set
+
+from dasmtl.analysis.lint import ModuleContext
+from dasmtl.analysis.rules import make_finding, rule
+from dasmtl.analysis.rules.tracing import _traced_names_in_expr
+
+
+def _dim_bound_params(expr: ast.AST, params: Set[str]) -> Set[str]:
+    """Traced params whose *dimensions* bound the iteration — any reference
+    inside the iterable, including the static spellings DAS102 prunes
+    (``len(x)``, ``x.shape[...]``)."""
+    hits: Set[str] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in params:
+            hits.add(node.id)
+    return hits
+
+
+def _first_jax_call(ctx: ModuleContext, loop: ast.For) -> Optional[str]:
+    stack = list(loop.body) + list(loop.orelse)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue  # nested defs are their own reachability nodes
+        if isinstance(node, ast.Call):
+            name = ctx.resolve(node.func)
+            if name and (name == "jax" or name.startswith("jax.")):
+                return name
+        stack.extend(ast.iter_child_nodes(node))
+    return None
+
+
+@rule("DAS109", "warning",
+      "jax op inside a Python for-loop over a traced dimension: the trace "
+      "unrolls to O(N) HLO ops — use lax.scan / lax.fori_loop / vmap")
+def check_unrolled_loops(ctx: ModuleContext):
+    for fn in ctx.traced_reachable:
+        params = ctx.traced_params(fn)
+        if not params:
+            continue
+        for node in ctx.body_walk(fn):
+            if not isinstance(node, ast.For):
+                continue
+            if _traced_names_in_expr(ctx, node.iter, params):
+                continue  # DAS102 territory: iterating the tracer itself
+            hits = _dim_bound_params(node.iter, params)
+            if not hits:
+                continue
+            jax_call = _first_jax_call(ctx, node)
+            if jax_call is None:
+                continue
+            yield make_finding(
+                ctx, "DAS109", node,
+                f"loop over a dimension of traced {sorted(hits)} in "
+                f"{fn.name!r} calls {jax_call} each iteration: the trace "
+                f"unrolls (one HLO op set per step) — roll it into "
+                f"lax.scan / lax.fori_loop, or vmap over the axis")

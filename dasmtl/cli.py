@@ -50,3 +50,60 @@ def lint_main(argv=None) -> int:
     from dasmtl.analysis.lint import main
 
     return main(argv)
+
+
+def audit_main(argv=None) -> int:
+    """``dasmtl-audit`` — the compile-time StableHLO/cost-model auditor
+    (dasmtl/analysis/audit/; rules in docs/STATIC_ANALYSIS.md).  Lowers the
+    jitted steps on a CPU backend it pins itself, so it is safe on hosts
+    whose accelerator plugin must not be touched."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from dasmtl.analysis.audit.runner import main
+
+    return main(argv)
+
+
+def doctor_main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from dasmtl.utils.doctor import main
+
+    return main(argv)
+
+
+def export_main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from dasmtl.export import main
+
+    return main(argv)
+
+
+#: The umbrella ``dasmtl <subcommand>`` surface.  Every per-tool console
+#: script stays installed (``dasmtl-train`` etc. are what the docs teach),
+#: but one discoverable entry point means ``dasmtl audit --check-baseline``
+#: works without remembering the hyphenated name.
+_SUBCOMMANDS = {
+    "train": (train_main, "train a model (dasmtl-train)"),
+    "test": (test_main, "evaluate a checkpoint (dasmtl-test)"),
+    "stream": (stream_main, "streaming inference (dasmtl-stream)"),
+    "export": (export_main, "export a serving artifact (dasmtl-export)"),
+    "doctor": (doctor_main, "environment diagnostics (dasmtl-doctor)"),
+    "lint": (lint_main, "JAX-aware AST linter (dasmtl-lint)"),
+    "audit": (audit_main, "compile-time HLO/cost auditor (dasmtl-audit)"),
+}
+
+
+def main(argv=None) -> int:
+    """``dasmtl`` — dispatch to the per-tool entry points above."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: dasmtl <command> [args...]\n\ncommands:")
+        for name, (_, help_text) in _SUBCOMMANDS.items():
+            print(f"  {name:<8} {help_text}")
+        return 0 if argv else 2
+    cmd = argv.pop(0)
+    if cmd not in _SUBCOMMANDS:
+        print(f"dasmtl: unknown command {cmd!r} "
+              f"(choose from {', '.join(_SUBCOMMANDS)})", file=sys.stderr)
+        return 2
+    result = _SUBCOMMANDS[cmd][0](argv)
+    return 0 if result is None else int(result)

@@ -1,3 +1,4 @@
-from dasmtl.parallel.mesh import (MeshPlan, batch_sharding,  # noqa: F401
+from dasmtl.parallel.mesh import (MeshPlan, abstract_batch,  # noqa: F401
+                                  abstract_replicated, batch_sharding,
                                   create_mesh, replicated_sharding,
                                   shard_batch)

@@ -80,6 +80,47 @@ def shard_batch(plan: MeshPlan, batch: dict) -> dict:
     return {k: jax.device_put(v, shardings[k]) for k, v in batch.items()}
 
 
+def abstract_batch(global_batch: int, hw: tuple,
+                   plan: Optional[MeshPlan] = None) -> dict:
+    """ShapeDtypeStructs of one canonical batch — the AOT twin of
+    :func:`shard_batch`: same keys, dtypes and (with a ``plan``) the same
+    ``NamedSharding`` layout, but no data and no device transfers.  This is
+    what ``dasmtl.analysis.audit`` lowers the jitted steps against, so the
+    compiled artifact it inspects is the one a real run would execute."""
+    import jax.numpy as jnp
+
+    shardings = batch_sharding(plan) if plan is not None else {}
+
+    def sds(shape, dtype, key):
+        if shardings:
+            return jax.ShapeDtypeStruct(shape, dtype,
+                                        sharding=shardings[key])
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    h, w = hw
+    return {
+        "x": sds((global_batch, h, w, 1), jnp.float32, "x"),
+        "distance": sds((global_batch,), jnp.int32, "distance"),
+        "event": sds((global_batch,), jnp.int32, "event"),
+        "weight": sds((global_batch,), jnp.float32, "weight"),
+    }
+
+
+def abstract_replicated(tree, plan: Optional[MeshPlan] = None):
+    """Map every array-like leaf (anything with ``.shape``/``.dtype``,
+    including ``jax.eval_shape`` output) to a ShapeDtypeStruct carrying the
+    replicated sharding — the parameter/optimizer layout of the real run,
+    expressed without touching a device."""
+    rep = replicated_sharding(plan) if plan is not None else None
+
+    def to_sds(leaf):
+        if rep is not None:
+            return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=rep)
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+
+    return jax.tree.map(to_sds, tree)
+
+
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
                            process_id: Optional[int] = None) -> None:

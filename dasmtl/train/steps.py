@@ -342,6 +342,27 @@ def _eval_body(spec: ModelSpec, state: TrainState,
     }
 
 
+def lowerable_steps(spec: ModelSpec, mesh_plan=None,
+                    bn_sync: str = "global") -> Dict[str, Any]:
+    """The jitted step callables keyed by kind, for AOT lowering.
+
+    ``dasmtl.analysis.audit`` compiles these against abstract
+    ``ShapeDtypeStruct`` inputs (``dasmtl.parallel.mesh.abstract_batch`` /
+    ``abstract_replicated``) and inspects the StableHLO / cost model — the
+    contract being audited is exactly the executable a real run dispatches,
+    so the factories here are the same ones the trainer calls, not
+    simplified twins.  Nothing is executed and no data is touched.
+
+    Donation state is whatever :func:`donate_argnums` resolves right now
+    (i.e. ``DASMTL_DISABLE_DONATION`` applies), so the auditor sees the
+    aliasing contract of the current environment.
+    """
+    return {
+        "train": make_train_step(spec, mesh_plan=mesh_plan, bn_sync=bn_sync),
+        "eval": make_eval_step(spec),
+    }
+
+
 def make_eval_step(spec: ModelSpec):
     """Returns ``eval_step(state, batch) -> out`` with per-example predictions
     (for host-side confusion matrices) and weighted loss sums."""

@@ -124,8 +124,27 @@ def collect() -> dict:
             "guard_transfer": d.guard_transfer,
             "guard_nan_check": d.guard_nan_check,
         },
+        "audit_baseline": _audit_baseline_summary(),
     }
     return info
+
+
+def _audit_baseline_summary() -> dict:
+    """Status of the compile-time auditor's committed budgets — metadata
+    only (reading the JSON; never lowering/compiling anything here)."""
+    from dasmtl.analysis.audit.baseline import (DEFAULT_BASELINE_PATH,
+                                                load_baseline)
+
+    path = DEFAULT_BASELINE_PATH
+    try:
+        data = load_baseline(path)
+    except (OSError, ValueError) as exc:
+        return {"path": path, "status": f"unreadable ({exc})"}
+    if data is None:
+        return {"path": path, "status": "missing"}
+    return {"path": path, "status": "ok",
+            "targets": len(data.get("targets", {})),
+            "generated_with": data.get("generated_with", {})}
 
 
 def main(argv=None) -> int:
@@ -170,6 +189,17 @@ def main(argv=None) -> int:
           "(dasmtl-lint; docs/STATIC_ANALYSIS.md)")
     print("  guard defaults: " + ", ".join(
         f"{k}={v}" for k, v in ana.get("guard_defaults", {}).items()))
+    ab = ana.get("audit_baseline", {})
+    if ab.get("status") == "ok":
+        gen = ab.get("generated_with", {})
+        gen_s = ", ".join(f"{k} {v}" for k, v in sorted(gen.items()))
+        print(f"  audit: baseline ok — {ab['targets']} target(s) in "
+              f"{ab['path']}" + (f" (from {gen_s})" if gen_s else "")
+              + "; verify with dasmtl-audit --check-baseline")
+    else:
+        print(f"  audit: baseline {ab.get('status', 'missing')} at "
+              f"{ab.get('path')} — generate with dasmtl-audit "
+              f"--update-baseline --preset full")
     return 0
 
 

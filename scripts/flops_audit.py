@@ -1,22 +1,17 @@
-"""Analytic MXU-FLOPs audit: jaxpr-derived conv/matmul FLOPs vs XLA's cost
-model.
+"""DEPRECATED shim — the MXU-FLOPs audit moved into the auditor.
 
-Round-2 verdict flagged that the published MFU 0.81 rests solely on
-``compiled.cost_analysis()["flops"]``, which can over-count (padding,
-fusion bookkeeping).  This audit derives a second, independent count from
-the *mathematical* operations themselves: it walks the traced jaxpr of the
-forward and of the full train step and sums
+The analytic jaxpr walk and the cost-model comparison now live in
+``dasmtl.analysis.audit`` (``analytic.py`` / ``runner.legacy_flops_report``)
+so there is exactly one cost-model code path: what this script printed,
+``dasmtl-audit`` now measures per matrix target and gates against
+``artifacts/audit_baseline.json``.
 
-- ``conv_general_dilated``: 2 x out_elements x (in_ch / groups) x prod(kernel)
-- ``dot_general``:          2 x out_elements x prod(contracting dims)
+This wrapper keeps the old CLI (``--batch/--dtype/--samples_per_s/
+--peak_flops``) and the old one-JSON-line stdout contract for existing
+harvest tooling.  New callers should use::
 
-(element-wise work is excluded on purpose — MFU measures MXU utilization,
-and the elementwise FLOPs are noise at these shapes).  Comparing the two
-counts bounds how much of the cost-model figure is real arithmetic.
-
-Run:  python scripts/flops_audit.py [--batch 256] [--dtype bfloat16]
-          [--samples_per_s 128510]   # recompute MFU from a measured rate
-Emits one JSON line on stdout.  Works on any backend (counting only).
+    dasmtl-audit --check-baseline            # the CI gate
+    dasmtl-audit --preset full --format json # raw per-target metrics
 """
 
 from __future__ import annotations
@@ -28,60 +23,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Peak dense bf16 FLOP/s by TPU generation (public spec sheets), as bench.py.
-_PEAK_BF16 = {"v6e": 918e12, "trillium": 918e12, "v5p": 459e12,
-              "v5e": 197e12, "v5 lite": 197e12, "v4": 275e12}
-
-
-def _subjaxprs(params):
-    for v in params.values():
-        if hasattr(v, "jaxpr"):  # ClosedJaxpr
-            yield v.jaxpr
-        elif hasattr(v, "eqns"):  # raw Jaxpr
-            yield v
-        elif isinstance(v, (list, tuple)):
-            for item in v:
-                if hasattr(item, "jaxpr"):
-                    yield item.jaxpr
-                elif hasattr(item, "eqns"):
-                    yield item
-
-
-def mxu_flops(jaxpr) -> float:
-    """Sum conv/dot FLOPs over a jaxpr, recursing into call sub-jaxprs
-    (pjit, custom_vjp, scan bodies — scan trip counts are NOT multiplied,
-    callers audit unrolled-free computations)."""
-    total = 0.0
-    for eqn in jaxpr.eqns:
-        name = eqn.primitive.name
-        if name == "conv_general_dilated":
-            out_elems = 1
-            for d in eqn.outvars[0].aval.shape:
-                out_elems *= d
-            rhs_shape = eqn.invars[1].aval.shape
-            dn = eqn.params["dimension_numbers"]
-            in_ch_per_group = rhs_shape[dn.rhs_spec[1]]
-            k_elems = 1
-            for i in dn.rhs_spec[2:]:
-                k_elems *= rhs_shape[i]
-            total += 2.0 * out_elems * in_ch_per_group * k_elems
-        elif name == "dot_general":
-            out_elems = 1
-            for d in eqn.outvars[0].aval.shape:
-                out_elems *= d
-            (lhs_c, _), _ = eqn.params["dimension_numbers"]
-            lhs_shape = eqn.invars[0].aval.shape
-            contract = 1
-            for i in lhs_c:
-                contract *= lhs_shape[i]
-            total += 2.0 * out_elems * contract
-        for sub in _subjaxprs(eqn.params):
-            total += mxu_flops(sub)
-    return total
-
 
 def main() -> int:
-    ap = argparse.ArgumentParser()
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--dtype", type=str, default="bfloat16")
     ap.add_argument("--samples_per_s", type=float, default=None,
@@ -91,74 +35,15 @@ def main() -> int:
                     help="override peak FLOP/s (default: by device kind)")
     args = ap.parse_args()
 
-    import jax
-    import numpy as np
+    print("scripts/flops_audit.py is deprecated: the cost-model audit "
+          "lives in dasmtl-audit now (docs/STATIC_ANALYSIS.md); this shim "
+          "delegates and will be removed", file=sys.stderr)
 
-    from dasmtl.config import Config
-    from dasmtl.main import build_state
-    from dasmtl.models.registry import get_model_spec
-    from dasmtl.train.steps import make_train_step
-    from dasmtl.utils.profiling import flops_of
+    from dasmtl.analysis.audit.runner import legacy_flops_report
 
-    cfg = Config(model="MTL", batch_size=args.batch,
-                 compute_dtype=args.dtype)
-    spec = get_model_spec(cfg.model)
-    state = build_state(cfg, spec)
-    train_step = make_train_step(spec)
-
-    rng = np.random.default_rng(0)
-    batch = {
-        "x": rng.normal(size=(args.batch, 100, 250, 1)).astype(np.float32),
-        "distance": rng.integers(0, 16, size=(args.batch,)).astype(np.int32),
-        "event": rng.integers(0, 2, size=(args.batch,)).astype(np.int32),
-        "weight": np.ones((args.batch,), np.float32),
-    }
-    lr = np.float32(1e-3)
-
-    def forward(variables, x):
-        return state.apply_fn(variables, x, train=False)
-
-    variables = {"params": state.params, "batch_stats": state.batch_stats}
-    fwd_jaxpr = jax.make_jaxpr(forward)(variables, batch["x"])
-    step_jaxpr = jax.make_jaxpr(
-        lambda s, b, r: train_step(s, b, r))(state, batch, lr)
-
-    fwd_analytic = mxu_flops(fwd_jaxpr.jaxpr)
-    step_analytic = mxu_flops(step_jaxpr.jaxpr)
-    fwd_cost = flops_of(forward, variables, batch["x"])
-    step_cost = flops_of(lambda s, b, r: train_step(s, b, r),
-                         state, batch, lr)
-
-    result = {
-        "metric": "mxu_flops_audit",
-        "batch_size": args.batch,
-        "compute_dtype": args.dtype,
-        "backend": jax.default_backend(),
-        "forward_flops_analytic": fwd_analytic,
-        "forward_flops_cost_model": fwd_cost,
-        "train_step_flops_analytic": step_analytic,
-        "train_step_flops_cost_model": step_cost,
-        "bwd_fwd_ratio_analytic": round(step_analytic / fwd_analytic, 3),
-    }
-    if fwd_cost:
-        result["cost_over_analytic_forward"] = round(
-            fwd_cost / fwd_analytic, 4)
-    if step_cost:
-        result["cost_over_analytic_step"] = round(
-            step_cost / step_analytic, 4)
-    if args.samples_per_s:
-        peak = args.peak_flops
-        if peak is None:
-            kind = jax.devices()[0].device_kind.lower()
-            peak = next((v for k, v in _PEAK_BF16.items() if k in kind),
-                        None)
-        if peak:
-            per_sample = step_analytic / args.batch
-            result["mfu_analytic"] = round(
-                args.samples_per_s * per_sample / peak, 4)
-            if step_cost:
-                result["mfu_cost_model"] = round(
-                    args.samples_per_s * step_cost / args.batch / peak, 4)
+    result = legacy_flops_report(args.batch, args.dtype,
+                                 samples_per_s=args.samples_per_s,
+                                 peak_flops=args.peak_flops)
     print(json.dumps(result))
     return 0
 

@@ -7,14 +7,25 @@ cd "$(dirname "$0")/.."
 
 rc=0
 
-echo "== dasmtl-lint dasmtl/"
-python -m dasmtl.analysis.lint dasmtl/ || rc=1
+echo "== dasmtl-lint dasmtl/ (+ unused-noqa report)"
+python -m dasmtl.analysis.lint --report-unused-noqa dasmtl/ || rc=1
 
 if command -v ruff >/dev/null 2>&1; then
     echo "== ruff check"
     ruff check || rc=1
 else
     echo "== ruff not installed here; skipped (CI runs it — pip install ruff)"
+fi
+
+# Compile-time audit against the committed budgets.  `quick` compiles the
+# one sharded MTL config (~40 s — always a cold compile: the auditor
+# disables the persistent cache because deserialized executables lose
+# their aliasing table); CI's audit job runs the wider `ci` preset.
+if [ "${DASMTL_LINT_SKIP_AUDIT:-}" = "" ]; then
+    echo "== dasmtl-audit --check-baseline --preset quick"
+    python -m dasmtl.analysis.audit --check-baseline --preset quick || rc=1
+else
+    echo "== dasmtl-audit skipped (DASMTL_LINT_SKIP_AUDIT set)"
 fi
 
 exit $rc

@@ -293,6 +293,108 @@ class Trainer:
     assert len(lines_of(src, "DAS107")) == 1
 
 
+# -- DAS108: float64 in jax code ---------------------------------------------
+
+_DAS108_POS = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def make_table():
+    return jnp.zeros((4,), dtype=jnp.float64)   # jnp f64 reference
+
+def widen():
+    return jnp.arange(4, dtype=np.float64)      # np f64 into a jnp call
+
+def enable():
+    jax.config.update("jax_enable_x64", True)   # the global switch
+
+@jax.jit
+def step(x):
+    return x.astype("float64").sum()            # traced astype to f64
+"""
+
+_DAS108_NEG = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def host_metrics(cm):
+    tp = np.diag(cm).astype(np.float64)         # host numpy f64 is fine
+    return np.asarray(tp, np.float64).mean()
+
+@jax.jit
+def step(x):
+    return x.astype(jnp.float32).sum()
+"""
+
+
+def test_das108_flags_jax_float64_spellings():
+    lines = lines_of(_DAS108_POS, "DAS108")
+    assert len(lines) == 4, lines
+
+
+def test_das108_allows_host_numpy_f64():
+    assert "DAS108" not in ids(_DAS108_NEG)
+
+
+# -- DAS109: unrolled loop over a traced dimension ----------------------------
+
+_DAS109_POS = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    acc = jnp.zeros(())
+    for i in range(x.shape[0]):                 # static bound, but...
+        acc = acc + jnp.sum(x[i])               # ...a jnp op per iteration
+    return acc
+"""
+
+_DAS109_NEG = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x, spec):
+    names = []
+    for i in range(x.shape[0]):                 # no jax ops inside: cheap
+        names.append(i)
+    for k in range(4):                          # bound not from a tracer
+        x = x + jnp.ones_like(x)
+    return x
+
+def host_loop(batches):
+    for b in batches:                           # host code loops freely
+        jnp.asarray(b)
+"""
+
+
+def test_das109_flags_jnp_op_in_unrolled_loop():
+    assert "DAS109" in ids(_DAS109_POS)
+
+
+def test_das109_allows_cheap_and_static_loops():
+    assert "DAS109" not in ids(_DAS109_NEG)
+
+
+def test_das109_defers_to_das102_on_direct_iteration():
+    src = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    acc = jnp.zeros(())
+    for row in x:                               # iterating the tracer itself
+        acc = acc + jnp.sum(row)
+    return acc
+"""
+    found = ids(src)
+    assert "DAS102" in found and "DAS109" not in found
+
+
 # -- suppression + framework -------------------------------------------------
 
 def test_noqa_suppresses_named_rule():
@@ -314,6 +416,76 @@ def test_plain_flake8_noqa_is_not_honored():
     assert "DAS104" in ids(src)
 
 
+def test_noqa_inside_string_literal_is_inert():
+    """A string/docstring merely MENTIONING the noqa syntax must neither
+    suppress findings on its line nor count as a (dead) suppression."""
+    src = ('MSG = "use # dasmtl: noqa[DAS104] to suppress"\n'
+           "def f(x, acc=[]):\n    return acc\n")
+    assert "DAS104" in ids(src)
+    findings = lint_source(src, "snippet.py", report_unused_noqa=True)
+    assert [f for f in findings if f.rule == "DAS199"] == []
+
+
+# -- --report-unused-noqa (DAS199) -------------------------------------------
+
+def unused(src: str):
+    return [f.line for f in lint_source(src, "snippet.py",
+                                        report_unused_noqa=True)
+            if f.rule == "DAS199"]
+
+
+def test_unused_listed_noqa_is_reported():
+    src = "def f(x):  # dasmtl: noqa[DAS104]\n    return x\n"
+    assert unused(src) == [1]
+
+
+def test_used_noqa_is_not_reported():
+    src = "def f(x, acc=[]):  # dasmtl: noqa[DAS104]\n    return acc\n"
+    assert unused(src) == []
+
+
+def test_partially_used_noqa_reports_the_dead_rule():
+    src = ("def f(x, acc=[]):  # dasmtl: noqa[DAS104,DAS101]\n"
+           "    return acc\n")
+    findings = [f for f in lint_source(src, "snippet.py",
+                                       report_unused_noqa=True)
+                if f.rule == "DAS199"]
+    assert len(findings) == 1
+    assert "DAS101" in findings[0].message
+
+
+def test_unused_bare_noqa_is_reported_and_cannot_hide_itself():
+    src = "def f(x):  # dasmtl: noqa\n    return x\n"
+    assert unused(src) == [1]
+
+
+def test_used_bare_noqa_is_not_reported():
+    src = "def f(x, acc=[]):  # dasmtl: noqa\n    return acc\n"
+    assert unused(src) == []
+
+
+def test_select_run_does_not_misreport_unselected_rules():
+    # DAS104 would fire here, but only DAS101 ran — the suppression cannot
+    # be proven dead and must not be reported.
+    src = "def f(x, acc=[]):  # dasmtl: noqa[DAS104]\n    return acc\n"
+    findings = lint_source(src, "snippet.py", select=["DAS101"],
+                           report_unused_noqa=True)
+    assert findings == []
+
+
+def test_cli_report_unused_noqa_exit_code(tmp_path):
+    stale = tmp_path / "stale.py"
+    stale.write_text("def f(x):  # dasmtl: noqa[DAS104]\n    return x\n")
+    env_cmd = [sys.executable, "-m", "dasmtl.analysis.lint"]
+    # Without the flag the dead suppression is invisible...
+    assert subprocess.run(env_cmd + [str(stale)]).returncode == 0
+    # ...with it, DAS199 fires and the run fails.
+    proc = subprocess.run(env_cmd + ["--report-unused-noqa", str(stale)],
+                          capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "DAS199" in proc.stdout
+
+
 def test_syntax_error_is_a_finding():
     assert ids("def f(:\n") == ["DAS000"]
 
@@ -322,7 +494,7 @@ def test_rule_registry_is_stable():
     got = [r.id for r in all_rules()]
     assert got == sorted(got)
     assert {"DAS101", "DAS102", "DAS103", "DAS104", "DAS105", "DAS106",
-            "DAS107"} <= set(got)
+            "DAS107", "DAS108", "DAS109"} <= set(got)
 
 
 def test_package_lints_clean():
