@@ -3,7 +3,7 @@
 JAX-specific defects — stray host syncs inside the step path, per-step
 recompilation, PRNG key reuse, donated-buffer reads — pass CPU unit tests
 and only surface as silent wall-clock regressions (or heap corruption) on a
-real v4-8.  This package catches them three ways:
+real v4-8.  This package catches them four ways:
 
 - :mod:`dasmtl.analysis.lint` — an AST linter with JAX-aware rules
   (``dasmtl-lint``; rule registry in :mod:`dasmtl.analysis.rules`), run over
@@ -16,6 +16,11 @@ real v4-8.  This package catches them three ways:
   step: ``jax.transfer_guard("disallow")`` after warmup, an XLA
   recompilation counter fed by ``jax.monitoring``, and optional NaN
   checking.  Enabled by ``Config.tracing_guards``.
+- :mod:`dasmtl.analysis.sanitize` — runtime SPMD sanitizers
+  (``dasmtl-sanitize``): replica-divergence fingerprints, checkify
+  NaN/Inf blame threaded through the step factories, and determinism
+  hash chains gated against a committed baseline.  Enabled by
+  ``Config.sanitize``; proves itself by seeded fault injection.
 
 ``docs/STATIC_ANALYSIS.md`` documents every rule id and the
 ``# dasmtl: noqa[RULE]`` suppression syntax.

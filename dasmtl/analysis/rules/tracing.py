@@ -10,10 +10,19 @@ DAS106 — ``print()`` / f-string interpolation of traced values inside a
 traced function.  These run at trace time (once), not at step time — they
 look like per-step logging and are not; use ``jax.debug.print``.
 
-Both rules only look at the *parameters* of jit-reachable functions (the
+DAS110 — Python ``assert`` on a traced value inside a traced function.
+The condition is evaluated ONCE with an abstract value: either the bool
+conversion raises at trace time (so the "check" can never see real data),
+or it constant-folds and the assert silently bakes to a no-op in the
+compiled program — and ``python -O`` strips it entirely either way.  A
+per-step value check belongs in ``jax.experimental.checkify.check`` (the
+sanitize suite wires it: ``make_train_step(checkify_errors=True)`` /
+``Config.sanitize``).
+
+The rules only look at the *parameters* of jit-reachable functions (the
 values that are certainly tracers) and skip shape/dtype/static accesses, so
 idiomatic static configuration (``if spec.uses_dropout``, ``x.shape[0]``,
-``if mask is None``) never trips them.
+``if mask is None``, ``assert x.ndim == 4``) never trips them.
 """
 
 from __future__ import annotations
@@ -106,3 +115,26 @@ def check_trace_time_side_effects(ctx: ModuleContext):
                             f"tracer (or trace-time constant), not the "
                             f"per-step value")
                         break
+
+
+@rule("DAS110", "error",
+      "Python `assert` on a traced value inside jit-reachable code "
+      "(trace-time no-op; use checkify.check)")
+def check_traced_assert(ctx: ModuleContext):
+    for fn in ctx.traced_reachable:
+        params = ctx.traced_params(fn)
+        if not params:
+            continue
+        for node in ctx.body_walk(fn):
+            if not isinstance(node, ast.Assert):
+                continue
+            hits = _traced_names_in_expr(ctx, node.test, params)
+            if hits:
+                yield make_finding(
+                    ctx, "DAS110", node,
+                    f"`assert` on traced value(s) {sorted(hits)} in "
+                    f"{fn.name!r}: under tracing this either raises before "
+                    f"seeing data or silently bakes to a no-op (and -O "
+                    f"strips it) — use jax.experimental.checkify.check, "
+                    f"wired via make_train_step(checkify_errors=True) / "
+                    f"Config.sanitize")

@@ -1,0 +1,51 @@
+"""Runtime SPMD sanitizer suite — the execution-time member of the
+``dasmtl.analysis`` triad (lint: source, audit: lowered HLO, sanitize:
+**live run**).
+
+Three sanitizers, each targeting a defect class that neither the AST
+rules nor the compile-time auditor can prove absent:
+
+- **SAN201** (:mod:`divergence`) — replica-divergence detection: cheap
+  on-device fingerprints of params / optimizer state / BN running stats /
+  PRNG key *per dp replica*, compared at a configurable step cadence.
+  Catches missing grad sync, desynced PRNG streams and BN desync — the
+  SPMD analog of a data race.
+- **SAN202** (:mod:`checks`) — ``jax.experimental.checkify`` threaded
+  through the train-step factories (``make_train_step(checkify_errors=
+  True)``) with a cheap per-step non-finite probe and a checkify replay
+  for op-level first-failure blame.
+- **SAN203** (:mod:`determinism`) — determinism hash chains over seeded
+  short runs of the production factories, gated against the committed
+  ``artifacts/determinism_baseline.json``.
+
+The suite proves itself by seeded fault injection (:mod:`faults`,
+``dasmtl-sanitize --self-test``).  Wired into training via
+``Config.sanitize``; catalog and workflows in docs/STATIC_ANALYSIS.md.
+
+Everything re-exports lazily: the CLI must be able to print ``--help``
+and pin its backend before anything imports jax.
+"""
+
+_COMMON_EXPORTS = ("SanitizeError", "ReplicaDivergenceError",
+                   "CheckifyFailure", "NonFiniteError", "SanitizeFinding")
+_LAZY = {
+    "DivergenceMonitor": "dasmtl.analysis.sanitize.divergence",
+    "StepSanitizer": "dasmtl.analysis.sanitize.checks",
+    "assert_finite_state": "dasmtl.analysis.sanitize.checks",
+    "step_error_set": "dasmtl.analysis.sanitize.checks",
+    "observe_error": "dasmtl.analysis.sanitize.checks",
+    "run_cell": "dasmtl.analysis.sanitize.determinism",
+    "SanitizeCell": "dasmtl.analysis.sanitize.determinism",
+}
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _COMMON_EXPORTS:
+        from dasmtl.analysis.sanitize import common
+
+        return getattr(common, name)
+    if name in _LAZY:
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,9 @@
+"""``python -m dasmtl.analysis.sanitize`` — same surface as the installed
+``dasmtl-sanitize`` console script (and ``dasmtl sanitize``)."""
+
+import sys
+
+from dasmtl.analysis.sanitize.runner import main
+
+if __name__ == "__main__":
+    sys.exit(main())

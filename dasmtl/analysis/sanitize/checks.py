@@ -1,0 +1,156 @@
+"""SAN202 — checkify wiring and the cheap non-finite probe.
+
+``jax.experimental.checkify`` instruments every float op of a traced
+function with error predicates and threads the first failure out as a
+functional value — the only way to get **op-level blame** ("nan generated
+by primitive: conv_general_dilated" with a source line) out of a jitted
+step.  The catch: instrumentation inflates the XLA program, and on this
+container's single-core CPU the checkified full-size train step takes
+minutes to compile.  So the sanitizer runs two-tier:
+
+- every step, a **cheap probe** (:func:`fingerprint.nonfinite_any` over
+  the step's metrics and the new state — one fused reduction, ~ms);
+- on the first trip, the *same* ``(state, batch, lr)`` is **replayed**
+  through the checkify-wrapped factory
+  (``make_train_step(checkify_errors=True)``) to localize blame.  The
+  replay pays the instrumented compile exactly once, on the failure path,
+  where minutes against an otherwise-silent corruption is a bargain.
+
+Small models (tests, the self-test spec) compile the checkified step in
+well under a second and can use it directly.
+
+``checkify.index_checks`` is excluded by default: on jax 0.4.37 its
+gather instrumentation crashes at trace time on ``take_along_axis``
+(tuple-index bug inside checkify itself) — the NLL gather in every loss
+here trips it.  ``step_error_set(oob=True)`` re-enables OOB checking for
+jax versions where that is fixed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.experimental import checkify
+
+from dasmtl.analysis.sanitize.common import CheckifyFailure, NonFiniteError
+from dasmtl.analysis.sanitize.fingerprint import (nonfinite_any,
+                                                  nonfinite_leaves)
+
+
+def step_error_set(oob: bool = False):
+    """The checkify error set the step factories instrument: NaN/Inf and
+    division-by-zero always; out-of-bounds indexing opt-in (see module
+    docstring for the jax 0.4.37 caveat)."""
+    errors = checkify.float_checks  # nan + div-by-zero
+    if oob:
+        errors = errors | checkify.index_checks
+    return errors
+
+
+def observe_error(err, context: str = "") -> None:
+    """Pull a checkify Error to the host and raise on first failure.
+
+    ``err.get()`` is an *explicit* transfer (legal under the step
+    guards' ``transfer_guard("disallow")`` discipline), but it does block
+    on the step — call it outside the guarded region, after dispatch.
+    """
+    msg = err.get()
+    if msg is None:
+        return
+    where = f" at {context}" if context else ""
+    raise CheckifyFailure(f"SAN202: checkify tripped{where}: {msg}")
+
+
+class StepSanitizer:
+    """Per-step driver of the two-tier SAN202 flow for a Trainer.
+
+    ``after_step(prev_state, batch, lr, new_state, metrics)`` runs the
+    cheap probe over ``(metrics, new params/batch_stats)``; on a trip it
+    replays the step through the checkified factory for blame.  Requires
+    the un-checkified step to run **without donation** (the replay reads
+    ``prev_state`` again) — ``Trainer`` builds it that way when
+    ``Config.sanitize`` is set.
+    """
+
+    def __init__(self, spec, mesh_plan=None, bn_sync: str = "global"):
+        self.spec = spec
+        self.mesh_plan = mesh_plan
+        self.bn_sync = bn_sync
+        self.steps_checked = 0
+        self._checkified = None  # built only on the failure path
+
+    def _checkified_step(self):
+        if self._checkified is None:
+            from dasmtl.train.steps import make_train_step
+
+            self._checkified = make_train_step(
+                self.spec, mesh_plan=self.mesh_plan, bn_sync=self.bn_sync,
+                checkify_errors=True)
+        return self._checkified
+
+    def after_step(self, prev_state, batch, lr, new_state,
+                   metrics: Dict[str, Any], context: str = "") -> None:
+        probe_tree = {"metrics": metrics, "params": new_state.params,
+                      "batch_stats": new_state.batch_stats}
+        flagged = bool(jax.device_get(_nonfinite_probe()(probe_tree)))
+        self.steps_checked += 1
+        if not flagged:
+            return
+        where = f" at {context}" if context else ""
+        print(f"[sanitize] non-finite value detected{where}; replaying the "
+              f"step under checkify for op-level blame (compiles the "
+              f"instrumented step once — this can take a while on CPU)")
+        try:
+            err, _ = self._checkified_step()(prev_state, batch, lr)
+            observe_error(err, context=context)
+        except CheckifyFailure:
+            raise
+        except Exception as exc:  # noqa: BLE001 — replay is best-effort
+            raise NonFiniteError(
+                f"SAN202: non-finite value in step outputs{where} in "
+                f"{nonfinite_leaves(probe_tree)} (checkify replay failed: "
+                f"{exc!r})") from exc
+        # The replay came back clean: the poison is in the *inputs* (state
+        # was already non-finite before this step) or in a path checkify
+        # does not instrument — still fail, with leaf-level blame.
+        raise NonFiniteError(
+            f"SAN202: non-finite value in step outputs{where} in "
+            f"{nonfinite_leaves(probe_tree)} — the checkify replay of this "
+            f"step is clean, so the inputs were already poisoned (check "
+            f"the previous steps / the data pipeline)")
+
+    def summary(self) -> Dict[str, Any]:
+        return {"steps_checked": self.steps_checked,
+                "replay_compiled": self._checkified is not None}
+
+
+_jitted_nonfinite: Optional[Any] = None
+
+
+def _nonfinite_probe():
+    """One shared jitted probe (a fresh ``jax.jit`` wrapper per call would
+    retrace every time — the wrapper itself is the trace cache key)."""
+    global _jitted_nonfinite
+    if _jitted_nonfinite is None:
+        _jitted_nonfinite = jax.jit(nonfinite_any)
+    return _jitted_nonfinite
+
+
+def assert_finite_state(state_or_tree: Any, context: str = "") -> None:
+    """Epoch-cadence finite check for paths where per-step checkify wiring
+    is not available (the fused CV scan-over-vmap dispatch): one eager
+    all-finite reduction per float leaf, a single failure message naming
+    the poisoned leaves."""
+    tree = state_or_tree
+    if hasattr(tree, "params"):  # a TrainState (possibly fold-stacked)
+        tree = {"params": tree.params, "batch_stats": tree.batch_stats,
+                "opt_state": tree.opt_state}
+    flagged = bool(jax.device_get(_nonfinite_probe()(tree)))
+    if not flagged:
+        return
+    where = f" at {context}" if context else ""
+    raise NonFiniteError(
+        f"SAN202: non-finite values in state{where} in "
+        f"{nonfinite_leaves(tree)} — NaN/Inf poisoning; re-run the "
+        f"offending step with Config.sanitize for op-level blame")

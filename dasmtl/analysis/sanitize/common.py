@@ -1,0 +1,40 @@
+"""Shared types of the runtime sanitizer suite — no jax import here, so
+the CLI can parse arguments and render findings before any backend
+decision is made (same discipline as the linter's Finding type)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+class SanitizeError(RuntimeError):
+    """Base class: a runtime sanitizer caught a defect in a live run."""
+
+
+class ReplicaDivergenceError(SanitizeError):
+    """SAN201 — replicas of nominally replicated state hold different
+    values (missing grad sync, desynced PRNG streams, BN desync)."""
+
+
+class CheckifyFailure(SanitizeError):
+    """SAN202 — a checkify-instrumented step reported NaN/Inf,
+    division-by-zero, or an out-of-bounds index, with op-level blame."""
+
+
+class NonFiniteError(SanitizeError):
+    """SAN202 — the cheap non-finite probe tripped (and, when a checkify
+    replay was possible, carries its blame message)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SanitizeFinding:
+    """One sanitizer finding, mirroring the audit's AuditFinding shape so
+    the two CLIs render and JSON-serialize identically."""
+
+    rule: str  # SAN201 | SAN202 | SAN203
+    severity: str  # "error" | "warning"
+    target: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.target}: {self.rule} [{self.severity}] {self.message}"

@@ -1,0 +1,317 @@
+"""SAN203 — determinism fingerprints and the committed baseline.
+
+Run-to-run nondeterminism makes the parity ladder and the TPU bench
+figures unreproducible — and it creeps in silently (an op that picks a
+nondeterministic reduction, an accidental dependence on host state, a
+data-order change).  This module pins it the same way the audit pins cost
+budgets: each cell of a config matrix runs a short, fully seeded
+training loop through the **production** step factories on synthetic
+data, and commits
+
+- a SHA-256 **hash chain** over every step's metric record (bit-exact
+  trajectory),
+- SHA-256 digests of the final params / BatchNorm stats / optimizer
+  state,
+- float summary metrics (``final_loss``) compared under tolerance.
+
+``dasmtl-sanitize --check-baseline`` fails when any digest moves.  Digest
+comparison is version-gated: XLA is free to change instruction selection
+across jax/jaxlib releases, so when the baseline's ``generated_with``
+disagrees with the running versions the exact-digest check is skipped
+(stderr note) and only the tolerance-checked float metrics gate — the
+workflow is then to justify the bump and ``--update-baseline``, exactly
+like the audit.  Hand-edited tolerances survive updates.
+
+Clean cells double as runtime smoke for the other sanitizers: every dp>1
+cell ends with a replica-divergence check (SAN201) and every cell with a
+non-finite probe (SAN202).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from dasmtl.analysis.sanitize.common import SanitizeFinding
+
+DEFAULT_BASELINE_PATH = os.path.join("artifacts",
+                                     "determinism_baseline.json")
+
+#: Relative tolerance per float metric when digests cannot gate (version
+#: mismatch) — and a second line of defense when they can.
+DEFAULT_TOLERANCES: Dict[str, float] = {
+    "final_loss": 1e-4,
+    "final_count": 0.0,
+}
+
+MATRIX_MODELS = ("MTL", "single_event", "multi_classifier")
+MATRIX_DTYPES = ("float32", "bfloat16")
+MATRIX_DP = (1, 2)
+
+
+@dataclasses.dataclass(frozen=True)
+class SanitizeCell:
+    """One determinism cell: a seeded short run of one configuration."""
+
+    model: str
+    compute_dtype: str = "float32"
+    dp: int = 1
+    batch_size: int = 8  # per device
+    steps: int = 4
+    hw: Tuple[int, int] = (100, 250)  # the production input geometry
+    seed: int = 0
+
+    @property
+    def name(self) -> str:
+        dt = "bf16" if self.compute_dtype == "bfloat16" else "f32"
+        return f"{self.model}-{dt}-dp{self.dp}"
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp
+
+
+def full_matrix() -> List[SanitizeCell]:
+    return [SanitizeCell(model=m, compute_dtype=dt, dp=dp)
+            for m in MATRIX_MODELS for dt in MATRIX_DTYPES
+            for dp in MATRIX_DP]
+
+
+def _named(names: Tuple[str, ...]) -> List[SanitizeCell]:
+    by_name = {c.name: c for c in full_matrix()}
+    return [by_name[n] for n in names]
+
+
+#: quick: the one dp-sharded cell (divergence + determinism in one run).
+#: ci: adds the 1-device contract, bf16 and model B — mirrors the audit's
+#: ci preset cell-for-cell so the two gates cover the same configs.
+#: full: the whole matrix (baseline regeneration; Inception cells are the
+#: slow ones).
+PRESETS: Dict[str, List[SanitizeCell]] = {
+    "quick": _named(("MTL-f32-dp2",)),
+    "ci": _named(("MTL-f32-dp1", "MTL-f32-dp2", "MTL-bf16-dp2",
+                  "single_event-f32-dp1")),
+    "full": full_matrix(),
+}
+
+
+def resolve_cells(preset: Optional[str] = None,
+                  names: Optional[str] = None) -> List[SanitizeCell]:
+    if names:
+        wanted = [n.strip() for n in names.split(",") if n.strip()]
+        by_name = {c.name: c for c in full_matrix()}
+        unknown = sorted(set(wanted) - set(by_name))
+        if unknown:
+            raise ValueError(f"unknown sanitize cell(s) {unknown}; known: "
+                             f"{sorted(by_name)}")
+        return [by_name[n] for n in wanted]
+    preset = preset or "ci"
+    if preset not in PRESETS:
+        raise ValueError(f"unknown preset {preset!r}; "
+                         f"choose from {sorted(PRESETS)}")
+    return PRESETS[preset]
+
+
+@dataclasses.dataclass
+class CellReport:
+    """Measured fingerprints of one cell run."""
+
+    name: str
+    n_devices: int
+    compute_dtype: str
+    steps: int
+    digests: Dict[str, str]
+    metrics: Dict[str, float]
+
+    def to_baseline_entry(self) -> dict:
+        return {"n_devices": self.n_devices,
+                "compute_dtype": self.compute_dtype, "steps": self.steps,
+                "digests": dict(self.digests),
+                "metrics": {k: float(v) for k, v in self.metrics.items()}}
+
+
+def synthetic_batch(rng, n: int, hw: Tuple[int, int]) -> dict:
+    """One seeded host batch in the canonical layout (labels cover both
+    task heads; ``mixed_label`` derives the 32-way label inside the step)."""
+    import numpy as np
+
+    return {
+        "x": rng.normal(size=(n, hw[0], hw[1], 1)).astype(np.float32),
+        "distance": rng.integers(0, 16, n).astype(np.int32),
+        "event": rng.integers(0, 2, n).astype(np.int32),
+        "weight": np.ones((n,), np.float32),
+    }
+
+
+def run_cell(cell: SanitizeCell, spec=None,
+             ) -> Tuple[CellReport, List[SanitizeFinding]]:
+    """Run one seeded cell through the production train-step factory and
+    fingerprint the trajectory.  Returns the report plus any SAN201/202
+    findings from the clean-run checks (a clean cell returns none)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dasmtl.analysis.sanitize.checks import _nonfinite_probe
+    from dasmtl.analysis.sanitize.divergence import (DivergenceMonitor,
+                                                     state_arrays)
+    from dasmtl.analysis.sanitize.fingerprint import (chain_digest,
+                                                      nonfinite_leaves,
+                                                      tree_digest)
+    from dasmtl.config import Config
+    from dasmtl.main import build_state, replicate_state
+    from dasmtl.models.registry import get_model_spec
+    from dasmtl.parallel.mesh import create_mesh, shard_batch
+    from dasmtl.train.steps import make_train_step
+
+    cfg = Config(model=cell.model, batch_size=cell.batch_size,
+                 compute_dtype=cell.compute_dtype, seed=cell.seed)
+    spec = spec or get_model_spec(cell.model)
+    plan = create_mesh(dp=cell.dp, sp=1) if cell.dp > 1 else None
+    state = replicate_state(build_state(cfg, spec, input_hw=cell.hw), plan)
+    # The replay contract of the sanitizer (and determinism itself) wants
+    # the un-donated step: digests are donation-independent, but a
+    # donated-input read on a buggy backend would not be.
+    step = make_train_step(spec, mesh_plan=plan, donate=False)
+
+    rng = np.random.default_rng(cell.seed)
+    lr = jnp.float32(cfg.lr)
+    chain = cell.name  # genesis link: the cell identity itself
+    last: Dict[str, float] = {}
+    for _ in range(cell.steps):
+        batch = synthetic_batch(rng, cell.batch_size * cell.dp, cell.hw)
+        batch = shard_batch(plan, batch) if plan is not None \
+            else jax.device_put(batch)
+        state, metrics = step(state, batch, lr)
+        last = {k: float(v)
+                for k, v in jax.device_get(metrics).items()}
+        chain = chain_digest(chain, last)
+
+    findings: List[SanitizeFinding] = []
+    arrays = state_arrays(state)
+    if bool(jax.device_get(_nonfinite_probe()(arrays))):
+        findings.append(SanitizeFinding(
+            "SAN202", "error", cell.name,
+            f"non-finite values after {cell.steps} seeded steps in "
+            f"{nonfinite_leaves(arrays)}"))
+    if plan is not None:
+        from dasmtl.analysis.sanitize.divergence import \
+            replica_divergence_report
+
+        monitor = DivergenceMonitor(plan, every=1)
+        drift = replica_divergence_report(monitor, state, cell.name)
+        if drift:
+            findings.append(SanitizeFinding("SAN201", "error", cell.name,
+                                            drift))
+
+    host = jax.device_get({"params": arrays["params"],
+                           "batch_stats": arrays["batch_stats"],
+                           "opt_state": arrays["opt_state"]})
+    report = CellReport(
+        name=cell.name, n_devices=cell.dp,
+        compute_dtype=cell.compute_dtype, steps=cell.steps,
+        digests={
+            "metrics_chain": chain,
+            "params": tree_digest(host["params"]),
+            "batch_stats": tree_digest(host["batch_stats"]),
+            "opt_state": tree_digest(host["opt_state"]),
+        },
+        metrics={
+            "final_loss": last.get("loss_sum", 0.0)
+            / max(last.get("count", 1.0), 1.0),
+            "final_count": last.get("count", 0.0),
+        })
+    return report, findings
+
+
+# -- baseline ----------------------------------------------------------------
+
+def load_baseline(path: str) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def update_baseline(reports: Iterable[CellReport], path: str,
+                    generated_with: Optional[dict] = None) -> dict:
+    """Merge measured fingerprints into the baseline: audited cells are
+    overwritten, other cells kept, hand-edited tolerances preserved —
+    the same contract as the audit baseline."""
+    existing = load_baseline(path) or {}
+    tolerances = dict(DEFAULT_TOLERANCES)
+    tolerances.update(existing.get("tolerances", {}))
+    targets = dict(existing.get("targets", {}))
+    for report in reports:
+        targets[report.name] = report.to_baseline_entry()
+    data = {
+        "version": 1,
+        "comment": ("Determinism fingerprints for dasmtl-sanitize "
+                    "--check-baseline; see docs/STATIC_ANALYSIS.md for the "
+                    "update workflow."),
+        "generated_with": generated_with
+        or existing.get("generated_with", {}),
+        "tolerances": tolerances,
+        "targets": {k: targets[k] for k in sorted(targets)},
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return data
+
+
+def versions_match(baseline: Optional[dict], current: dict) -> bool:
+    """Digest comparisons are only meaningful against the same jax/jaxlib
+    (XLA may legitimately reschedule float reductions across releases)."""
+    if baseline is None:
+        return False
+    gen = baseline.get("generated_with", {})
+    return all(gen.get(k) == v for k, v in current.items())
+
+
+def check_reports(reports: Iterable[CellReport], baseline: Optional[dict],
+                  baseline_path: str = DEFAULT_BASELINE_PATH,
+                  compare_digests: bool = True) -> List[SanitizeFinding]:
+    findings: List[SanitizeFinding] = []
+    if baseline is None:
+        return [SanitizeFinding(
+            "SAN203", "error", "<baseline>",
+            f"no determinism baseline at {baseline_path!r} — generate one "
+            f"with dasmtl-sanitize --update-baseline --preset full and "
+            f"commit it")]
+    tolerances = dict(DEFAULT_TOLERANCES)
+    tolerances.update(baseline.get("tolerances", {}))
+    targets = baseline.get("targets", {})
+    for report in reports:
+        entry = targets.get(report.name)
+        if entry is None:
+            findings.append(SanitizeFinding(
+                "SAN203", "error", report.name,
+                f"cell has no baseline entry in {baseline_path!r} — run "
+                f"dasmtl-sanitize --update-baseline and commit the diff"))
+            continue
+        if compare_digests:
+            for key, old in sorted(entry.get("digests", {}).items()):
+                new = report.digests.get(key)
+                if new is not None and new != old:
+                    findings.append(SanitizeFinding(
+                        "SAN203", "error", report.name,
+                        f"{key} digest drift: {new[:16]}… vs baseline "
+                        f"{old[:16]}… — the seeded trajectory changed "
+                        f"bit-for-bit; find the nondeterminism (or justify "
+                        f"the change and --update-baseline)"))
+        for key, old in sorted(entry.get("metrics", {}).items()):
+            new = report.metrics.get(key)
+            if new is None:
+                continue
+            tol = tolerances.get(key, 0.0)
+            dev = abs(new - old) / max(abs(old), 1.0)
+            if dev > tol:
+                findings.append(SanitizeFinding(
+                    "SAN203", "error", report.name,
+                    f"{key} {new:.6g} vs baseline {old:.6g} ({dev:.2%} > "
+                    f"{tol:.0%} tolerance)"))
+    return findings

@@ -1,0 +1,141 @@
+"""SAN201 — the replica-divergence detector.
+
+Under data parallelism every device holds a nominally identical copy of
+the parameters, optimizer moments, BatchNorm running statistics and the
+base PRNG key.  Nothing at runtime *verifies* that: a missing gradient
+all-reduce, a desynced per-replica PRNG stream or per-replica BN drift
+silently trains ``dp`` different models whose divergence only shows up —
+if ever — as an accuracy mystery weeks later.  This is the SPMD analog of
+a data race, and the runtime counterpart of the compile-time AUD104 check
+(which can prove an all-reduce *exists*, not that it is *sufficient*).
+
+Mechanism: a ``shard_map`` over the ``dp`` axis computes the per-leaf
+:func:`~dasmtl.analysis.sanitize.fingerprint.leaf_digest` of every state
+leaf **per replica, on device** — each shard hashes its local copy of the
+"replicated" arrays — and returns one ``[dp, L]`` uint32 matrix.  One
+host transfer per check, a few KB, regardless of model size.  Rows are
+then compared host-side; a mismatch raises
+:class:`~dasmtl.analysis.sanitize.common.ReplicaDivergenceError` naming
+exactly which pytree leaves drifted and showing each replica's digest.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from dasmtl.analysis.sanitize.common import ReplicaDivergenceError
+from dasmtl.analysis.sanitize.fingerprint import digest_vector, named_leaves
+
+
+def state_arrays(state: Any) -> Dict[str, Any]:
+    """The array-only view of a TrainState that SAN201 fingerprints: the
+    full pytree that must be replica-identical for data parallelism to be
+    sound.  (``apply_fn``/``tx`` are static and excluded.)"""
+    return {
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+        "rng": state.rng,
+    }
+
+
+class DivergenceMonitor:
+    """Cadenced replica-fingerprint checker for a training loop.
+
+    Inert (``active`` False, every call a no-op) when there is nothing to
+    compare: no mesh, ``dp == 1``, or a spatial axis (``sp > 1`` shards
+    feature maps — no device holds a complete replica to hash).
+    """
+
+    def __init__(self, mesh_plan=None, every: int = 100):
+        if every < 1:
+            raise ValueError("every must be >= 1")
+        self.mesh_plan = mesh_plan
+        self.every = every
+        self.active = (mesh_plan is not None and mesh_plan.dp > 1
+                       and mesh_plan.sp == 1)
+        self.checks = 0
+        self._steps_since = 0
+        self._fp_fn = None  # built lazily: one jitted program per run
+
+    # -- fingerprints --------------------------------------------------------
+    def _build(self):
+        from dasmtl.train.steps import shard_map_compat
+
+        def per_replica(tree):
+            # [1, L] per shard -> [dp, L] global under out_specs P("dp").
+            return digest_vector(tree).reshape(1, -1)
+
+        mapped = shard_map_compat(per_replica, mesh=self.mesh_plan.mesh,
+                                  in_specs=(P(),), out_specs=P("dp"))
+        self._fp_fn = jax.jit(mapped)
+
+    def fingerprints(self, state: Any) -> Tuple[np.ndarray, List[str]]:
+        """``([dp, L] uint32 digests, leaf names)`` — one device round-trip."""
+        if not self.active:
+            raise RuntimeError("DivergenceMonitor is inactive "
+                               "(no dp mesh to compare replicas on)")
+        tree = state_arrays(state)
+        if self._fp_fn is None:
+            self._build()
+        digests = np.asarray(jax.device_get(self._fp_fn(tree)))
+        names = [name for name, _ in named_leaves(tree)]
+        return digests, names
+
+    # -- checking ------------------------------------------------------------
+    def check(self, state: Any, context: str = "") -> None:
+        """Compare all replicas now; raise on any drifted leaf."""
+        if not self.active:
+            return
+        digests, names = self.fingerprints(state)
+        self.checks += 1
+        drifted = [i for i in range(digests.shape[1])
+                   if not (digests[:, i] == digests[0, i]).all()]
+        if not drifted:
+            return
+        lines = []
+        for i in drifted[:12]:
+            per_replica = ", ".join(f"r{r}={digests[r, i]:#010x}"
+                                    for r in range(digests.shape[0]))
+            lines.append(f"  {names[i]}: {per_replica}")
+        more = f"\n  … and {len(drifted) - 12} more" if len(drifted) > 12 \
+            else ""
+        where = f" at {context}" if context else ""
+        raise ReplicaDivergenceError(
+            f"SAN201: {len(drifted)}/{len(names)} state leaves diverge "
+            f"across the {digests.shape[0]} dp replicas{where} — replicas "
+            f"are training different models (missing grad sync, desynced "
+            f"PRNG stream, or per-replica BN drift):\n" + "\n".join(lines)
+            + more)
+
+    def maybe_check(self, state: Any, context: str = "") -> bool:
+        """Cadence wrapper: every ``every``-th call runs :meth:`check`.
+        Returns whether a check ran."""
+        if not self.active:
+            return False
+        self._steps_since += 1
+        if self._steps_since < self.every:
+            return False
+        self._steps_since = 0
+        self.check(state, context=context)
+        return True
+
+    def summary(self) -> Dict[str, Any]:
+        return {"active": self.active, "every": self.every,
+                "checks": self.checks,
+                "dp": self.mesh_plan.dp if self.mesh_plan else 1}
+
+
+def replica_divergence_report(monitor: "DivergenceMonitor", state: Any,
+                              target: str) -> Optional[str]:
+    """Run one check, returning the error message instead of raising —
+    the form the sanitize runner folds into findings."""
+    try:
+        monitor.check(state, context=target)
+    except ReplicaDivergenceError as exc:
+        return str(exc)
+    return None

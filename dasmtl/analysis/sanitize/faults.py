@@ -1,0 +1,146 @@
+"""Seeded fault injection — how the sanitizer suite proves itself.
+
+A sanitizer that has never caught anything is an assertion, not a tool.
+These hooks let tests and ``dasmtl-sanitize --self-test`` plant exactly
+the defects the suite exists for, each caught by its sanitizer:
+
+- ``inject("grad_desync")`` — the per-replica train step factory
+  (:func:`dasmtl.train.steps._make_per_replica_train_step`) skips its
+  gradient ``psum`` while the context is active (read at **factory**
+  time: build the step inside the context), so every replica updates with
+  its local gradients only.  → SAN201.
+- :func:`fork_replica_rng` — rebuilds ``state.rng`` as a "replicated"
+  array whose buffer on one device differs (the exact on-device shape of
+  a desynced PRNG stream).  → SAN201.
+- :func:`poison_param_nan` — writes a NaN into one element of a backbone
+  convolution kernel, so the forward pass poisons mid-network.  → SAN202
+  with checkify blame on the conv primitive.
+
+Test-only by construction: nothing in the production path activates a
+fault, and the injection registry is process-local.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Any, Optional, Set, Tuple
+
+FAULTS = ("grad_desync", "prng_fork", "nan")
+
+_ACTIVE: Set[str] = set()
+
+
+def active(name: str) -> bool:
+    """Is a fault currently injected?  Consulted by the step factories."""
+    return name in _ACTIVE
+
+
+@contextmanager
+def inject(name: str):
+    """Activate one named fault for the duration of the context."""
+    if name not in FAULTS:
+        raise ValueError(f"unknown fault {name!r}; known: {FAULTS}")
+    _ACTIVE.add(name)
+    try:
+        yield
+    finally:
+        _ACTIVE.discard(name)
+
+
+def fork_replica_rng(state: Any, mesh_plan, replica: int = 1) -> Any:
+    """Return ``state`` with its base PRNG key *forked on one replica*: the
+    array still carries the replicated sharding, but the buffer on device
+    ``replica`` holds different bits — indistinguishable, to everything
+    except SAN201, from a real desynced stream."""
+    import jax
+    import numpy as np
+
+    from dasmtl.parallel.mesh import replicated_sharding
+
+    devices = list(mesh_plan.mesh.devices.flat)
+    if not 0 <= replica < len(devices):
+        raise ValueError(f"replica {replica} outside mesh of "
+                         f"{len(devices)} devices")
+    rng_host = np.asarray(jax.device_get(state.rng))
+    forked = rng_host ^ np.uint32(0xDEADBEEF)
+    shards = [jax.device_put(forked if i == replica else rng_host, d)
+              for i, d in enumerate(devices)]
+    arr = jax.make_array_from_single_device_arrays(
+        rng_host.shape, replicated_sharding(mesh_plan), shards)
+    return state.replace(rng=arr)
+
+
+def poison_param_nan(state: Any, match: str = "onv", element: int = 0,
+                     mesh_plan=None) -> Tuple[Any, str]:
+    """Write NaN into one element of the first 4-D param leaf whose path
+    contains ``match`` (a conv kernel — "mid-backbone").  Returns the
+    poisoned state and the leaf name."""
+    import jax
+    import numpy as np
+
+    from dasmtl.analysis.sanitize.fingerprint import _flatten_with_path
+
+    sharding = None
+    if mesh_plan is not None:
+        from dasmtl.parallel.mesh import replicated_sharding
+
+        sharding = replicated_sharding(mesh_plan)
+    leaves, treedef = _flatten_with_path(state.params)
+    poisoned: Optional[str] = None
+    out = []
+    for path, leaf in leaves:
+        name = jax.tree_util.keystr(path)
+        if (poisoned is None and match in name
+                and getattr(leaf, "ndim", 0) == 4):
+            a = np.asarray(jax.device_get(leaf)).copy()
+            a.flat[element % a.size] = np.nan
+            leaf = jax.device_put(a, sharding)
+            poisoned = name
+        out.append(leaf)
+    if poisoned is None:
+        raise ValueError(f"no 4-D param leaf matching {match!r} to poison")
+    params = jax.tree_util.tree_unflatten(treedef, out)
+    return state.replace(params=params), poisoned
+
+
+def selftest_spec():
+    """A miniature MTL-shaped ModelSpec for the fault-injection matrix:
+    conv + BatchNorm + dropout backbone, two heads, the production
+    ``mtl_loss``.  Small enough that even the checkify-instrumented step
+    compiles in under a second, while driving exactly the production
+    factories (``make_train_step`` global and per-replica paths) —
+    the sanitizers are exercised on the real code path, just a small
+    program."""
+    import jax.numpy as jnp
+
+    import flax.linen as nn
+
+    from dasmtl.models.registry import ModelSpec
+    from dasmtl.train import losses
+
+    class _TinyMTL(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.Conv(4, (3, 3), name="conv1")(x)
+            x = nn.BatchNorm(use_running_average=not train, name="bn1",
+                             momentum=0.9)(x)
+            x = nn.relu(x)
+            x = nn.Conv(8, (3, 3), strides=(2, 2), name="conv2")(x)
+            x = nn.relu(x)
+            x = nn.Dropout(0.1, deterministic=not train)(x)
+            x = x.mean(axis=(1, 2))
+            return (nn.Dense(16, name="head_distance")(x),
+                    nn.Dense(2, name="head_event")(x))
+
+    def decode(outputs):
+        return {"distance": jnp.argmax(outputs[0], axis=-1),
+                "event": jnp.argmax(outputs[1], axis=-1)}
+
+    return ModelSpec(
+        name="sanitize_selftest",
+        build=lambda cfg: _TinyMTL(),
+        loss_fn=losses.mtl_loss,
+        report_tasks=(("distance", 16), ("event", 2)),
+        decode=decode,
+        uses_dropout=True,
+    )

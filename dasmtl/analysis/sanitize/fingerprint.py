@@ -1,0 +1,140 @@
+"""Pytree fingerprints — the hashing layer under all three sanitizers.
+
+Two kinds of digest, for two different questions:
+
+- :func:`leaf_digest` — a cheap **on-device** uint32 hash (bitcast to
+  integer words, position-weighted wraparound sum).  Computed inside the
+  same XLA program that inspects the data, so comparing replicas costs one
+  scalar per leaf per replica and ONE host transfer total — never a
+  per-replica pull of the full state (SAN201).
+- :func:`host_digest` / :func:`tree_digest` — SHA-256 over the raw bytes
+  of (already fetched) host arrays, keyed by leaf path.  Collision-proof
+  and stable across processes, so it is what the determinism baseline
+  commits (SAN203).
+
+Both are order- and bit-exact: a single flipped mantissa bit anywhere in
+the tree changes the digest.  That is the point — the sanitizers verify
+*bitwise* reproducibility; tolerance-based comparisons live in the
+baseline's float metrics instead.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# jax 0.4.x keeps flatten_with_path in tree_util (jax.tree.flatten_with_path
+# arrived later) — same compat note as models/torch_port.
+_flatten_with_path = jax.tree_util.tree_flatten_with_path
+
+
+def named_leaves(tree: Any) -> List[Tuple[str, Any]]:
+    """``[(path, leaf), ...]`` in canonical flatten order, with readable
+    slash-free paths like ``params['conv1']['kernel']``."""
+    leaves, _ = _flatten_with_path(tree)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in leaves]
+
+
+def _as_uint32_words(x: jax.Array) -> jax.Array:
+    """Reinterpret any array's bits as a flat uint32 vector (jittable)."""
+    x = jnp.asarray(x)
+    if x.dtype == jnp.bool_:
+        u = x.astype(jnp.uint32)
+    elif jnp.issubdtype(x.dtype, jnp.integer):
+        # Wraparound cast keeps all low 32 bits; sanitizer-grade hashing
+        # does not need the (x64-disabled) high words.
+        u = x.astype(jnp.uint32)
+    else:
+        nbits = x.dtype.itemsize * 8
+        if nbits == 16:
+            u = jax.lax.bitcast_convert_type(x, jnp.uint16).astype(jnp.uint32)
+        else:
+            if nbits != 32:  # f64 cannot occur without x64; stay defensive
+                x = x.astype(jnp.float32)
+            u = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    return u.reshape(-1)
+
+
+def leaf_digest(x: jax.Array) -> jax.Array:
+    """Order-sensitive uint32 digest of one array, computed on device.
+
+    ``sum(words[i] * (i * 2654435761 + 0x9E3779B9)) mod 2**32`` — the
+    Knuth/golden-ratio multipliers make position matter (a permutation of
+    values changes the digest), and unsigned wraparound is defined XLA
+    arithmetic.  Cheap enough to run over the full train state every few
+    hundred steps."""
+    u = _as_uint32_words(x)
+    idx = jnp.arange(u.shape[0], dtype=jnp.uint32)
+    weights = idx * jnp.uint32(2654435761) + jnp.uint32(0x9E3779B9)
+    return jnp.sum(u * weights, dtype=jnp.uint32)
+
+
+def digest_vector(tree: Any) -> jax.Array:
+    """``[L]`` uint32 vector of per-leaf digests in canonical flatten order
+    (jittable; leaf names come from :func:`named_leaves` host-side)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.stack([leaf_digest(leaf) for leaf in leaves])
+
+
+def nonfinite_any(tree: Any) -> jax.Array:
+    """Scalar bool: does ANY float leaf contain a NaN/Inf?  One fused
+    reduction per leaf, jittable — the per-step cheap probe of SAN202."""
+    flags = [jnp.any(~jnp.isfinite(leaf))
+             for leaf in jax.tree_util.tree_leaves(tree)
+             if hasattr(leaf, "dtype")
+             and jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)]
+    if not flags:
+        return jnp.zeros((), jnp.bool_)
+    return jnp.stack(flags).any()
+
+
+def nonfinite_leaves(tree: Any) -> List[str]:
+    """Names of float leaves holding NaN/Inf — the blame pass after
+    :func:`nonfinite_any` trips.  Eager (one small transfer per float
+    leaf); only ever called on the failure path."""
+    bad = []
+    for name, leaf in named_leaves(tree):
+        if not (hasattr(leaf, "dtype")
+                and jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)):
+            continue
+        if not np.isfinite(np.asarray(jax.device_get(leaf),
+                                      dtype=np.float64)).all():
+            bad.append(name)
+    return bad
+
+
+def host_digest(array: np.ndarray) -> str:
+    """SHA-256 hex of one host array's raw bytes (C order)."""
+    a = np.ascontiguousarray(np.asarray(array))
+    h = hashlib.sha256()
+    h.update(str(a.dtype).encode())
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def tree_digest(tree: Any) -> str:
+    """SHA-256 hex over every leaf of an (already host-side) pytree, keyed
+    by leaf path so a tree restructure cannot silently collide."""
+    h = hashlib.sha256()
+    for name, leaf in named_leaves(tree):
+        h.update(name.encode())
+        a = np.ascontiguousarray(np.asarray(leaf))
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def chain_digest(prev_hex: str, record: Dict[str, float]) -> str:
+    """One link of the SAN203 hash chain: fold a step's scalar metric
+    record (sorted keys, f64 bytes) into the running digest."""
+    h = hashlib.sha256()
+    h.update(prev_hex.encode())
+    for key in sorted(record):
+        h.update(key.encode())
+        h.update(np.float64(record[key]).tobytes())
+    return h.hexdigest()

@@ -1,0 +1,278 @@
+"""Orchestration + CLI for the runtime sanitizer suite (``dasmtl-sanitize``).
+
+Three verbs:
+
+- **matrix run** (default): execute the seeded determinism cells of a
+  preset through the production step factories, report fingerprints and
+  any clean-run SAN201/SAN202 findings, optionally gate against /
+  regenerate the committed baseline (SAN203).
+- ``--self-test``: the fault-injection matrix — plant each defect the
+  suite exists for (disabled grad sync, forked replica PRNG, NaN
+  mid-backbone) on a miniature spec and verify the matching sanitizer
+  catches it.  A sanitizer that misses its fault fails the run.
+- ``--list-cells``: print the matrix and presets.
+
+Backend handling mirrors the audit CLI: the CPU backend and a virtual
+multi-device host are pinned *before* jax initializes (collective cells
+need ``dp`` devices; this container's TPU-tunnel plugin must never be
+touched by an analysis tool), and donation is disabled for the process —
+the sanitizer re-reads step inputs for checkify replays, which donated
+buffers would forbid.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import List, Optional, Sequence, Tuple
+
+from dasmtl.analysis.sanitize.common import (ReplicaDivergenceError,
+                                             SanitizeError, SanitizeFinding)
+from dasmtl.analysis.sanitize.determinism import (DEFAULT_BASELINE_PATH,
+                                                  CellReport, check_reports,
+                                                  load_baseline,
+                                                  resolve_cells,
+                                                  update_baseline,
+                                                  versions_match)
+
+
+def _pin_backend(min_devices: int) -> None:
+    """CPU + >= ``min_devices`` virtual devices, donation off (checkify
+    replays re-read step inputs).  Reuses the audit's pinning — including
+    its compile-cache disable, which for an *executing* tool is equally
+    load-bearing: on this jaxlib a donating executable deserialized from
+    the persistent cache writes into freed buffers."""
+    os.environ["DASMTL_DISABLE_DONATION"] = "1"
+    from dasmtl.analysis.audit.runner import _pin_cpu_backend
+
+    _pin_cpu_backend(min_devices)
+
+
+def run_cells(cells) -> Tuple[List[CellReport], List[SanitizeFinding]]:
+    from dasmtl.analysis.sanitize.determinism import run_cell
+
+    reports: List[CellReport] = []
+    findings: List[SanitizeFinding] = []
+    for cell in cells:
+        report, found = run_cell(cell)
+        reports.append(report)
+        findings.extend(found)
+    return reports, findings
+
+
+# -- fault-injection self-test ------------------------------------------------
+
+def self_test(verbose: bool = True) -> List[SanitizeFinding]:
+    """Prove each sanitizer catches its fault.  Returns findings for every
+    fault that went UNCAUGHT (empty = the suite works)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dasmtl.analysis.sanitize import faults
+    from dasmtl.analysis.sanitize.checks import observe_error
+    from dasmtl.analysis.sanitize.determinism import synthetic_batch
+    from dasmtl.analysis.sanitize.divergence import DivergenceMonitor
+    from dasmtl.config import Config
+    from dasmtl.main import build_state, replicate_state
+    from dasmtl.parallel.mesh import create_mesh, shard_batch
+    from dasmtl.train.steps import make_train_step
+
+    import numpy as np
+
+    hw, per_dev = (24, 32), 8
+    spec = faults.selftest_spec()
+    cfg = Config(model="MTL", batch_size=per_dev)
+    findings: List[SanitizeFinding] = []
+
+    def note(msg: str) -> None:
+        if verbose:
+            print(f"[self-test] {msg}")
+
+    def batch_for(rng, plan=None):
+        n = per_dev * (plan.dp if plan else 1)
+        b = synthetic_batch(rng, n, hw)
+        return shard_batch(plan, b) if plan else jax.device_put(b)
+
+    lr = jnp.float32(1e-2)
+
+    # 1. SAN202: NaN injected mid-backbone, caught and blamed by checkify.
+    state = build_state(cfg, spec, input_hw=hw)
+    step = make_train_step(spec, checkify_errors=True)
+    rng = np.random.default_rng(0)
+    err, _ = step(state, batch_for(rng), lr)
+    if err.get() is not None:
+        findings.append(SanitizeFinding(
+            "SAN202", "error", "self-test/nan",
+            f"clean run tripped checkify: {err.get()}"))
+    bad_state, leaf = faults.poison_param_nan(state)
+    err, _ = step(bad_state, batch_for(rng), lr)
+    try:
+        observe_error(err, context=f"self-test step (poisoned {leaf})")
+        findings.append(SanitizeFinding(
+            "SAN202", "error", "self-test/nan",
+            f"NaN injected into {leaf} was NOT caught by the checkified "
+            f"step"))
+    except SanitizeError as exc:
+        note(f"SAN202 caught injected NaN: {str(exc).splitlines()[0]}")
+
+    # The dp faults need a mesh.
+    if len(jax.devices()) < 2:
+        findings.append(SanitizeFinding(
+            "SAN201", "error", "self-test/dp",
+            "needs >= 2 devices for the divergence faults — set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=2 (the CLI does)"))
+        return findings
+    plan = create_mesh(dp=2, sp=1)
+    monitor = DivergenceMonitor(plan, every=1)
+
+    # 2. SAN201: gradient sync disabled in the per-replica step factory.
+    state = replicate_state(build_state(cfg, spec, input_hw=hw), plan)
+    monitor.check(state, context="self-test pre-fault")  # clean baseline
+    with faults.inject("grad_desync"):
+        desync_step = make_train_step(spec, mesh_plan=plan,
+                                      bn_sync="per_replica")
+    rng = np.random.default_rng(1)
+    for _ in range(2):
+        state, _ = desync_step(state, batch_for(rng, plan), lr)
+    try:
+        monitor.check(state, context="self-test grad_desync")
+        findings.append(SanitizeFinding(
+            "SAN201", "error", "self-test/grad_desync",
+            "disabled gradient sync was NOT caught by the divergence "
+            "fingerprints"))
+    except ReplicaDivergenceError as exc:
+        note(f"SAN201 caught disabled grad sync: "
+             f"{str(exc).splitlines()[0]}")
+
+    # 3. SAN201: one replica's PRNG stream forked.
+    state = replicate_state(build_state(cfg, spec, input_hw=hw), plan)
+    forked = faults.fork_replica_rng(state, plan)
+    try:
+        monitor.check(forked, context="self-test prng_fork")
+        findings.append(SanitizeFinding(
+            "SAN201", "error", "self-test/prng_fork",
+            "forked replica PRNG stream was NOT caught by the divergence "
+            "fingerprints"))
+    except ReplicaDivergenceError as exc:
+        note(f"SAN201 caught forked PRNG stream: "
+             f"{str(exc).splitlines()[0]}")
+
+    return findings
+
+
+def summary_line(reports: Sequence[CellReport],
+                 findings: Sequence[SanitizeFinding]) -> str:
+    n_err = sum(1 for f in findings if f.severity == "error")
+    n_warn = len(findings) - n_err
+    status = "clean" if not findings else (f"{n_err} error(s), "
+                                           f"{n_warn} warning(s)")
+    return f"sanitize: {len(reports)} cell(s) run, {status}"
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dasmtl-sanitize",
+        description="Runtime SPMD sanitizer suite: replica-divergence "
+                    "fingerprints, checkify NaN/Inf blame, and determinism "
+                    "hash chains against a committed baseline "
+                    "(docs/STATIC_ANALYSIS.md)")
+    ap.add_argument("--preset", choices=sorted(k for k in ("quick", "ci",
+                                                           "full")),
+                    default="ci",
+                    help="cell subset (default: ci; full = whole matrix, "
+                         "use for --update-baseline)")
+    ap.add_argument("--cells", type=str, default=None,
+                    help="comma-separated cell names (overrides --preset; "
+                         "see --list-cells)")
+    ap.add_argument("--check-baseline", action="store_true",
+                    help="compare fingerprints against the committed "
+                         "baseline and fail on drift")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline entries for the run cells "
+                         "(tolerances and other cells are preserved)")
+    ap.add_argument("--baseline", type=str, default=DEFAULT_BASELINE_PATH)
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the fault-injection matrix instead of the "
+                         "determinism cells: each planted fault must be "
+                         "caught by its sanitizer")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-cells", action="store_true",
+                    help="print the cell matrix and presets, then exit")
+    args = ap.parse_args(argv)
+
+    if args.list_cells:
+        from dasmtl.analysis.sanitize.determinism import PRESETS, full_matrix
+
+        for c in full_matrix():
+            print(c.name)
+        for name, cells in sorted(PRESETS.items()):
+            print(f"preset {name}: {', '.join(c.name for c in cells)}")
+        return 0
+
+    if args.self_test:
+        _pin_backend(2)
+        findings = self_test(verbose=args.format == "text")
+        if args.format == "json":
+            print(json.dumps(
+                {"findings": [dataclasses.asdict(f) for f in findings]}))
+        else:
+            for f in findings:
+                print(f.render())
+            print("self-test: "
+                  + ("all injected faults caught" if not findings
+                     else f"{len(findings)} fault(s) NOT caught"),
+                  file=sys.stderr)
+        return 1 if findings else 0
+
+    try:
+        cells = resolve_cells(args.preset, args.cells)
+    except ValueError as exc:
+        ap.error(str(exc))
+    _pin_backend(max(c.n_devices for c in cells))
+
+    reports, findings = run_cells(cells)
+    if args.update_baseline:
+        from dasmtl.analysis.audit.runner import _generated_with
+
+        update_baseline(reports, args.baseline,
+                        generated_with=_generated_with())
+        print(f"baseline written: {args.baseline} "
+              f"({len(reports)} cell(s))", file=sys.stderr)
+    elif args.check_baseline:
+        from dasmtl.analysis.audit.runner import _generated_with
+
+        baseline = load_baseline(args.baseline)
+        same = versions_match(baseline, _generated_with())
+        if baseline is not None and not same:
+            print("sanitize: baseline generated under "
+                  f"{baseline.get('generated_with')} but running "
+                  f"{_generated_with()} — exact-digest checks skipped "
+                  "(float metrics still gate); --update-baseline after "
+                  "justifying the version bump", file=sys.stderr)
+        findings = list(findings) + check_reports(
+            reports, baseline, baseline_path=args.baseline,
+            compare_digests=same)
+
+    if args.format == "json":
+        print(json.dumps({
+            "reports": [dataclasses.asdict(r) for r in reports],
+            "findings": [dataclasses.asdict(f) for f in findings],
+        }, default=str))
+    else:
+        for report in reports:
+            print(f"{report.name}: devices={report.n_devices} "
+                  f"dtype={report.compute_dtype} steps={report.steps} "
+                  f"chain={report.digests['metrics_chain'][:16]}… "
+                  f"params={report.digests['params'][:16]}… "
+                  f"final_loss={report.metrics['final_loss']:.6g}")
+        for f in findings:
+            print(f.render())
+        print(summary_line(reports, findings), file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,6 +63,18 @@ def audit_main(argv=None) -> int:
     return main(argv)
 
 
+def sanitize_main(argv=None) -> int:
+    """``dasmtl-sanitize`` — the runtime SPMD sanitizer suite
+    (dasmtl/analysis/sanitize/; SAN rules in docs/STATIC_ANALYSIS.md).
+    Executes seeded short runs on a CPU backend it pins itself (plus the
+    fault-injection self-test), so it is safe on hosts whose accelerator
+    plugin must not be touched."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from dasmtl.analysis.sanitize.runner import main
+
+    return main(argv)
+
+
 def doctor_main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     from dasmtl.utils.doctor import main
@@ -89,6 +101,8 @@ _SUBCOMMANDS = {
     "doctor": (doctor_main, "environment diagnostics (dasmtl-doctor)"),
     "lint": (lint_main, "JAX-aware AST linter (dasmtl-lint)"),
     "audit": (audit_main, "compile-time HLO/cost auditor (dasmtl-audit)"),
+    "sanitize": (sanitize_main,
+                 "runtime SPMD sanitizer suite (dasmtl-sanitize)"),
 }
 
 

@@ -129,6 +129,15 @@ class Config:
     guard_transfer: str = "disallow"  # off | log | disallow
     guard_nan_check: bool = False  # jax_debug_nans while guarded
 
+    # ---- runtime sanitizers (dasmtl/analysis/sanitize/) ----
+    # Per-step non-finite probe with checkify replay for op-level blame
+    # (SAN202) plus replica-divergence fingerprints every
+    # `sanitize_every` steps under a dp mesh (SAN201).  Keeps the
+    # per-step host pipeline (no fused device-data scan) and disables
+    # step-input donation so failing steps can be replayed.
+    sanitize: bool = False
+    sanitize_every: int = 100  # replica-fingerprint cadence (steps)
+
     # ---- misc ----
     seed: int = 1
     log_every_steps: int = 100  # metric-line cadence (reference utils.py:376)
@@ -153,6 +162,8 @@ class Config:
         if self.guard_transfer not in ("off", "log", "disallow"):
             raise ValueError(
                 f"unknown guard_transfer {self.guard_transfer!r}")
+        if self.sanitize_every < 1:
+            raise ValueError("sanitize_every must be >= 1")
         if self.cv_parallel and self.fold_index is not None:
             raise ValueError("cv_parallel trains every fold at once; "
                              "--fold_index selects a single fold — pick one")
@@ -199,11 +210,30 @@ class Config:
         return cls(**{k: v for k, v in data.items() if k in known})
 
 
+#: The valued-boolean vocabulary of the compat flags.  Closed sets on BOTH
+#: sides: an unrecognized value is a parse error, never a silent False —
+#: the old "anything not in the truthy set is falsy" rule meant a typo'd
+#: ``--dataset_ram on`` quietly disabled the flag.
+_TRUTHY = frozenset({"1", "true", "yes", "y", "t", "on"})
+_FALSY = frozenset({"0", "false", "no", "n", "f", "off"})
+
+
+def _parse_bool_value(raw: str) -> Optional[bool]:
+    """True/False for a recognized spelling, None for anything else."""
+    v = str(raw).strip().lower()
+    if v in _TRUTHY:
+        return True
+    if v in _FALSY:
+        return False
+    return None
+
+
 class _CompatBoolAction(argparse.Action):
     """``--flag`` / ``--no-flag`` / ``--flag False`` — BooleanOptionalAction
     plus the reference's valued form (reference train.py:18 ``type=bool``,
     whose only way to disable was ``--dataset_ram False`` — which that trap
-    actually parsed as True; here the value parses properly)."""
+    actually parsed as True; here the value parses properly, and a value
+    outside the known truthy/falsy spellings is a hard parse error)."""
 
     def __init__(self, option_strings, dest, default=None, help=None,  # noqa: A002
                  **kwargs):
@@ -219,8 +249,12 @@ class _CompatBoolAction(argparse.Action):
         elif values is None:
             value = True
         else:
-            value = str(values).strip().lower() in ("1", "true", "yes",
-                                                    "y", "t")
+            value = _parse_bool_value(values)
+            if value is None:
+                parser.error(
+                    f"argument {option_string}: invalid boolean "
+                    f"{values!r} (expected one of "
+                    f"{sorted(_TRUTHY)} / {sorted(_FALSY)})")
         setattr(namespace, self.dest, value)
 
 
@@ -341,6 +375,14 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--guard_nan_check", action=argparse.BooleanOptionalAction,
                    default=d.guard_nan_check,
                    help="enable jax_debug_nans while the guards are active")
+    p.add_argument("--sanitize", action=argparse.BooleanOptionalAction,
+                   default=d.sanitize,
+                   help="arm the runtime sanitizers: per-step NaN/Inf probe "
+                        "with checkify blame + replica-divergence "
+                        "fingerprints under dp (docs/STATIC_ANALYSIS.md)")
+    p.add_argument("--sanitize_every", type=int, default=d.sanitize_every,
+                   help="steps between replica-divergence fingerprint "
+                        "checks")
 
 
 def _resolve_compat(ns: argparse.Namespace) -> dict:
@@ -354,15 +396,21 @@ def _resolve_compat(ns: argparse.Namespace) -> dict:
     # An explicit --device (any value, incl. "auto") beats the alias: the
     # parser's sentinel default None means "--device was not given".
     if gpu is not None and kw["device"] is None:
-        wanted = "auto" if gpu.strip().lower() in (
-            "1", "true", "yes", "y", "t") else "cpu"
+        parsed = _parse_bool_value(gpu)
+        if parsed is None:
+            print(f"--GPU_device: invalid boolean {gpu!r} (expected one of "
+                  f"{sorted(_TRUTHY)} / {sorted(_FALSY)})", file=sys.stderr)
+            raise SystemExit(2)
+        wanted = "auto" if parsed else "cpu"
         print(f"--GPU_device is deprecated (reference alias): mapping "
               f"{gpu!r} -> --device {wanted}; note the reference's "
               f"type=bool treated every string as True — here "
-              f"{gpu!r} parses as {wanted != 'cpu'}", file=sys.stderr)
+              f"{gpu!r} parses as {parsed}", file=sys.stderr)
         kw["device"] = wanted
     if kw["device"] is None:
-        kw["device"] = "auto"  # the Config field default
+        # The Config field default, taken FROM the dataclass so the two
+        # defaults cannot silently diverge.
+        kw["device"] = Config.__dataclass_fields__["device"].default
     return kw
 
 

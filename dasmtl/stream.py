@@ -56,7 +56,7 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
                    process_index: int = 0, process_count: int = 1,
                    resident: str = "auto",
                    exported_path: Optional[str] = None,
-                   dp: int = 1) -> list:
+                   dp: int = 1, sanitize: bool = False) -> list:
     """Run the restored ``model`` over every window of ``record``.
 
     Returns the prediction rows (and writes ``out_csv`` when given).  Library
@@ -85,6 +85,14 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
     shape dictates the window.  The artifact's computation is fixed at
     export time, so the in-graph slicing path is unavailable
     (``resident="on"`` is rejected; host windowing is used).
+
+    ``sanitize`` arms the serving-path SAN202 probe: every batch's raw
+    model outputs get a fused on-device finite check (the decoded argmax
+    of NaN logits would otherwise be a confidently wrong *integer* —
+    invisible downstream), and a trip raises
+    :class:`~dasmtl.analysis.sanitize.common.NonFiniteError` naming the
+    affected windows.  On the exported path the check runs host-side over
+    the artifact's ``log_probs_*`` heads.
     """
     import jax
 
@@ -143,6 +151,18 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
 
         def forward_artifact(x):
             out = artifact_call(x)
+            if sanitize:
+                bad = [k for k in sorted(out) if k.startswith("log_probs_")
+                       and not np.isfinite(
+                           np.asarray(jax.device_get(out[k]))).all()]
+                if bad:
+                    from dasmtl.analysis.sanitize.common import \
+                        NonFiniteError
+
+                    raise NonFiniteError(
+                        f"SAN202: non-finite artifact outputs in {bad} — "
+                        f"the exported weights or the input record are "
+                        f"poisoned")
             return {k: v for k, v in out.items()
                     if not k.startswith("log_probs_")}
 
@@ -186,6 +206,31 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
         resident == "on"
         or (resident == "auto" and jax.default_backend() != "cpu"))
 
+    def decode_checked(outputs):
+        """Decode inside the jitted forward; under ``sanitize`` also emit
+        the fused non-finite flag over the raw float outputs."""
+        preds = spec.decode(outputs)
+        if not sanitize:
+            return preds
+        from dasmtl.analysis.sanitize.fingerprint import nonfinite_any
+
+        return preds, nonfinite_any(outputs)
+
+    def unpack_checked(out, batch):
+        if not sanitize:
+            return out
+        preds, flag = out
+        if bool(jax.device_get(flag)):
+            from dasmtl.analysis.sanitize.common import NonFiniteError
+
+            idx = [int(i) for i in batch["index"] if int(i) >= 0]
+            raise NonFiniteError(
+                f"SAN202: non-finite model outputs while streaming "
+                f"windows {idx[:8]}{'…' if len(idx) > 8 else ''} — "
+                f"poisoned weights or input record; the decoded argmax "
+                f"would have been silently wrong")
+        return preds
+
     if use_resident:
         # The record is a jit ARGUMENT (not a closed-over constant): the
         # compiled program keys on shape/dtype, so streaming many same-shape
@@ -198,7 +243,8 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
             def slice_one(o):
                 return jax.lax.dynamic_slice(rec, (o[0], o[1]), (h, w))
             xs = jax.vmap(slice_one)(origin)[..., None]
-            return spec.decode(state.apply_fn(variables, xs, train=False))
+            return decode_checked(state.apply_fn(variables, xs,
+                                                 train=False))
 
         record_dev = jax.device_put(
             np.asarray(record, np.float32),
@@ -212,11 +258,12 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
             origin = batch["origin"]
             if mesh_plan is not None:
                 origin = jax.device_put(origin, _origin_sharding)
-            return forward_resident(record_dev, origin)
+            return unpack_checked(forward_resident(record_dev, origin),
+                                  batch)
     else:
         @jax.jit
         def forward(x):
-            return spec.decode(state.apply_fn(variables, x, train=False))
+            return decode_checked(state.apply_fn(variables, x, train=False))
 
         batches = window_batches(record, batch_size, plan=plan,
                                  process_index=process_index,
@@ -226,7 +273,7 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
             x = batch["x"]
             if mesh_plan is not None:
                 x = jax.device_put(x, _x_sharding)
-            return forward(x)
+            return unpack_checked(forward(x), batch)
 
     return _emit(spec, plan, batches, run, out_csv,
                  process_index, process_count)
@@ -298,6 +345,12 @@ def main(argv=None) -> int:
                    help="shard each batch's window axis over this many "
                         "devices (single-process multi-chip serving; "
                         "-1 = all visible devices)")
+    p.add_argument("--sanitize", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="finite-check every batch's raw model outputs and "
+                        "fail naming the affected windows (SAN202, "
+                        "docs/STATIC_ANALYSIS.md) instead of silently "
+                        "emitting the argmax of NaN logits")
     args = p.parse_args(argv)
     if bool(args.model_path) == bool(args.exported):
         p.error("exactly one of --model_path / --exported is required")
@@ -331,7 +384,7 @@ def main(argv=None) -> int:
         np.asarray(record), args.model_path, model=args.model,
         batch_size=args.batch_size, stride=stride, out_csv=out_csv,
         process_index=pi, process_count=pc, resident=args.resident,
-        exported_path=args.exported, dp=args.dp)
+        exported_path=args.exported, dp=args.dp, sanitize=args.sanitize)
     print(f"streamed {len(rows)} windows from {record.shape} record "
           f"-> {shard_csv_path(out_csv, pi, pc)}")
     return 0

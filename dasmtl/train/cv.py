@@ -299,6 +299,14 @@ class CVTrainer:
         self._log_jsonl({"kind": "cv_train", "epoch": epoch,
                          "loss": [float(l) for l in mean_loss],
                          "examples_per_s": examples / max(elapsed, 1e-9)})
+        if self.cfg.sanitize:
+            # The fused scan-over-vmap dispatch cannot thread per-step
+            # checkify errors out, so CV sanitizing runs the epoch-cadence
+            # finite probe over every fold's state instead
+            # (docs/STATIC_ANALYSIS.md SAN202).
+            from dasmtl.analysis.sanitize.checks import assert_finite_state
+
+            assert_finite_state(self.states, context=f"cv epoch {epoch}")
         if not self._preempted:
             self.states = self.states.replace(epoch=self.states.epoch + 1)
 
@@ -375,6 +383,9 @@ class CVTrainer:
         print(f"[cv] {self.n_folds} folds in one computation: "
               f"dataset {self.device_data.nbytes / 2**20:.1f} MiB resident, "
               f"{self.steps_per_epoch} steps/epoch/fold")
+        if cfg.sanitize:
+            print("[sanitize] armed (cv): per-epoch finite probe over all "
+                  "fold states")
         all_reports: List[List[FoldReport]] = []
         start_epoch = int(np.asarray(jax.device_get(self.states.epoch)).max())
         self._preempted = False

@@ -46,6 +46,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from dasmtl.analysis.guards import StepGuards
+from dasmtl.analysis.sanitize.checks import StepSanitizer
+from dasmtl.analysis.sanitize.divergence import DivergenceMonitor
 from dasmtl.config import Config, mixed_label
 from dasmtl.data.device import DeviceDataset, resident_bytes, unwrap_source
 from dasmtl.data.pipeline import BatchIterator, eval_batches, prefetch
@@ -152,8 +154,19 @@ class Trainer:
         self.val_source = val_source
         self.run_dir = run_dir
         self.mesh_plan = mesh_plan
+        # Sanitize mode (docs/STATIC_ANALYSIS.md SAN201/202) keeps the
+        # pre-step state alive for checkify replays, so the step must not
+        # donate its input buffers.
         self.train_step = make_train_step(spec, mesh_plan=mesh_plan,
-                                          bn_sync=cfg.bn_sync)
+                                          bn_sync=cfg.bn_sync,
+                                          donate=not cfg.sanitize)
+        self._sanitizer = (StepSanitizer(spec, mesh_plan=mesh_plan,
+                                         bn_sync=cfg.bn_sync)
+                           if cfg.sanitize else None)
+        # Inert (every call a no-op) without a dp mesh to compare on.
+        self._divergence = (DivergenceMonitor(mesh_plan,
+                                              every=cfg.sanitize_every)
+                            if cfg.sanitize else None)
         # A caller evaluating the same spec repeatedly (e.g. the SNR
         # robustness sweep) passes one jitted eval step so XLA compiles the
         # identical computation once, not per Trainer.  An external step also
@@ -379,6 +392,11 @@ class Trainer:
         if cfg.bn_sync != "global":
             return declined("bn_sync=per_replica keeps the shard_map host "
                             "pipeline")
+        if cfg.sanitize:
+            # The sanitizer extracts per-step errors and replays failing
+            # steps — both need the per-step dispatch, not a fused scan.
+            return declined("sanitize mode keeps the per-step path for "
+                            "checkify error extraction")
         if jax.process_count() > 1:
             # Each process holds only its file shard; a "replicated" HBM copy
             # would be wrong (and device_put can't span non-addressable
@@ -478,9 +496,18 @@ class Trainer:
         last_step = -1
         for i, batch in enumerate(batches):
             last_step = i
+            prev_state = self.state  # alive for the sanitize replay
             with self._step_guard():
                 self.state, step_metrics = self.train_step(
                     self.state, batch, lr_arr)
+            if self._sanitizer is not None:
+                # Outside the guarded region: the probe/fingerprint pulls
+                # are explicit, but they block on the step.
+                where = f"epoch {epoch} step {i}"
+                self._sanitizer.after_step(prev_state, batch, lr_arr,
+                                           self.state, step_metrics,
+                                           context=where)
+                self._divergence.maybe_check(self.state, context=where)
             # Accumulate device scalars without forcing a sync each step.
             for k, v in step_metrics.items():
                 window[k] = window.get(k, 0.0) + v
@@ -551,6 +578,12 @@ class Trainer:
             print(f"[guards] armed: warmup={warmup} steps, "
                   f"transfer={cfg.guard_transfer}, "
                   f"nan_check={cfg.guard_nan_check}")
+        if self._sanitizer is not None:
+            div = self._divergence.summary()
+            print("[sanitize] armed: per-step non-finite probe + checkify "
+                  "replay on failure; replica fingerprints "
+                  + (f"every {div['every']} steps over dp={div['dp']}"
+                     if div["active"] else "inactive (no dp mesh)"))
         # Preemption safety: TPU pods deliver SIGTERM ahead of maintenance /
         # capacity reclaims — stop at the next step boundary and write a full
         # resumable checkpoint instead of losing the run.
@@ -588,6 +621,10 @@ class Trainer:
                         self.ckpt.save(self.state)
             if self.guards is not None:
                 print(f"[guards] clean run: {self.guards.summary()}")
+            if self._sanitizer is not None:
+                print(f"[sanitize] clean run: "
+                      f"{self._sanitizer.summary()} | divergence "
+                      f"{self._divergence.summary()}")
         finally:
             if handler_installed:
                 # A C-installed prior handler reads back as None and can't be

@@ -85,7 +85,8 @@ def _batch_labels(batch: Batch) -> Dict[str, jax.Array]:
 
 
 def make_train_step(spec: ModelSpec, mesh_plan=None,
-                    bn_sync: str = "global"):
+                    bn_sync: str = "global", *, donate: bool = True,
+                    checkify_errors: bool = False):
     """Returns ``train_step(state, batch, lr) -> (state, metrics)``.
 
     Metrics are *sums* (weighted correct counts, weighted loss sums, example
@@ -105,18 +106,34 @@ def make_train_step(spec: ModelSpec, mesh_plan=None,
       per-device batch is the reference's 32.  Gradients are the exact global
       weighted mean (psum of weighted-sum grads / psum of counts); running
       stats are the replica mean.  Requires a mesh with ``sp == 1``.
+
+    ``checkify_errors=True`` threads ``jax.experimental.checkify``
+    (NaN/Inf + div-by-zero; SAN202, docs/STATIC_ANALYSIS.md) through the
+    same step body; the returned callable then has the checkify signature
+    ``(state, batch, lr) -> (error, (state, metrics))``.  Donation is off
+    on that path — the sanitizer re-reads the inputs of a failing step.
+    ``donate=False`` disables donation on the plain step (the sanitized
+    Trainer needs the pre-step state alive for the checkify replay).
     """
     if bn_sync not in ("global", "per_replica"):
         raise ValueError(f"unknown bn_sync {bn_sync!r}")
     if (bn_sync == "per_replica" and mesh_plan is not None
             and mesh_plan.n_devices > 1):
-        return _make_per_replica_train_step(spec, mesh_plan)
+        step_fn = _per_replica_step_fn(spec, mesh_plan)
+    else:
+        def step_fn(state: TrainState, batch: Batch, lr: jax.Array,
+                    ) -> Tuple[TrainState, Dict[str, jax.Array]]:
+            return _step_body(spec, state, batch, lr)
 
-    def train_step(state: TrainState, batch: Batch,
-                   lr: jax.Array) -> Tuple[TrainState, Dict[str, jax.Array]]:
-        return _step_body(spec, state, batch, lr)
+    if checkify_errors:
+        from jax.experimental import checkify
 
-    return jax.jit(train_step, donate_argnums=donate_argnums(0))
+        from dasmtl.analysis.sanitize.checks import step_error_set
+
+        return jax.jit(checkify.checkify(step_fn,
+                                         errors=step_error_set()))
+    d = donate_argnums(0) if donate else ()
+    return jax.jit(step_fn, donate_argnums=d)
 
 
 def _step_body(spec: ModelSpec, state: TrainState, batch: Batch,
@@ -262,14 +279,25 @@ def make_cv_scan_train_step(spec: ModelSpec, mesh_plan=None):
     return jax.jit(mapped, donate_argnums=donate_argnums(0))
 
 
-def _make_per_replica_train_step(spec: ModelSpec, mesh_plan):
-    """The ``bn_sync="per_replica"`` step: shard_map over the ``dp`` axis so
-    BatchNorm sees only the device-local batch shard, with explicit psum
-    collectives for gradients/metrics and pmean for running stats."""
+def _per_replica_step_fn(spec: ModelSpec, mesh_plan):
+    """The ``bn_sync="per_replica"`` step (unjitted): shard_map over the
+    ``dp`` axis so BatchNorm sees only the device-local batch shard, with
+    explicit psum collectives for gradients/metrics and pmean for running
+    stats.
+
+    The gradient/stats sync can be disabled by the sanitize suite's
+    ``faults.inject("grad_desync")`` — read at FACTORY time, test-only —
+    so the SAN201 divergence detector can prove it catches exactly the
+    missing-psum bug this hand-written collective code could one day
+    acquire (the GSPMD path cannot lose its all-reduce without AUD104
+    noticing; this path can)."""
     if mesh_plan.sp != 1:
         raise ValueError(
             "bn_sync=per_replica requires sp=1 — spatially sharded feature "
             "maps have no 'replica' whose batch statistics are complete")
+    from dasmtl.analysis.sanitize import faults
+
+    sync_replicas = not faults.active("grad_desync")
 
     batch_specs = {"x": P("dp"), "distance": P("dp"), "event": P("dp"),
                    "weight": P("dp")}
@@ -297,10 +325,15 @@ def _make_per_replica_train_step(spec: ModelSpec, mesh_plan):
         ((loss_sum, (parts, local_stats, outputs, n_local)),
          grads) = grad_fn(state.params)
         n_global = jnp.maximum(jax.lax.psum(n_local, "dp"), 1.0)
-        grads = jax.tree.map(
-            lambda g: jax.lax.psum(g, "dp") / n_global, grads)
-        new_stats = jax.tree.map(lambda s: jax.lax.pmean(s, "dp"),
-                                 local_stats)
+        if sync_replicas:
+            grads = jax.tree.map(
+                lambda g: jax.lax.psum(g, "dp") / n_global, grads)
+            new_stats = jax.tree.map(lambda s: jax.lax.pmean(s, "dp"),
+                                     local_stats)
+        else:  # fault-injected: local-mean grads, unsynced BN stats
+            grads = jax.tree.map(
+                lambda g: g / jnp.maximum(n_local, 1.0), grads)
+            new_stats = local_stats
         new_state = state.apply_updates(grads, lr).replace(
             batch_stats=new_stats)
 
@@ -316,10 +349,9 @@ def _make_per_replica_train_step(spec: ModelSpec, mesh_plan):
         metrics = {k: jax.lax.psum(v, "dp") for k, v in metrics.items()}
         return new_state, metrics
 
-    mapped = shard_map_compat(local_step, mesh=mesh_plan.mesh,
-                              in_specs=(P(), batch_specs, P()),
-                              out_specs=(P(), P()))
-    return jax.jit(mapped, donate_argnums=donate_argnums(0))
+    return shard_map_compat(local_step, mesh=mesh_plan.mesh,
+                            in_specs=(P(), batch_specs, P()),
+                            out_specs=(P(), P()))
 
 
 def _eval_body(spec: ModelSpec, state: TrainState,

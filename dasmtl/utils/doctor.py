@@ -125,6 +125,11 @@ def collect() -> dict:
             "guard_nan_check": d.guard_nan_check,
         },
         "audit_baseline": _audit_baseline_summary(),
+        "sanitize_defaults": {
+            "sanitize": d.sanitize,
+            "sanitize_every": d.sanitize_every,
+        },
+        "determinism_baseline": _determinism_baseline_summary(),
     }
     return info
 
@@ -134,6 +139,24 @@ def _audit_baseline_summary() -> dict:
     only (reading the JSON; never lowering/compiling anything here)."""
     from dasmtl.analysis.audit.baseline import (DEFAULT_BASELINE_PATH,
                                                 load_baseline)
+
+    path = DEFAULT_BASELINE_PATH
+    try:
+        data = load_baseline(path)
+    except (OSError, ValueError) as exc:
+        return {"path": path, "status": f"unreadable ({exc})"}
+    if data is None:
+        return {"path": path, "status": "missing"}
+    return {"path": path, "status": "ok",
+            "targets": len(data.get("targets", {})),
+            "generated_with": data.get("generated_with", {})}
+
+
+def _determinism_baseline_summary() -> dict:
+    """Status of the sanitizer's committed determinism fingerprints —
+    metadata only, nothing executed."""
+    from dasmtl.analysis.sanitize.determinism import (DEFAULT_BASELINE_PATH,
+                                                      load_baseline)
 
     path = DEFAULT_BASELINE_PATH
     try:
@@ -200,6 +223,21 @@ def main(argv=None) -> int:
         print(f"  audit: baseline {ab.get('status', 'missing')} at "
               f"{ab.get('path')} — generate with dasmtl-audit "
               f"--update-baseline --preset full")
+    print("  sanitize defaults: " + ", ".join(
+        f"{k}={v}" for k, v in ana.get("sanitize_defaults", {}).items()))
+    db = ana.get("determinism_baseline", {})
+    if db.get("status") == "ok":
+        gen = db.get("generated_with", {})
+        gen_s = ", ".join(f"{k} {v}" for k, v in sorted(gen.items()))
+        print(f"  sanitize: determinism baseline ok — {db['targets']} "
+              f"cell(s) in {db['path']}"
+              + (f" (from {gen_s})" if gen_s else "")
+              + "; verify with dasmtl-sanitize --check-baseline")
+    else:
+        print(f"  sanitize: determinism baseline "
+              f"{db.get('status', 'missing')} at {db.get('path')} — "
+              f"generate with dasmtl-sanitize --update-baseline "
+              f"--preset full")
     return 0
 
 

@@ -188,9 +188,16 @@ def main() -> int:
                 # claim (e.g. the relay file was rewritten without its
                 # upstream dying), and SIGKILLing a claimed client wedges
                 # the chip — keep the full grace for it.
-                age, _ = heartbeat_state()
+                age, allow = heartbeat_state()
+                # "Beat-stale" threshold for the short grace, derived from
+                # the phase's own beat budget (its declared allowance, or
+                # the --stale_s fallback) rather than a hard-coded wall
+                # time: an eighth of the budget marks a worker that has
+                # been quiet far longer than a healthy beat gap but well
+                # before the full reap budget (default 480 s -> 60 s).
+                beat_budget = allow or args.stale_s
                 reap("relay restarted — fresh dial to catch its window",
-                     grace=5.0 if age > 60 else None)
+                     grace=5.0 if age > beat_budget / 8.0 else None)
                 break
             age, allow = heartbeat_state()
             budget = allow or args.stale_s

@@ -28,4 +28,15 @@ else
     echo "== dasmtl-audit skipped (DASMTL_LINT_SKIP_AUDIT set)"
 fi
 
+# Runtime sanitizer smoke against the committed determinism baseline.
+# `quick` runs the one dp2-sharded cell (divergence + determinism in a
+# single seeded run); CI's sanitize job runs the wider `ci` preset plus
+# the fault-injection self-test.
+if [ "${DASMTL_LINT_SKIP_SANITIZE:-}" = "" ]; then
+    echo "== dasmtl-sanitize --check-baseline --preset quick"
+    python -m dasmtl.analysis.sanitize --check-baseline --preset quick || rc=1
+else
+    echo "== dasmtl-sanitize skipped (DASMTL_LINT_SKIP_SANITIZE set)"
+fi
+
 exit $rc

@@ -395,6 +395,49 @@ def step(x):
     assert "DAS102" in found and "DAS109" not in found
 
 
+# -- DAS110: assert on traced values ------------------------------------------
+
+_DAS110_POS = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x, weight):
+    assert weight > 0, "positive weight"   # compare on a tracer: no-op
+    assert x                               # truthiness of a tracer: no-op
+    return jnp.sum(x) / weight
+"""
+
+_DAS110_NEG = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x, mask=None):
+    assert x.shape[0] % 4 == 0         # shape access: static, legal
+    assert mask is None or x.ndim == 4  # identity check: static
+    return jnp.sum(x)
+
+def host_validate(batch):
+    assert batch["x"].min() >= 0        # host code asserts freely
+"""
+
+
+def test_das110_flags_assert_on_traced_value():
+    assert "DAS110" in ids(_DAS110_POS)
+    assert len(lines_of(_DAS110_POS, "DAS110")) == 2
+
+
+def test_das110_allows_static_and_host_asserts():
+    assert "DAS110" not in ids(_DAS110_NEG)
+
+
+def test_das110_message_points_at_checkify():
+    findings = [f for f in lint_source(_DAS110_POS, "snippet.py")
+                if f.rule == "DAS110"]
+    assert findings and "checkify" in findings[0].message
+
+
 # -- suppression + framework -------------------------------------------------
 
 def test_noqa_suppresses_named_rule():
@@ -494,7 +537,7 @@ def test_rule_registry_is_stable():
     got = [r.id for r in all_rules()]
     assert got == sorted(got)
     assert {"DAS101", "DAS102", "DAS103", "DAS104", "DAS105", "DAS106",
-            "DAS107", "DAS108", "DAS109"} <= set(got)
+            "DAS107", "DAS108", "DAS109", "DAS110"} <= set(got)
 
 
 def test_package_lints_clean():

@@ -1,0 +1,389 @@
+"""Runtime sanitizer suite (dasmtl/analysis/sanitize/): fingerprint
+primitives, SAN201 replica-divergence detection, SAN202 checkify wiring,
+SAN203 determinism cells + baseline workflow, and the seeded
+fault-injection matrix that proves each sanitizer catches its fault.
+
+Everything runs on the self-test ModelSpec (a miniature conv+BN+dropout
+MTL net) so even the checkify-instrumented step compiles in well under a
+second — the code paths exercised (``make_train_step`` global /
+per-replica / checkified, ``DivergenceMonitor``, ``StepSanitizer``) are
+the production ones."""
+
+import json
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dasmtl.analysis.sanitize import faults
+from dasmtl.analysis.sanitize import fingerprint as fp
+from dasmtl.analysis.sanitize.checks import (StepSanitizer,
+                                             assert_finite_state,
+                                             observe_error)
+from dasmtl.analysis.sanitize.common import (CheckifyFailure, NonFiniteError,
+                                             ReplicaDivergenceError,
+                                             SanitizeError)
+from dasmtl.analysis.sanitize.determinism import (PRESETS, SanitizeCell,
+                                                  check_reports,
+                                                  load_baseline, run_cell,
+                                                  synthetic_batch,
+                                                  update_baseline)
+from dasmtl.analysis.sanitize.divergence import DivergenceMonitor
+from dasmtl.config import Config
+from dasmtl.main import build_state, replicate_state
+from dasmtl.parallel.mesh import create_mesh, shard_batch
+from dasmtl.train.steps import make_train_step
+
+# Matches runner.self_test's geometry so the compiled programs are shared
+# through the suite-level compilation cache.
+HW = (24, 32)
+BATCH = 8
+
+
+@pytest.fixture(scope="module")
+def tiny_spec():
+    return faults.selftest_spec()
+
+
+def _tiny_state(tiny_spec, plan=None):
+    state = build_state(Config(model="MTL", batch_size=BATCH), tiny_spec,
+                        input_hw=HW)
+    return replicate_state(state, plan)
+
+
+def _batch(rng, plan=None):
+    n = BATCH * (plan.dp if plan is not None else 1)
+    b = synthetic_batch(rng, n, HW)
+    return shard_batch(plan, b) if plan is not None else jax.device_put(b)
+
+
+def _dp2_plan():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    return create_mesh(dp=2, sp=1)
+
+
+# -- fingerprint primitives ---------------------------------------------------
+
+def test_leaf_digest_deterministic_and_bit_sensitive():
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(7, 5)),
+                    jnp.float32)
+    d1 = int(fp.leaf_digest(x))
+    d2 = int(fp.leaf_digest(x))
+    assert d1 == d2
+    y = np.asarray(x).copy()
+    y[3, 2] = np.nextafter(y[3, 2], np.inf)  # one-ULP flip
+    assert int(fp.leaf_digest(jnp.asarray(y))) != d1
+
+
+def test_leaf_digest_is_position_sensitive():
+    a = jnp.asarray([1.0, 2.0, 3.0])
+    b = jnp.asarray([3.0, 2.0, 1.0])
+    assert int(fp.leaf_digest(a)) != int(fp.leaf_digest(b))
+
+
+def test_leaf_digest_covers_bf16_int_and_key_dtypes():
+    for arr in (jnp.asarray([1.5, -2.25], jnp.bfloat16),
+                jnp.arange(6, dtype=jnp.int32),
+                jax.random.PRNGKey(7)):
+        d = int(fp.leaf_digest(arr))
+        assert d == int(fp.leaf_digest(arr))
+    assert int(fp.leaf_digest(jnp.asarray([1.5, -2.25], jnp.bfloat16))) != \
+        int(fp.leaf_digest(jnp.asarray([1.5, -2.5], jnp.bfloat16)))
+
+
+def test_tree_and_chain_digests():
+    tree = {"a": np.arange(4, dtype=np.float32), "b": np.ones((2, 2))}
+    d = fp.tree_digest(tree)
+    assert d == fp.tree_digest(tree) and len(d) == 64
+    tree2 = {"a": np.arange(4, dtype=np.float32), "b": np.zeros((2, 2))}
+    assert fp.tree_digest(tree2) != d
+    c1 = fp.chain_digest("genesis", {"loss": 1.0, "count": 8.0})
+    assert c1 == fp.chain_digest("genesis", {"count": 8.0, "loss": 1.0})
+    assert c1 != fp.chain_digest("genesis", {"loss": 1.0 + 1e-12,
+                                             "count": 8.0})
+    assert c1 != fp.chain_digest(c1, {"loss": 1.0, "count": 8.0})
+
+
+def test_nonfinite_probe_and_blame():
+    clean = {"w": jnp.ones((3,)), "n": jnp.arange(3)}
+    assert not bool(fp.nonfinite_any(clean))
+    bad = {"w": jnp.asarray([1.0, np.nan, 2.0]), "n": jnp.arange(3)}
+    assert bool(fp.nonfinite_any(bad))
+    assert fp.nonfinite_leaves(bad) == ["['w']"]
+
+
+# -- fault registry -----------------------------------------------------------
+
+def test_fault_registry_scoping():
+    assert not faults.active("grad_desync")
+    with faults.inject("grad_desync"):
+        assert faults.active("grad_desync")
+    assert not faults.active("grad_desync")
+    with pytest.raises(ValueError, match="unknown fault"):
+        with faults.inject("typo"):
+            pass
+
+
+# -- SAN201: replica divergence ----------------------------------------------
+
+def test_divergence_monitor_inert_without_mesh(tiny_spec):
+    monitor = DivergenceMonitor(None, every=1)
+    assert not monitor.active
+    state = _tiny_state(tiny_spec)
+    monitor.check(state)  # no-op, no raise
+    assert monitor.maybe_check(state) is False
+
+
+def test_divergence_monitor_clean_on_replicated_state(tiny_spec):
+    plan = _dp2_plan()
+    monitor = DivergenceMonitor(plan, every=1)
+    state = _tiny_state(tiny_spec, plan)
+    monitor.check(state)  # replicated copies are identical
+    digests, names = monitor.fingerprints(state)
+    assert digests.shape[0] == 2 and digests.shape[1] == len(names)
+    assert (digests[0] == digests[1]).all()
+
+
+def test_divergence_catches_forked_replica_rng(tiny_spec):
+    plan = _dp2_plan()
+    monitor = DivergenceMonitor(plan, every=1)
+    forked = faults.fork_replica_rng(_tiny_state(tiny_spec, plan), plan)
+    with pytest.raises(ReplicaDivergenceError, match="rng"):
+        monitor.check(forked, context="test")
+
+
+def test_divergence_catches_disabled_grad_sync(tiny_spec):
+    """The per-replica step with its psum fault-disabled really diverges,
+    and SAN201 names drifted param leaves; the unfaulted step stays
+    replica-identical (control)."""
+    plan = _dp2_plan()
+    monitor = DivergenceMonitor(plan, every=1)
+    lr = jnp.float32(1e-2)
+
+    state = _tiny_state(tiny_spec, plan)
+    good_step = make_train_step(tiny_spec, mesh_plan=plan,
+                                bn_sync="per_replica", donate=False)
+    rng = np.random.default_rng(1)
+    for _ in range(2):
+        state, _ = good_step(state, _batch(rng, plan), lr)
+    monitor.check(state, context="control")  # synced: must stay clean
+
+    with faults.inject("grad_desync"):
+        bad_step = make_train_step(tiny_spec, mesh_plan=plan,
+                                   bn_sync="per_replica", donate=False)
+    state = _tiny_state(tiny_spec, plan)
+    rng = np.random.default_rng(1)
+    for _ in range(2):
+        state, _ = bad_step(state, _batch(rng, plan), lr)
+    with pytest.raises(ReplicaDivergenceError,
+                       match="leaves diverge") as exc_info:
+        monitor.check(state, context="desync")
+    # Named-leaf diff: params and BN stats both drifted.
+    assert "bn1" in str(exc_info.value)
+
+
+def test_divergence_cadence(tiny_spec):
+    plan = _dp2_plan()
+    monitor = DivergenceMonitor(plan, every=3)
+    state = _tiny_state(tiny_spec, plan)
+    ran = [monitor.maybe_check(state) for _ in range(7)]
+    assert ran == [False, False, True, False, False, True, False]
+    assert monitor.checks == 2
+
+
+# -- SAN202: checkify wiring --------------------------------------------------
+
+def test_checkified_step_clean_and_metric_parity(tiny_spec):
+    state = _tiny_state(tiny_spec)
+    plain = make_train_step(tiny_spec, donate=False)
+    checked = make_train_step(tiny_spec, checkify_errors=True)
+    rng = np.random.default_rng(2)
+    batch = _batch(rng)
+    lr = jnp.float32(1e-2)
+    _, m_plain = plain(state, batch, lr)
+    err, (_, m_checked) = checked(state, batch, lr)
+    assert err.get() is None
+    m_plain = jax.device_get(m_plain)
+    m_checked = jax.device_get(m_checked)
+    # checkify must not change the step's numerics.
+    for k in m_plain:
+        np.testing.assert_allclose(np.asarray(m_plain[k]),
+                                   np.asarray(m_checked[k]), rtol=1e-6)
+
+
+def test_checkify_blames_injected_nan(tiny_spec):
+    state = _tiny_state(tiny_spec)
+    bad_state, leaf = faults.poison_param_nan(state)
+    assert "conv" in leaf
+    checked = make_train_step(tiny_spec, checkify_errors=True)
+    rng = np.random.default_rng(3)
+    err, _ = checked(bad_state, _batch(rng), jnp.float32(1e-2))
+    with pytest.raises(CheckifyFailure, match="nan"):
+        observe_error(err, context="test step")
+
+
+def test_step_sanitizer_two_tier_flow(tiny_spec):
+    """Clean steps pass the cheap probe; a poisoned step trips it and the
+    checkify replay localizes blame to the conv primitive."""
+    san = StepSanitizer(tiny_spec)
+    state = _tiny_state(tiny_spec)
+    step = make_train_step(tiny_spec, donate=False)
+    rng = np.random.default_rng(4)
+    batch = _batch(rng)
+    lr = jnp.float32(1e-2)
+    new_state, metrics = step(state, batch, lr)
+    san.after_step(state, batch, lr, new_state, metrics, context="clean")
+    assert san.steps_checked == 1 and not san.summary()["replay_compiled"]
+
+    bad_state, _ = faults.poison_param_nan(state)
+    new_state, metrics = step(bad_state, batch, lr)
+    with pytest.raises(SanitizeError, match="nan"):
+        san.after_step(bad_state, batch, lr, new_state, metrics,
+                       context="poisoned")
+    assert san.summary()["replay_compiled"]
+
+
+def test_assert_finite_state(tiny_spec):
+    state = _tiny_state(tiny_spec)
+    assert_finite_state(state, context="clean")
+    bad_state, leaf = faults.poison_param_nan(state)
+    with pytest.raises(NonFiniteError, match="conv"):
+        assert_finite_state(bad_state, context="poisoned")
+
+
+# -- SAN203: determinism cells + baseline -------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_cell_report(tiny_spec):
+    cell = SanitizeCell(model="MTL", dp=1, batch_size=4, steps=2, hw=HW)
+    report, findings = run_cell(cell, spec=tiny_spec)
+    return cell, report, findings
+
+
+def test_run_cell_is_deterministic(tiny_spec, tiny_cell_report):
+    cell, report, findings = tiny_cell_report
+    assert findings == []
+    report2, findings2 = run_cell(cell, spec=tiny_spec)
+    assert findings2 == []
+    assert report2.digests == report.digests
+    assert report2.metrics == report.metrics
+    assert set(report.digests) == {"metrics_chain", "params", "batch_stats",
+                                   "opt_state"}
+
+
+def test_dp2_cell_runs_clean_divergence_check(tiny_spec):
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    cell = SanitizeCell(model="MTL", dp=2, batch_size=4, steps=2, hw=HW)
+    report, findings = run_cell(cell, spec=tiny_spec)
+    assert findings == []  # SAN201 + SAN202 clean on the seeded run
+    assert report.n_devices == 2
+
+
+def test_baseline_roundtrip_and_drift(tmp_path, tiny_cell_report):
+    _, report, _ = tiny_cell_report
+    path = str(tmp_path / "determinism_baseline.json")
+    update_baseline([report], path, generated_with={"jax": "x"})
+    baseline = load_baseline(path)
+    assert check_reports([report], baseline, baseline_path=path) == []
+
+    # Tampered digest -> SAN203 drift finding.
+    baseline["targets"][report.name]["digests"]["params"] = "0" * 64
+    findings = check_reports([report], baseline, baseline_path=path)
+    assert [f.rule for f in findings] == ["SAN203"]
+    assert "digest drift" in findings[0].message
+
+    # Version mismatch: digests skipped, float metrics still gate.
+    findings = check_reports([report], baseline, baseline_path=path,
+                             compare_digests=False)
+    assert findings == []
+    baseline["targets"][report.name]["metrics"]["final_loss"] *= 2
+    findings = check_reports([report], baseline, baseline_path=path,
+                             compare_digests=False)
+    assert [f.rule for f in findings] == ["SAN203"]
+
+    # Missing entry / missing file.
+    assert check_reports([report], {"targets": {}},
+                         baseline_path=path)[0].rule == "SAN203"
+    assert check_reports([report], None,
+                         baseline_path=path)[0].rule == "SAN203"
+
+
+def test_baseline_update_preserves_hand_edits(tmp_path, tiny_cell_report):
+    _, report, _ = tiny_cell_report
+    path = str(tmp_path / "b.json")
+    update_baseline([report], path)
+    data = load_baseline(path)
+    data["tolerances"]["final_loss"] = 0.5
+    data["targets"]["other-cell"] = {"digests": {}, "metrics": {}}
+    with open(path, "w") as f:
+        json.dump(data, f)
+    update_baseline([report], path)
+    merged = load_baseline(path)
+    assert merged["tolerances"]["final_loss"] == 0.5
+    assert "other-cell" in merged["targets"]
+
+
+def test_committed_baseline_covers_ci_preset():
+    """The acceptance gate's data: the committed determinism baseline
+    exists and has an entry for every ci-preset cell (so
+    `dasmtl-sanitize --check-baseline` can pass in CI)."""
+    baseline = load_baseline("artifacts/determinism_baseline.json")
+    assert baseline is not None, "artifacts/determinism_baseline.json missing"
+    targets = baseline.get("targets", {})
+    for cell in PRESETS["ci"]:
+        assert cell.name in targets, f"no baseline entry for {cell.name}"
+        entry = targets[cell.name]
+        assert set(entry["digests"]) >= {"metrics_chain", "params"}
+
+
+# -- the full fault-injection matrix (the CI self-test, in-process) -----------
+
+def test_self_test_catches_every_fault():
+    from dasmtl.analysis.sanitize.runner import self_test
+
+    uncaught = self_test(verbose=False)
+    assert uncaught == [], "\n".join(f.render() for f in uncaught)
+
+
+# -- Trainer integration ------------------------------------------------------
+
+def test_trainer_fit_sanitized_clean(tmp_path, tiny_arrays):
+    from tests.test_train_loop import _mk_trainer
+
+    tr = _mk_trainer(tmp_path, tiny_arrays, epoch_num=1, sanitize=True,
+                     sanitize_every=2)
+    results = tr.fit()
+    assert results and np.isfinite(results[-1].loss)
+    assert tr._sanitizer is not None
+    assert tr._sanitizer.steps_checked > 0
+    # No failure => the checkified replay was never compiled.
+    assert not tr._sanitizer.summary()["replay_compiled"]
+
+
+def test_trainer_sanitize_declines_device_data(tmp_path, tiny_arrays):
+    from tests.test_train_loop import _mk_trainer
+
+    tr = _mk_trainer(tmp_path, tiny_arrays, sanitize=True, device_data="on")
+    assert tr._use_device_data() is False
+
+
+# -- CLI surfaces -------------------------------------------------------------
+
+def test_cli_list_cells():
+    proc = subprocess.run(
+        [sys.executable, "-m", "dasmtl.analysis.sanitize", "--list-cells"],
+        capture_output=True, text=True)
+    assert proc.returncode == 0
+    assert "MTL-f32-dp2" in proc.stdout
+    assert "preset ci:" in proc.stdout
+
+
+def test_umbrella_cli_knows_sanitize():
+    from dasmtl.cli import _SUBCOMMANDS
+
+    assert "sanitize" in _SUBCOMMANDS

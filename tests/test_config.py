@@ -76,3 +76,43 @@ def test_from_json_tolerates_other_versions(capsys):
     restored = Config.from_json(_json.dumps(data))
     assert restored.model == "MTL"
     assert "ignoring unknown fields" in capsys.readouterr().err
+
+
+def test_valued_boolean_rejects_unknown_spellings():
+    """The satellite fix for the silent-flip trap: 'on'/'off' now parse as
+    real booleans, and anything outside the closed truthy/falsy sets is a
+    hard parse error instead of quietly meaning False."""
+    import pytest
+
+    from dasmtl.config import parse_train_args
+
+    assert parse_train_args(["--dataset_ram", "on"]).dataset_ram is True
+    assert parse_train_args(["--dataset_ram", "off"]).dataset_ram is False
+    with pytest.raises(SystemExit):
+        parse_train_args(["--dataset_ram", "banana"])
+    with pytest.raises(SystemExit):
+        parse_train_args(["--GPU_device", "banana"])
+
+
+def test_device_fallback_tracks_config_default():
+    """_resolve_compat's no-flag fallback reads the dataclass default, so
+    the two can never diverge."""
+    import dataclasses
+
+    from dasmtl.config import Config, parse_train_args
+
+    field_default = {f.name: f.default
+                     for f in dataclasses.fields(Config)}["device"]
+    assert parse_train_args([]).device == field_default == Config().device
+
+
+def test_sanitize_flags_parse():
+    from dasmtl.config import Config, parse_train_args
+
+    assert Config().sanitize is False
+    cfg = parse_train_args(["--sanitize", "--sanitize_every", "7"])
+    assert cfg.sanitize is True and cfg.sanitize_every == 7
+    import pytest
+
+    with pytest.raises(ValueError, match="sanitize_every"):
+        Config(sanitize_every=0)

@@ -16,6 +16,25 @@ from dasmtl.train.checkpoint import CheckpointManager
 HW = (52, 64)
 
 
+def assert_rows_close(want, got, rel_tol=1e-6):
+    """Per-window row comparison for dp-vs-single-device parity: decoded
+    integer/string fields (window identity, predictions) must match
+    EXACTLY, float fields (weight) within a small tolerance — real GSPMD
+    hardware may re-associate float reductions, so bitwise equality on
+    floats is a flake, while a changed decoded label is a real bug."""
+    import math
+
+    assert len(want) == len(got), f"{len(want)} vs {len(got)} rows"
+    for a, b in zip(want, got):
+        assert set(a) == set(b), f"row keys differ: {set(a)} vs {set(b)}"
+        for k in a:
+            if isinstance(a[k], float):
+                assert math.isclose(a[k], b[k], rel_tol=rel_tol,
+                                    abs_tol=rel_tol), f"{k}: {a[k]} vs {b[k]}"
+            else:
+                assert a[k] == b[k], f"{k}: {a[k]} vs {b[k]}"
+
+
 def _checkpointed_state(tmp_path):
     cfg = Config(model="MTL", batch_size=4)
     spec = get_model_spec("MTL")
@@ -210,7 +229,10 @@ def test_dp_sharded_stream_matches_single_device(tmp_path):
     want = stream_predict(rec, ckpt, dp=1, resident="off", **kwargs)
     got_host = stream_predict(rec, ckpt, dp=4, resident="off", **kwargs)
     got_res = stream_predict(rec, ckpt, dp=4, resident="on", **kwargs)
-    assert want == got_host == got_res
+    # Decoded predictions exact, float fields under tolerance: bitwise
+    # float equality would make this flaky on real GSPMD hardware.
+    assert_rows_close(want, got_host)
+    assert_rows_close(want, got_res)
     assert len(want) > 4  # several batches, incl. a padded tail batch
 
 
@@ -237,3 +259,30 @@ def test_dp_stream_rejects_indivisible_batch(tmp_path):
     with pytest.raises(ValueError, match="divisible"):
         stream_predict(rec, ckpt, model="MTL", batch_size=3, window=HW,
                        dp=4)
+
+
+def test_stream_sanitize_clean_parity_and_poisoned_catch(tmp_path):
+    """The serving-path SAN202 probe: clean streams are row-identical with
+    the flag armed; poisoned weights raise naming the affected windows
+    instead of silently emitting the argmax of NaN logits."""
+    from dasmtl.analysis.sanitize import faults
+    from dasmtl.analysis.sanitize.common import NonFiniteError
+    from dasmtl.train.checkpoint import CheckpointManager as _Mgr
+
+    ckpt = _checkpointed_state(tmp_path)
+    rec = np.random.default_rng(5).normal(size=(52, 64 * 2 + 5))
+    kwargs = dict(model="MTL", batch_size=4, window=HW)
+    want = stream_predict(rec, ckpt, **kwargs)
+    got = stream_predict(rec, ckpt, sanitize=True, **kwargs)
+    assert_rows_close(want, got)
+
+    cfg = Config(model="MTL", batch_size=4)
+    state = build_state(cfg, get_model_spec("MTL"), input_hw=HW)
+    bad_state, _ = faults.poison_param_nan(state)
+    mgr = _Mgr(str(tmp_path / "bad"))
+    bad_ckpt = mgr.save(bad_state)
+    mgr.wait()
+    # Unsanitized: the sweep "succeeds" with confidently wrong integers.
+    assert stream_predict(rec, bad_ckpt, **kwargs)
+    with pytest.raises(NonFiniteError, match="windows"):
+        stream_predict(rec, bad_ckpt, sanitize=True, **kwargs)
