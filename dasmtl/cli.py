@@ -42,6 +42,17 @@ def stream_main(argv=None) -> int:
     return main(argv)
 
 
+def serve_main(argv=None) -> int:
+    """``dasmtl-serve`` — online inference serving (dasmtl/serve/):
+    dynamic micro-batching over bucketed compiled executables, with
+    backpressure and a drainable loop (docs/SERVING.md)."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    apply_device_flag(argv)
+    from dasmtl.serve.__main__ import main
+
+    return main(argv)
+
+
 def lint_main(argv=None) -> int:
     """``dasmtl-lint`` — the JAX-aware tracing-discipline linter
     (dasmtl/analysis/lint.py; rules in docs/STATIC_ANALYSIS.md).  Pure AST
@@ -98,6 +109,7 @@ _SUBCOMMANDS = {
     "test": (test_main, "evaluate a checkpoint (dasmtl-test)"),
     "stream": (stream_main, "streaming inference (dasmtl-stream)"),
     "export": (export_main, "export a serving artifact (dasmtl-export)"),
+    "serve": (serve_main, "online inference server (dasmtl-serve)"),
     "doctor": (doctor_main, "environment diagnostics (dasmtl-doctor)"),
     "lint": (lint_main, "JAX-aware AST linter (dasmtl-lint)"),
     "audit": (audit_main, "compile-time HLO/cost auditor (dasmtl-audit)"),

@@ -138,6 +138,22 @@ class Config:
     sanitize: bool = False
     sanitize_every: int = 100  # replica-fingerprint cadence (steps)
 
+    # ---- online serving (dasmtl/serve/) ----
+    # Dynamic micro-batching in front of the compiled inference fn:
+    # arriving single-window requests coalesce for at most
+    # `serve_max_wait_ms`, then pad to the smallest `serve_buckets` entry
+    # that fits — a power-of-two ladder, so occupancy stays >= 50% and
+    # every post-warmup batch hits an executable compiled at warmup.
+    # Backpressure: arrivals beyond `serve_watermark` queued requests are
+    # shed with an explicit error response (never queued unboundedly);
+    # `serve_queue_depth` is the hard memory bound.
+    serve_buckets: tuple = (1, 2, 4, 8, 16, 32)
+    serve_max_wait_ms: float = 5.0
+    serve_queue_depth: int = 256
+    serve_watermark: Optional[int] = None  # None = 90% of queue depth
+    serve_host: str = "127.0.0.1"
+    serve_port: int = 8321
+
     # ---- misc ----
     seed: int = 1
     log_every_steps: int = 100  # metric-line cadence (reference utils.py:376)
@@ -167,6 +183,24 @@ class Config:
         if self.cv_parallel and self.fold_index is not None:
             raise ValueError("cv_parallel trains every fold at once; "
                              "--fold_index selects a single fold — pick one")
+        # from_json hands back lists; normalize so equality and downstream
+        # `max(buckets)` arithmetic see one canonical sorted tuple.
+        buckets = tuple(sorted(set(int(b) for b in self.serve_buckets)))
+        if not buckets or buckets[0] < 1:
+            raise ValueError(f"serve_buckets must be a non-empty set of "
+                             f"positive sizes, got {self.serve_buckets!r}")
+        self.serve_buckets = buckets
+        if self.serve_max_wait_ms < 0:
+            raise ValueError("serve_max_wait_ms must be >= 0")
+        if self.serve_queue_depth < buckets[-1]:
+            raise ValueError(
+                f"serve_queue_depth {self.serve_queue_depth} cannot hold "
+                f"one full batch of the largest bucket ({buckets[-1]})")
+        if self.serve_watermark is not None and not (
+                1 <= self.serve_watermark <= self.serve_queue_depth):
+            raise ValueError(
+                f"serve_watermark {self.serve_watermark} outside "
+                f"[1, serve_queue_depth={self.serve_queue_depth}]")
 
     @property
     def decay_at_epoch0(self) -> bool:
@@ -179,6 +213,17 @@ class Config:
         if self.ckpt_acc_gate is not None:
             return self.ckpt_acc_gate
         return 0.95 if self.model == "multi_classifier" else 0.98
+
+    @property
+    def serve_watermark_resolved(self) -> int:
+        """Load-shedding threshold in queued requests: the explicit
+        ``serve_watermark`` when set, else 90% of the queue depth (but
+        never below one full largest-bucket batch, so shedding can't
+        starve the batcher of a complete batch)."""
+        if self.serve_watermark is not None:
+            return self.serve_watermark
+        return max(self.serve_buckets[-1],
+                   int(self.serve_queue_depth * 0.9))
 
     @property
     def num_classes(self) -> tuple:
@@ -256,6 +301,15 @@ class _CompatBoolAction(argparse.Action):
                     f"{values!r} (expected one of "
                     f"{sorted(_TRUTHY)} / {sorted(_FALSY)})")
         setattr(namespace, self.dest, value)
+
+
+def _parse_bucket_list(raw: str) -> tuple:
+    """``"1,2,4,8"`` -> ``(1, 2, 4, 8)`` (Config normalizes/validates)."""
+    try:
+        return tuple(int(b) for b in str(raw).split(",") if b.strip())
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected comma-separated batch sizes, got {raw!r}") from None
 
 
 def _add_shared_args(p: argparse.ArgumentParser) -> None:
@@ -383,6 +437,23 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--sanitize_every", type=int, default=d.sanitize_every,
                    help="steps between replica-divergence fingerprint "
                         "checks")
+    # Online-serving defaults (dasmtl/serve/, docs/SERVING.md).  The serve
+    # CLI (dasmtl-serve) has its own first-class flags; these exist so a
+    # run's config.json carries its serving geometry too.
+    p.add_argument("--serve_buckets", type=_parse_bucket_list,
+                   default=d.serve_buckets, metavar="B1,B2,...",
+                   help="serving batch-shape ladder compiled at warmup")
+    p.add_argument("--serve_max_wait_ms", type=float,
+                   default=d.serve_max_wait_ms,
+                   help="serving micro-batch deadline (ms)")
+    p.add_argument("--serve_queue_depth", type=int,
+                   default=d.serve_queue_depth,
+                   help="serving queue hard bound (requests)")
+    p.add_argument("--serve_watermark", type=int, default=d.serve_watermark,
+                   help="shed arrivals beyond this queue depth "
+                        "(default: 90%% of --serve_queue_depth)")
+    p.add_argument("--serve_host", type=str, default=d.serve_host)
+    p.add_argument("--serve_port", type=int, default=d.serve_port)
 
 
 def _resolve_compat(ns: argparse.Namespace) -> dict:

@@ -88,20 +88,47 @@ def prefetch(iterator: Iterator, depth: int = 2,
         stop.set()
 
 
+#: Padding fill value per batch key.  Anything not listed pads with zeros;
+#: ``weight`` 0.0 marks the row as padding for losses/metrics, ``index`` -1
+#: keeps padded rows from mapping to a real window-grid position.
+_PAD_FILL = {"weight": 0.0, "index": -1}
+
+
+def pad_to_bucket(batch: Batch, bucket: int) -> Batch:
+    """Pad every array's leading axis from ``n`` real rows up to ``bucket``.
+
+    THE padding convention of the whole repo, in one place: the training
+    pipeline's ragged final batch, the streaming sweep's tail batch, and
+    the online micro-batcher (:mod:`dasmtl.serve`) all pad through here, so
+    a padded partial batch is bit-identical in shape/dtype to a full one —
+    one compiled executable per bucket size, no recompiles.  ``weight``
+    pads with 0.0 and ``index`` with -1 (see ``_PAD_FILL``); every other
+    key pads with zeros of its own dtype.
+    """
+    sizes = {k: v.shape[0] for k, v in batch.items()}
+    if len(set(sizes.values())) > 1:
+        raise ValueError(f"ragged leading axes {sizes} — a batch's arrays "
+                         "must agree before padding")
+    n = next(iter(sizes.values()))
+    if n > bucket:
+        raise ValueError(f"{n} rows do not fit bucket size {bucket}")
+    if n == bucket:
+        return batch
+    out = {}
+    for k, v in batch.items():
+        pad = np.full((bucket - n,) + v.shape[1:], _PAD_FILL.get(k, 0),
+                      v.dtype)
+        out[k] = np.concatenate([v, pad], axis=0)
+    return out
+
+
 def _make_batch(source: _SourceBase, idx: np.ndarray, batch_size: int) -> Batch:
     n_real = idx.shape[0]
-    x = source.gather(idx)
-    distance = source.distance[idx]
-    event = source.event[idx]
-    weight = np.ones((n_real,), np.float32)
-    if n_real < batch_size:
-        pad = batch_size - n_real
-        x = np.concatenate(
-            [x, np.zeros((pad,) + x.shape[1:], x.dtype)], axis=0)
-        distance = np.concatenate([distance, np.zeros((pad,), np.int32)])
-        event = np.concatenate([event, np.zeros((pad,), np.int32)])
-        weight = np.concatenate([weight, np.zeros((pad,), np.float32)])
-    return {"x": x, "distance": distance, "event": event, "weight": weight}
+    return pad_to_bucket(
+        {"x": source.gather(idx),
+         "distance": source.distance[idx],
+         "event": source.event[idx],
+         "weight": np.ones((n_real,), np.float32)}, batch_size)
 
 
 class BatchIterator:

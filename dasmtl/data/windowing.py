@@ -34,6 +34,7 @@ from typing import Iterator, Optional, Tuple
 import numpy as np
 
 from dasmtl.config import INPUT_HEIGHT, INPUT_WIDTH
+from dasmtl.data.pipeline import pad_to_bucket
 
 
 @dataclasses.dataclass(frozen=True)
@@ -181,14 +182,13 @@ def window_index_batches(plan: WindowPlan, batch_size: int,
                          "path (window_batches), which zero-pads")
     for b0, n in _batch_ranges(plan, batch_size, process_index,
                                process_count):
-        index = np.full((batch_size,), -1, np.int64)
-        origin = np.zeros((batch_size, 2), np.int32)
-        weight = np.zeros((batch_size,), np.float32)
+        index = np.arange(b0, b0 + n, dtype=np.int64)
+        origin = np.zeros((n, 2), np.int32)
         for j in range(n):
-            index[j] = b0 + j
             origin[j] = plan.origin(b0 + j)
-            weight[j] = 1.0
-        yield {"index": index, "origin": origin, "weight": weight}
+        yield pad_to_bucket({"index": index, "origin": origin,
+                             "weight": np.ones((n,), np.float32)},
+                            batch_size)
 
 
 def window_batches(record: np.ndarray, batch_size: int,
@@ -214,12 +214,12 @@ def window_batches(record: np.ndarray, batch_size: int,
     h, w = plan.window
     for b0, n in _batch_ranges(plan, batch_size, process_index,
                                process_count):
-        x = np.zeros((batch_size, h, w, 1), np.float32)
-        weight = np.zeros((batch_size,), np.float32)
-        index = np.full((batch_size,), -1, np.int64)
+        x = np.zeros((n, h, w, 1), np.float32)
+        weight = np.zeros((n,), np.float32)
         for j in range(n):
             win, wt = extract_window(record, plan, b0 + j)
             x[j, :, :, 0] = win
             weight[j] = wt
-            index[j] = b0 + j
-        yield {"x": x, "weight": weight, "index": index}
+        yield pad_to_bucket(
+            {"x": x, "weight": weight,
+             "index": np.arange(b0, b0 + n, dtype=np.int64)}, batch_size)

@@ -99,14 +99,33 @@ def export_infer(spec, state, *, input_hw=(100, 250),
     return exported.serialize()
 
 
-def load_exported(path: str) -> Callable:
-    """Load a serialized artifact; returns ``fn(x) -> dict`` (no dasmtl
-    code involved beyond this reader — the artifact is self-contained)."""
+def deserialize_exported(path: str):
+    """The deserialized ``jax.export.Exported`` object itself — for callers
+    that need the input spec (``in_avals``) as well as ``.call``: the
+    streaming sweep derives its window grid from it, and the serving
+    executor (:mod:`dasmtl.serve`) validates it against the configured
+    window shape before accepting traffic."""
     from jax import export as jax_export
 
     with open(path, "rb") as f:
-        exported = jax_export.deserialize(bytearray(f.read()))
-    return exported.call
+        return jax_export.deserialize(bytearray(f.read()))
+
+
+def exported_input_hw(exported) -> tuple:
+    """``(height, width)`` of the artifact's ``(b, h, w, 1)`` input spec.
+    The batch dim is symbolic (any size); h/w are fixed at export time and
+    dictate the window every consumer must feed."""
+    shape = exported.in_avals[0].shape
+    if len(shape) != 4:
+        raise ValueError(f"expected a (b, h, w, 1) input spec, "
+                         f"got {shape}")
+    return int(shape[1]), int(shape[2])
+
+
+def load_exported(path: str) -> Callable:
+    """Load a serialized artifact; returns ``fn(x) -> dict`` (no dasmtl
+    code involved beyond this reader — the artifact is self-contained)."""
+    return deserialize_exported(path).call
 
 
 # -- CLI ----------------------------------------------------------------------

@@ -1,0 +1,42 @@
+"""Online inference serving: dynamic micro-batching over bucketed
+compiled executables, with backpressure and a drainable server loop.
+
+The offline surfaces (``dasmtl-stream``: sweep a recorded matrix;
+``dasmtl-export``: a self-contained StableHLO artifact) cannot answer
+*concurrent callers* with bounded latency.  This package is that missing
+deployment layer (docs/SERVING.md):
+
+- :mod:`~dasmtl.serve.queue` — bounded deadline queue, load shedding;
+- :mod:`~dasmtl.serve.batcher` — micro-batch coalescing + bucket padding;
+- :mod:`~dasmtl.serve.executor` — one compiled executable per bucket,
+  warmup-compiled, recompile-guarded, per-request NaN rejection;
+- :mod:`~dasmtl.serve.server` — dispatcher thread, graceful drain,
+  stdlib HTTP front end;
+- :mod:`~dasmtl.serve.metrics` — latency percentiles, batch occupancy,
+  shed/reject counters.
+
+Entry points: ``dasmtl-serve`` / ``dasmtl serve`` /
+``python -m dasmtl.serve``.  In-process use::
+
+    from dasmtl.serve import InferExecutor, ServeLoop
+    loop = ServeLoop(InferExecutor.from_exported(path, buckets=(1, 8, 32)))
+    loop.start()
+    result = loop.submit(window)     # ServeResult
+    loop.drain()
+
+jax only loads when an executor is built — importing the package (or
+parsing the CLI) touches no backend.
+"""
+
+from dasmtl.serve.batcher import BatchPlan, MicroBatcher, choose_bucket
+from dasmtl.serve.executor import InferExecutor
+from dasmtl.serve.metrics import ServeMetrics
+from dasmtl.serve.queue import QueueClosed, Request, RequestQueue, ServeResult
+from dasmtl.serve.server import (ServeLoop, install_signal_handlers,
+                                 make_http_server)
+
+__all__ = [
+    "BatchPlan", "MicroBatcher", "choose_bucket", "InferExecutor",
+    "ServeMetrics", "QueueClosed", "Request", "RequestQueue", "ServeResult",
+    "ServeLoop", "install_signal_handlers", "make_http_server",
+]

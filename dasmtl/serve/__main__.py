@@ -1,0 +1,148 @@
+"""``python -m dasmtl.serve`` — the online inference server CLI (same
+surface as the installed ``dasmtl-serve`` console script and
+``dasmtl serve``).
+
+Serve a StableHLO artifact (``--exported``, the deployment path: no
+framework rebuild, weights ride inside the file) or a checkpoint
+(``--model_path``); fire requests at ``POST /infer``; SIGTERM drains
+gracefully (in-flight batches finish, new work gets an explicit
+``closed``).  ``--selftest`` runs the in-process smoke instead — the CI
+serve job's entry point (docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    from dasmtl.config import Config
+
+    d = Config()
+    p = argparse.ArgumentParser(
+        description="dasmtl online inference serving: dynamic "
+                    "micro-batching over bucketed compiled executables")
+    src = p.add_argument_group("model source (exactly one)")
+    src.add_argument("--exported", type=str, default=None,
+                     help="serve a self-contained StableHLO artifact "
+                          "(python -m dasmtl.export); its input spec must "
+                          "match --window")
+    src.add_argument("--model_path", type=str, default=None,
+                     help="checkpoint directory to restore weights from")
+    p.add_argument("--model", type=str, default="MTL",
+                   help="model family (CSV columns / decode; must match "
+                        "the artifact's family when --exported)")
+    p.add_argument("--window", type=str, default=None, metavar="HxW",
+                   help="expected window shape, e.g. 100x250 (default: the "
+                        "config geometry; with --exported this is "
+                        "validated against the artifact's input spec "
+                        "before the server starts)")
+    p.add_argument("--buckets", type=str,
+                   default=",".join(str(b) for b in d.serve_buckets),
+                   help="comma-separated batch-shape ladder compiled at "
+                        "warmup; every served batch pads to one of these")
+    p.add_argument("--max_wait_ms", type=float, default=d.serve_max_wait_ms,
+                   help="micro-batching deadline: longest a request waits "
+                        "for peers before its batch flushes")
+    p.add_argument("--queue_depth", type=int, default=d.serve_queue_depth,
+                   help="hard bound on queued requests")
+    p.add_argument("--watermark", type=int, default=d.serve_watermark,
+                   help="shed arrivals beyond this many queued requests "
+                        "(default: 90%% of --queue_depth)")
+    p.add_argument("--host", type=str, default=d.serve_host)
+    p.add_argument("--port", type=int, default=d.serve_port)
+    p.add_argument("--device", type=str, default="auto",
+                   choices=["tpu", "cpu", "auto"])
+    p.add_argument("--selftest", action="store_true",
+                   help="run the in-process serving smoke (concurrent "
+                        "clients, NaN poisoning, SIGTERM drain) and exit "
+                        "0/1 — no network, CI-safe on CPU")
+    p.add_argument("--selftest_requests", type=int, default=512)
+    p.add_argument("--selftest_clients", type=int, default=8)
+    args = p.parse_args(argv)
+
+    from dasmtl.utils.platform import apply_device
+
+    apply_device(args.device)
+
+    if args.selftest:
+        from dasmtl.serve.selftest import run_selftest
+
+        report = run_selftest(requests=args.selftest_requests,
+                              clients=args.selftest_clients)
+        return 0 if report["passed"] else 1
+
+    if bool(args.exported) == bool(args.model_path):
+        p.error("exactly one of --exported / --model_path is required "
+                "(or --selftest)")
+    try:
+        buckets = tuple(int(b) for b in args.buckets.split(",") if b)
+    except ValueError:
+        p.error(f"--buckets must be comma-separated ints, "
+                f"got {args.buckets!r}")
+    window = None
+    if args.window:
+        try:
+            h, w = args.window.lower().split("x")
+            window = (int(h), int(w))
+        except ValueError:
+            p.error(f"--window must look like 100x250, got {args.window!r}")
+
+    from dasmtl.serve.executor import InferExecutor
+    from dasmtl.serve.server import (ServeLoop, install_signal_handlers,
+                                     make_http_server)
+
+    # Input-spec compatibility is a STARTUP error (the doctor-style check):
+    # an artifact exported for a different window must never reach traffic.
+    if args.exported:
+        executor = InferExecutor.from_exported(args.exported, buckets,
+                                               expected_hw=window)
+    else:
+        executor = InferExecutor.from_checkpoint(args.model,
+                                                 args.model_path, buckets,
+                                                 input_hw=window)
+    loop = ServeLoop(executor, buckets=buckets,
+                     max_wait_s=args.max_wait_ms / 1e3,
+                     queue_depth=args.queue_depth,
+                     watermark=args.watermark)
+    print(f"warming {len(buckets)} bucket(s) "
+          f"{list(buckets)} on {executor.input_hw[0]}x"
+          f"{executor.input_hw[1]} windows ...", file=sys.stderr)
+    loop.start()
+    httpd = make_http_server(loop, args.host, args.port)
+    host, port = httpd.server_address[:2]
+    print(f"serving {executor.source} on http://{host}:{port} "
+          f"(POST /infer, GET /healthz, GET /stats); warmup "
+          f"{loop.stats()['warmup_s']:.2f}s; SIGTERM drains",
+          file=sys.stderr)
+
+    # SIGTERM/SIGINT: refuse new work, let the dispatcher finish what is
+    # queued, then stop accepting connections.  shutdown() must not run in
+    # the signal handler (it joins the serve_forever thread) — flag + poll.
+    import threading
+
+    stop = threading.Event()
+    install_signal_handlers(loop, on_drain=lambda _s: stop.set())
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    stop.wait()
+    drained = loop.drain(timeout=60.0)
+    httpd.shutdown()
+    t.join(timeout=10.0)
+    loop.close()
+    stats = loop.stats()
+    print(f"drained={'clean' if drained else 'TIMEOUT'} "
+          f"answered={stats['requests']['answered']} "
+          f"shed={stats['requests']['shed']} "
+          f"p50={stats['latency_ms']['p50']}ms "
+          f"p99={stats['latency_ms']['p99']}ms "
+          f"occupancy={stats['batches']['mean_occupancy']:.2f} "
+          f"post_warmup_recompiles="
+          f"{stats['executor'].get('post_warmup_compiles', 0)}",
+          file=sys.stderr)
+    return 0 if drained else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,169 @@
+"""Dynamic micro-batcher: coalesce single-window requests into bucketed
+device batches under a latency deadline.
+
+The throughput of one compiled executable lives almost entirely in its
+batch dimension, but online callers arrive one window at a time.  The
+batcher holds an arriving request for at most ``max_wait`` while peers
+accumulate, then flushes everything pending as ONE batch, padded (via the
+repo-wide :func:`~dasmtl.data.pipeline.pad_to_bucket` convention) to the
+smallest configured **bucket** that fits:
+
+- flush triggers: pending count reaches the largest bucket (**size cap**),
+  the oldest deadline expires (**deadline flush**), or the server is
+  draining (flush whatever is left immediately);
+- buckets are a small fixed set of batch shapes (default a power-of-two
+  ladder), so warmup can compile every shape up front and no post-warmup
+  request ever waits on XLA — and a ladder keeps occupancy >= 50%
+  structurally, because the smallest power of two >= n is < 2n.
+
+The class is a synchronous state machine under one lock: callers inject
+``now`` (or a ``clock``), and the server loop supplies real time + a
+condition variable around it.  That split is what makes deadline logic
+exactly testable with a fake clock (tests/test_serve.py) while the
+threaded server stays thin.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from dasmtl.data.pipeline import pad_to_bucket
+from dasmtl.serve.metrics import ServeMetrics
+from dasmtl.serve.queue import QueueClosed, Request, RequestQueue, ServeResult
+
+
+def choose_bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest configured bucket holding ``n`` rows (buckets sorted
+    ascending; ``n`` never exceeds the largest — the batcher caps takes)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"{n} rows exceed the largest bucket {buckets[-1]}")
+
+
+@dataclasses.dataclass
+class BatchPlan:
+    """One flush: the requests it answers and the padded device batch."""
+
+    requests: List[Request]
+    bucket: int
+
+    @property
+    def n_real(self) -> int:
+        return len(self.requests)
+
+    def assemble(self) -> np.ndarray:
+        """``(bucket, h, w, 1) float32`` — real rows then zero padding,
+        through the same :func:`pad_to_bucket` as the training pipeline,
+        so a partial batch is shape-identical to a full one (no
+        recompiles)."""
+        x = np.stack([np.asarray(r.x, np.float32) for r in self.requests])
+        batch = pad_to_bucket({"x": x[..., None]}, self.bucket)
+        return batch["x"]
+
+
+class MicroBatcher:
+    """Thread-safe request admission + flush policy (no threads of its own).
+
+    ``submit`` always returns a future that WILL resolve: immediately with
+    a ``shed``/``closed`` refusal, or later with predictions (or a
+    per-request rejection) once a flush dispatches it.
+    """
+
+    def __init__(self, buckets: Sequence[int], max_wait_s: float,
+                 queue_depth: int, watermark: int,
+                 clock=time.monotonic,
+                 metrics: Optional[ServeMetrics] = None):
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"bad bucket set {buckets!r}")
+        self.max_wait_s = float(max_wait_s)
+        self.clock = clock
+        self.metrics = metrics or ServeMetrics()
+        self._queue = RequestQueue(queue_depth, watermark)
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._draining = False
+
+    # -- admission -----------------------------------------------------------
+    def submit(self, x: np.ndarray, now: Optional[float] = None,
+               max_wait_s: Optional[float] = None) -> "Request":
+        """Admit one window; the returned request's ``future`` resolves to
+        a :class:`ServeResult`.  Refusals (shed / draining) resolve the
+        future before returning — the caller never distinguishes."""
+        now = self.clock() if now is None else now
+        wait = self.max_wait_s if max_wait_s is None else float(max_wait_s)
+        self.metrics.observe_submit()
+        with self._lock:
+            req = Request(id=self._next_id, x=x, enqueue_t=now,
+                          deadline_t=now + wait)
+            self._next_id += 1
+            try:
+                admitted = self._queue.offer(req)
+            except QueueClosed:
+                self._refuse(req, "closed",
+                             "server draining — not accepting new work")
+                return req
+            if not admitted:
+                self._refuse(req, "shed",
+                             f"queue at watermark "
+                             f"({self._queue.watermark}) — retry later")
+        return req
+
+    def _refuse(self, req: Request, error: str, detail: str) -> None:
+        req.resolve(ServeResult(ok=False, request_id=req.id, error=error,
+                                detail=detail))
+        self.metrics.observe_result(error, 0.0)
+
+    # -- flush policy --------------------------------------------------------
+    def take_batch(self, now: Optional[float] = None) -> Optional[BatchPlan]:
+        """The due batch, or None.  Due = size cap reached, oldest deadline
+        expired, or draining with anything pending.  Takes ALL pending
+        requests up to the largest bucket (oldest deadlines first)."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            n = len(self._queue)
+            if n == 0:
+                return None
+            oldest = self._queue.peek_deadline()
+            if not (n >= self.buckets[-1] or self._draining
+                    or oldest <= now):
+                return None
+            reqs = self._queue.pop_oldest(min(n, self.buckets[-1]))
+        plan = BatchPlan(requests=reqs, bucket=choose_bucket(len(reqs),
+                                                             self.buckets))
+        self.metrics.observe_batch(plan.bucket, plan.n_real)
+        return plan
+
+    def ready_at(self, now: Optional[float] = None) -> Optional[float]:
+        """Earliest time a flush becomes due (<= now means "due already");
+        None while nothing is pending.  The server loop's wait bound."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            n = len(self._queue)
+            if n == 0:
+                return None
+            if n >= self.buckets[-1] or self._draining:
+                return now
+            return self._queue.peek_deadline()
+
+    # -- lifecycle -----------------------------------------------------------
+    def begin_drain(self) -> None:
+        """Stop admitting; everything already queued flushes immediately."""
+        with self._lock:
+            self._draining = True
+            self._queue.close()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queue)

@@ -1,0 +1,149 @@
+"""Bucketed inference executor: one compiled executable per batch shape.
+
+Wraps either a self-contained StableHLO artifact
+(:func:`dasmtl.export.deserialize_exported`) or an in-framework checkpoint
+forward (:func:`dasmtl.export.make_infer_fn` under ``jax.jit``) behind one
+contract:
+
+    preds, bad_rows = executor.run(x)    # x: (bucket, h, w, 1) float32
+
+- **warmup** runs a zero batch through every configured bucket size, so
+  every shape the batcher can emit is compiled before the server accepts
+  traffic;
+- the recompile counter from :mod:`dasmtl.analysis.guards` wraps every
+  call — a compilation landing after warmup raises
+  :class:`~dasmtl.analysis.guards.RecompileError` (a bucket miss is a
+  bug, not a slow path);
+- **per-request NaN rejection** — ``bad_rows[j]`` is True when request
+  ``j``'s outputs hold NaN/Inf.  In eval mode (BN running stats, no
+  dropout) rows are independent through the network, so a poisoned window
+  condemns only itself: the serving-path SAN202 probe
+  (docs/STATIC_ANALYSIS.md) at per-request granularity, via the same
+  ``log_probs_*`` heads the export contract guarantees on every model
+  family.  The decoded argmax of NaN logits is a confidently wrong
+  integer — rejection must happen here, not downstream.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class InferExecutor:
+    """Callable inference backend for :class:`~dasmtl.serve.ServeLoop`."""
+
+    def __init__(self, infer_fn: Callable, input_hw: Tuple[int, int],
+                 buckets: Sequence[int], *, jit: bool = True,
+                 strict_recompile: bool = True, source: str = "fn"):
+        import jax
+
+        from dasmtl.analysis.guards import StepGuards
+
+        self._fn = jax.jit(infer_fn) if jit else infer_fn
+        self.input_hw = (int(input_hw[0]), int(input_hw[1]))
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.source = source
+        self._warm = False
+        # Warmup legitimately compiles once per bucket; anything after
+        # that is a bucket miss.  transfer="off": serving feeds host numpy
+        # batches by design (the H2D copy is the declared input path).
+        self._guards = StepGuards(warmup_steps=len(self.buckets),
+                                  transfer="off",
+                                  recompile_check=strict_recompile)
+        self._guards.__enter__()
+
+    # -- constructors --------------------------------------------------------
+    @classmethod
+    def from_exported(cls, path: str, buckets: Sequence[int],
+                      expected_hw: Optional[Tuple[int, int]] = None,
+                      **kw) -> "InferExecutor":
+        """Serve a StableHLO artifact.  The artifact's ``(b, h, w, 1)``
+        input spec dictates the window; ``expected_hw`` (the configured
+        window shape) is validated against it BEFORE the server starts —
+        a mismatch must be a startup error, not a per-request 400."""
+        from dasmtl.export import deserialize_exported, exported_input_hw
+
+        exported = deserialize_exported(path)
+        hw = exported_input_hw(exported)
+        if expected_hw is not None and tuple(expected_hw) != hw:
+            raise ValueError(
+                f"exported artifact {path} takes {hw[0]}x{hw[1]} windows "
+                f"but the configured window is {expected_hw[0]}x"
+                f"{expected_hw[1]} — re-export or fix the window config")
+        # The exported computation is already compiled per concrete batch
+        # size at call time; jitting again would be a second cache layer.
+        return cls(exported.call, hw, buckets, jit=False,
+                   source=f"exported:{path}", **kw)
+
+    @classmethod
+    def from_checkpoint(cls, model: str, model_path: Optional[str],
+                        buckets: Sequence[int],
+                        input_hw: Optional[Tuple[int, int]] = None,
+                        **kw) -> "InferExecutor":
+        """Serve an in-framework forward: build the model, restore weights
+        (``model_path=None`` keeps fresh-init weights — selftest/bench),
+        jit :func:`~dasmtl.export.make_infer_fn`."""
+        from dasmtl.config import INPUT_HEIGHT, INPUT_WIDTH, Config
+        from dasmtl.export import make_infer_fn
+        from dasmtl.main import build_state
+        from dasmtl.models.registry import get_model_spec
+
+        hw = tuple(input_hw or (INPUT_HEIGHT, INPUT_WIDTH))
+        cfg = Config(model=model)
+        spec = get_model_spec(cfg.model)
+        state = build_state(cfg, spec, input_hw=hw)
+        if model_path:
+            from dasmtl.train.checkpoint import restore_weights
+
+            state = restore_weights(state, model_path)
+        return cls(make_infer_fn(spec, state), hw, buckets,
+                   source=f"checkpoint:{model_path or 'fresh-init'}", **kw)
+
+    # -- execution -----------------------------------------------------------
+    def warmup(self) -> float:
+        """Compile every bucket shape; returns wall seconds spent.  After
+        this, a compilation inside ``run`` raises."""
+        import time
+
+        h, w = self.input_hw
+        t0 = time.perf_counter()
+        for b in self.buckets:
+            self.run(np.zeros((b, h, w, 1), np.float32))
+        self._warm = True
+        return time.perf_counter() - t0
+
+    def run(self, x: np.ndarray
+            ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+        """One batch through the compiled forward.  ``x.shape[0]`` must be
+        a configured bucket.  Returns decoded per-task integer predictions
+        plus the per-row non-finite rejection mask."""
+        if x.shape[0] not in self.buckets:
+            raise ValueError(f"batch of {x.shape[0]} is not a configured "
+                             f"bucket {self.buckets}")
+        import jax
+
+        with self._guards.step():
+            out = self._fn(x)
+        out = {k: np.asarray(jax.device_get(v)) for k, v in out.items()}
+        bad = np.zeros((x.shape[0],), bool)
+        preds = {}
+        for k, v in out.items():
+            if k.startswith("log_probs_"):
+                bad |= ~np.isfinite(v.reshape(v.shape[0], -1)).all(axis=1)
+            else:
+                preds[k] = v
+        return preds, bad
+
+    # -- reporting / lifecycle -----------------------------------------------
+    @property
+    def post_warmup_compiles(self) -> int:
+        return self._guards.post_warmup_compiles
+
+    def compile_summary(self) -> dict:
+        return {"buckets": list(self.buckets), "warm": self._warm,
+                "source": self.source, **self._guards.summary()}
+
+    def close(self) -> None:
+        self._guards.__exit__(None, None, None)

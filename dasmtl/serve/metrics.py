@@ -1,0 +1,103 @@
+"""Serving observability: latency percentiles, batch occupancy, counters.
+
+Pure host-side bookkeeping (stdlib + numpy, no jax): the dispatcher and
+every client thread report here, and :meth:`ServeMetrics.snapshot` renders
+one JSON-able dict that backs both the ``/stats`` HTTP endpoint and the
+``/healthz`` status line.  All methods are thread-safe.
+
+Latency is recorded per request from submit to response — queueing wait +
+batch assembly + device execution — because that is the number a caller
+experiences; batch occupancy (real rows / bucket rows) is recorded per
+dispatched batch and is the one to watch when tuning ``serve_buckets`` and
+``serve_max_wait_ms`` (docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+import numpy as np
+
+#: Outcome labels a request can resolve with.  "ok" carries predictions;
+#: everything else is an explicit structured error, never a silent drop.
+OUTCOMES = ("ok", "shed", "closed", "nonfinite", "error")
+
+#: Bounded latency reservoir: percentiles come from the most recent window
+#: of completions, so a long-running server's stats track current load
+#: instead of averaging over its whole history.
+_RESERVOIR = 65536
+
+
+class ServeMetrics:
+    """Shared counters/histograms for one :class:`~dasmtl.serve.ServeLoop`."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._outcomes: Dict[str, int] = {k: 0 for k in OUTCOMES}
+        self._submitted = 0
+        self._latencies: list = []
+        self._latency_count = 0
+        # Per-bucket occupancy: bucket size -> [n_batches, real_rows_total].
+        self._buckets: Dict[int, list] = {}
+        # Coarse occupancy histogram over all batches, 10 bins of 10%.
+        self._occ_hist = [0] * 10
+
+    # -- recording -----------------------------------------------------------
+    def observe_submit(self) -> None:
+        with self._lock:
+            self._submitted += 1
+
+    def observe_result(self, outcome: str, latency_s: float) -> None:
+        if outcome not in self._outcomes:
+            outcome = "error"
+        with self._lock:
+            self._outcomes[outcome] += 1
+            self._latency_count += 1
+            if len(self._latencies) >= _RESERVOIR:
+                # Overwrite a pseudo-random slot (cheap, lock already held).
+                self._latencies[self._latency_count % _RESERVOIR] = latency_s
+            else:
+                self._latencies.append(latency_s)
+
+    def observe_batch(self, bucket: int, n_real: int) -> None:
+        with self._lock:
+            stats = self._buckets.setdefault(bucket, [0, 0])
+            stats[0] += 1
+            stats[1] += n_real
+            frac = n_real / bucket if bucket else 0.0
+            self._occ_hist[min(9, int(frac * 10))] += 1
+
+    # -- reporting -----------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            lat = np.asarray(self._latencies, np.float32)
+            outcomes = dict(self._outcomes)
+            submitted = self._submitted
+            buckets = {b: tuple(v) for b, v in self._buckets.items()}
+            occ_hist = list(self._occ_hist)
+        n_batches = sum(nb for nb, _ in buckets.values())
+        real_rows = sum(nr for _, nr in buckets.values())
+        slot_rows = sum(b * nb for b, (nb, _) in buckets.items())
+        if lat.size:
+            p50, p95, p99 = (float(v) * 1e3 for v in
+                             np.percentile(lat, [50, 95, 99]))
+        else:
+            p50 = p95 = p99 = 0.0
+        return {
+            "requests": {"submitted": submitted, **outcomes,
+                         "answered": sum(outcomes.values())},
+            "latency_ms": {"p50": round(p50, 3), "p95": round(p95, 3),
+                           "p99": round(p99, 3),
+                           "count": self._latency_count},
+            "batches": {
+                "count": n_batches,
+                "mean_occupancy": (real_rows / slot_rows if slot_rows
+                                   else 0.0),
+                "occupancy_hist_10pct_bins": occ_hist,
+                "per_bucket": {
+                    str(b): {"batches": nb, "real_rows": nr,
+                             "mean_occupancy": nr / (b * nb) if nb else 0.0}
+                    for b, (nb, nr) in sorted(buckets.items())},
+            },
+        }

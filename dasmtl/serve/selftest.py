@@ -1,0 +1,184 @@
+"""In-process serving smoke: the subsystem proves its own contract.
+
+Spins a real :class:`~dasmtl.serve.ServeLoop` over a real compiled forward
+(fresh-init weights on a reduced window — the batching/backpressure/drain
+machinery is identical to production, only the conv stacks are smaller),
+fires concurrent closed-loop clients, poisons a deterministic subset of
+requests with NaN windows, SIGTERMs itself mid-run, and then checks the
+invariants the subsystem exists to provide:
+
+1. every submitted request resolved — with predictions or an explicit
+   shed / closed / nonfinite refusal; no drops, no timeouts;
+2. zero post-warmup XLA compilations (every bucket compiled up front;
+   the recompile counter is :mod:`dasmtl.analysis.guards`' — the same
+   instrument the trainer trusts);
+3. mean batch occupancy >= 50% of the active bucket size (the
+   power-of-two ladder's structural guarantee);
+4. graceful drain: requests accepted before the SIGTERM all completed,
+   submissions after it all resolved ``closed`` — nothing in flight was
+   dropped.
+
+Run via ``python -m dasmtl.serve --selftest`` (the CI serve job) or from
+tests/test_serve_smoke.py.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Optional
+
+import numpy as np
+
+
+def run_selftest(*, requests: int = 512, clients: int = 8,
+                 input_hw=(52, 64), buckets=(1, 2, 4, 8),
+                 max_wait_ms: float = 2.0, queue_depth: int = 64,
+                 poison_every: int = 37, model: str = "MTL",
+                 use_signal: bool = True, drain_frac: float = 0.7,
+                 verbose: bool = True) -> dict:
+    """Returns a report dict: ``{"passed": bool, "failures": [...],
+    "stats": <ServeLoop.stats()>, ...}``.  ``use_signal=False`` calls
+    ``begin_drain`` directly (for callers not on the main thread, where
+    ``signal.signal`` is unavailable)."""
+    from dasmtl.serve.executor import InferExecutor
+    from dasmtl.serve.server import ServeLoop, install_signal_handlers
+
+    executor = InferExecutor.from_checkpoint(model, None, buckets,
+                                             input_hw=input_hw)
+    loop = ServeLoop(executor, buckets=buckets,
+                     max_wait_s=max_wait_ms / 1e3,
+                     queue_depth=queue_depth)
+    say = print if verbose else (lambda *_a, **_k: None)
+    say(f"[serve-selftest] warming {len(buckets)} bucket(s) on "
+        f"{input_hw[0]}x{input_hw[1]} windows ...")
+    loop.start()
+    say(f"[serve-selftest] warmup {loop.stats()['warmup_s']:.2f}s; firing "
+        f"{requests} requests from {clients} clients "
+        f"(poison every {poison_every}th, drain at {drain_frac:.0%})")
+
+    rng = np.random.default_rng(0)
+    h, w = executor.input_hw
+    windows = rng.normal(size=(64, h, w)).astype(np.float32)
+
+    submitted = threading.Semaphore(0)
+    drain_after = int(requests * drain_frac)
+    drained = threading.Event()
+    outcomes: list = []
+    out_lock = threading.Lock()
+    failures: list = []
+
+    def record(i, poisoned, before_drain, outcome):
+        with out_lock:
+            outcomes.append((i, poisoned, before_drain, outcome))
+
+    def client(cid: int) -> None:
+        for k in range(cid, requests, clients):
+            poisoned = poison_every and (k % poison_every == poison_every - 1)
+            x = np.asarray(windows[k % len(windows)])
+            if poisoned:
+                x = x.copy()
+                x[0, 0] = np.nan
+            before_drain = not drained.is_set()
+            fut = loop.submit_async(x)
+            submitted.release()
+            try:
+                record(k, poisoned, before_drain, fut.result(timeout=60.0))
+            except Exception as exc:  # noqa: BLE001 — a drop IS the finding
+                record(k, poisoned, before_drain, exc)
+
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(clients)]
+    prev_handlers: Optional[dict] = None
+    if use_signal:
+        prev_handlers = install_signal_handlers(
+            loop, signals=(signal.SIGTERM,),
+            on_drain=lambda _s: drained.set())
+    try:
+        for t in threads:
+            t.start()
+        # Let most of the load through, then deliver a real SIGTERM while
+        # clients are still firing — the drain must finish accepted work
+        # and refuse the rest.
+        for _ in range(drain_after):
+            submitted.acquire()
+        if use_signal:
+            os.kill(os.getpid(), signal.SIGTERM)
+        else:
+            loop.begin_drain()
+            drained.set()
+        for t in threads:
+            t.join(timeout=120.0)
+            if t.is_alive():
+                failures.append("client thread hung — requests dropped")
+        fully_drained = loop.drain(timeout=30.0)
+    finally:
+        if prev_handlers is not None:
+            for s, h_prev in prev_handlers.items():
+                signal.signal(s, h_prev)
+    stats = loop.stats()
+    loop.close()
+
+    # -- invariant checks ----------------------------------------------------
+    if not fully_drained:
+        failures.append("dispatcher did not drain within 30s")
+    if len(outcomes) != requests:
+        failures.append(f"{requests - len(outcomes)} request(s) never "
+                        f"resolved")
+    n_ok = n_refused = 0
+    for i, poisoned, _before_drain, res in outcomes:
+        if isinstance(res, Exception):
+            failures.append(f"request {i}: dropped "
+                            f"({type(res).__name__}: {res})")
+            continue
+        if res.ok:
+            n_ok += 1
+            if poisoned:
+                failures.append(f"request {i}: NaN-poisoned window "
+                                f"answered ok — SAN202 probe missed it")
+            if not res.predictions:
+                failures.append(f"request {i}: ok without predictions")
+        else:
+            n_refused += 1
+            if res.error not in ("shed", "closed", "nonfinite"):
+                failures.append(f"request {i}: unstructured failure "
+                                f"{res.error!r} ({res.detail})")
+            if poisoned and res.error not in ("nonfinite", "closed", "shed"):
+                failures.append(f"request {i}: poisoned window got "
+                                f"{res.error!r}, expected nonfinite")
+            if not poisoned and res.error == "nonfinite":
+                failures.append(f"request {i}: clean window rejected "
+                                f"nonfinite — probe blames wrong rows")
+
+    occupancy = stats["batches"]["mean_occupancy"]
+    if stats["batches"]["count"] and occupancy < 0.5:
+        failures.append(f"mean batch occupancy {occupancy:.2f} < 0.5")
+    recompiles = stats["executor"].get("post_warmup_compiles", 0)
+    if recompiles:
+        failures.append(f"{recompiles} post-warmup recompile(s) — a batch "
+                        f"shape escaped the bucket ladder")
+    answered = stats["requests"]["answered"]
+    if answered != requests:
+        failures.append(f"metrics answered={answered} != {requests}")
+
+    report = {
+        "passed": not failures,
+        "failures": failures,
+        "requests": requests,
+        "ok": n_ok,
+        "refused": n_refused,
+        "mean_occupancy": occupancy,
+        "post_warmup_compiles": recompiles,
+        "p50_ms": stats["latency_ms"]["p50"],
+        "p99_ms": stats["latency_ms"]["p99"],
+        "stats": stats,
+    }
+    say(f"[serve-selftest] {n_ok} ok / {n_refused} refused over "
+        f"{requests}; occupancy {occupancy:.2f}; "
+        f"p50 {report['p50_ms']:.1f}ms p99 {report['p99_ms']:.1f}ms; "
+        f"post-warmup recompiles {recompiles}")
+    for f in failures:
+        say(f"[serve-selftest] FAIL: {f}")
+    say(f"[serve-selftest] {'PASSED' if report['passed'] else 'FAILED'}")
+    return report

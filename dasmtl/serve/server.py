@@ -1,0 +1,299 @@
+"""The drainable server loop + stdlib HTTP front end.
+
+:class:`ServeLoop` owns one dispatcher thread that pulls due batches from
+the :class:`~dasmtl.serve.batcher.MicroBatcher`, runs them through the
+:class:`~dasmtl.serve.executor.InferExecutor`, and resolves every
+request's future — predictions for finite rows, a structured ``nonfinite``
+rejection for poisoned ones, a structured ``error`` if the executor itself
+fails (a broken batch must answer its callers, not strand them).
+
+Lifecycle::
+
+    loop = ServeLoop(executor, buckets=..., max_wait_s=...)
+    loop.start()                  # warmup compiles every bucket, then serve
+    res = loop.submit(window)     # blocking; submit_async() for a Future
+    loop.drain()                  # SIGTERM path: finish queued work,
+                                  # refuse new, stop the dispatcher
+    loop.close()
+
+Graceful drain is the contract the tests pin: after ``begin_drain`` every
+already-accepted request still gets its answer (the batcher flushes
+leftovers immediately, draining bypasses deadlines) and every later submit
+resolves instantly with ``closed``.  ``install_signal_handlers`` wires
+SIGTERM/SIGINT to ``begin_drain`` — signal-safe because it only flips
+flags and notifies; the blocking wait stays in the main loop.
+
+The HTTP front end is deliberately stdlib-only (``http.server``): a
+thread-per-connection ``ThreadingHTTPServer`` whose POST handler blocks on
+``loop.submit`` — concurrency and batching live in the loop, not the
+transport.  POST /infer, GET /healthz, GET /stats (docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+import time
+from concurrent.futures import TimeoutError as FuturesTimeoutError
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+import numpy as np
+
+from dasmtl.serve.batcher import BatchPlan, MicroBatcher
+from dasmtl.serve.metrics import ServeMetrics
+from dasmtl.serve.queue import ServeResult
+
+#: Decoded event-head label names (index = class id), mirrored from the
+#: streaming CSV writer so the two serving surfaces agree.
+EVENT_NAMES = ("striking", "excavating")
+
+#: Dispatcher idle wait when nothing is queued (s) — a notify cuts it
+#: short; this only bounds how long shutdown can lag a lost notify.
+_IDLE_WAIT_S = 0.5
+
+
+class ServeLoop:
+    """Queue + micro-batcher + executor behind one submit() surface."""
+
+    def __init__(self, executor, *, buckets: Optional[Sequence[int]] = None,
+                 max_wait_s: float = 0.005, queue_depth: int = 256,
+                 watermark: Optional[int] = None,
+                 clock=time.monotonic,
+                 metrics: Optional[ServeMetrics] = None):
+        buckets = tuple(buckets or getattr(executor, "buckets", (1,)))
+        if watermark is None:
+            watermark = max(max(buckets), int(queue_depth * 0.9))
+        self.executor = executor
+        self.metrics = metrics or ServeMetrics()
+        self.clock = clock
+        self.batcher = MicroBatcher(buckets, max_wait_s, queue_depth,
+                                    watermark, clock=clock,
+                                    metrics=self.metrics)
+        self._cv = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._warmup_s: Optional[float] = None
+        self._inflight = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ServeLoop":
+        if self._thread is not None:
+            raise RuntimeError("ServeLoop.start is once-only")
+        self._warmup_s = self.executor.warmup()
+        self._thread = threading.Thread(target=self._dispatch_loop,
+                                        name="dasmtl-serve-dispatch",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def begin_drain(self) -> None:
+        """Refuse new work, flush what is queued.  Non-blocking and
+        signal-safe (flags + notify only) — ``drain`` waits."""
+        self.batcher.begin_drain()
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """``begin_drain`` + wait for the dispatcher to finish everything
+        already accepted.  True when the queue fully drained in time."""
+        self.begin_drain()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            return not self._thread.is_alive()
+        return True
+
+    def close(self) -> None:
+        self.drain(timeout=30.0)
+        self.executor.close()
+
+    @property
+    def draining(self) -> bool:
+        return self.batcher.draining
+
+    # -- request surface -----------------------------------------------------
+    def submit_async(self, x: np.ndarray, max_wait_s: Optional[float] = None):
+        """Admit one ``(h, w)`` window; returns a Future[ServeResult]."""
+        req = self.batcher.submit(np.asarray(x, np.float32),
+                                  max_wait_s=max_wait_s)
+        with self._cv:
+            self._cv.notify_all()
+        return req.future
+
+    def submit(self, x: np.ndarray, timeout: Optional[float] = 30.0,
+               max_wait_s: Optional[float] = None) -> ServeResult:
+        return self.submit_async(x, max_wait_s=max_wait_s).result(timeout)
+
+    # -- dispatcher ----------------------------------------------------------
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cv:
+                plan = None
+                while plan is None:
+                    now = self.clock()
+                    plan = self.batcher.take_batch(now)
+                    if plan is not None:
+                        self._inflight = plan.n_real
+                        break
+                    if self._stop and self.batcher.depth == 0:
+                        return
+                    due = self.batcher.ready_at(now)
+                    self._cv.wait(timeout=_IDLE_WAIT_S if due is None
+                                  else max(0.0, due - now))
+            try:
+                self._run_plan(plan)
+            finally:
+                with self._cv:
+                    self._inflight = 0
+                    self._cv.notify_all()
+
+    def _run_plan(self, plan: BatchPlan) -> None:
+        now = self.clock()
+        try:
+            preds, bad = self.executor.run(plan.assemble())
+        except Exception as exc:  # noqa: BLE001 — must answer the callers
+            detail = f"{type(exc).__name__}: {exc}"
+            for req in plan.requests:
+                self._finish(req, ServeResult(
+                    ok=False, request_id=req.id, error="error",
+                    detail=detail, bucket=plan.bucket))
+            return
+        done = self.clock()
+        for j, req in enumerate(plan.requests):
+            latency = done - req.enqueue_t
+            if bad[j]:
+                self._finish(req, ServeResult(
+                    ok=False, request_id=req.id, error="nonfinite",
+                    detail="model outputs for this window hold NaN/Inf — "
+                           "poisoned input or weights (SAN202, "
+                           "docs/STATIC_ANALYSIS.md)",
+                    latency_s=latency, bucket=plan.bucket))
+                continue
+            out = {k: int(v[j]) for k, v in preds.items()}
+            if "event" in out:
+                out["event_name"] = EVENT_NAMES[out["event"]]
+            self._finish(req, ServeResult(
+                ok=True, request_id=req.id, predictions=out,
+                latency_s=latency, bucket=plan.bucket))
+
+    def _finish(self, req, result: ServeResult) -> None:
+        req.resolve(result)
+        self.metrics.observe_result(result.outcome, result.latency_s)
+
+    # -- observability -------------------------------------------------------
+    def stats(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap["queue"] = {"depth": self.batcher.depth,
+                         "draining": self.batcher.draining,
+                         "inflight": self._inflight}
+        snap["executor"] = self.executor.compile_summary()
+        snap["warmup_s"] = self._warmup_s
+        return snap
+
+    def healthz(self) -> dict:
+        return {
+            "status": "draining" if self.batcher.draining else "serving",
+            "warm": self._warmup_s is not None,
+            "queue_depth": self.batcher.depth,
+            "post_warmup_recompiles": getattr(
+                self.executor, "post_warmup_compiles", 0),
+        }
+
+
+def install_signal_handlers(loop: ServeLoop,
+                            signals=(signal.SIGTERM, signal.SIGINT),
+                            on_drain=None) -> dict:
+    """SIGTERM/SIGINT -> ``begin_drain`` (idempotent).  Returns the
+    previous handlers so tests can restore them."""
+    prev = {}
+
+    def handler(signum, frame):  # noqa: ARG001 — signal API shape
+        loop.begin_drain()
+        if on_drain is not None:
+            on_drain(signum)
+
+    for s in signals:
+        prev[s] = signal.signal(s, handler)
+    return prev
+
+
+# -- HTTP front end -----------------------------------------------------------
+
+
+def _make_handler(loop: ServeLoop, request_timeout_s: float):
+    """Handler class closed over the loop (BaseHTTPRequestHandler is
+    instantiated per connection by the server, so state rides the class)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args) -> None:  # quiet by default
+            pass
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self) -> None:  # noqa: N802 — http.server API shape
+            if self.path == "/healthz":
+                h = loop.healthz()
+                self._reply(503 if h["status"] == "draining" else 200, h)
+            elif self.path == "/stats":
+                self._reply(200, loop.stats())
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+        def do_POST(self) -> None:  # noqa: N802 — http.server API shape
+            if self.path != "/infer":
+                self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                x = np.asarray(json.loads(self.rfile.read(n))["x"],
+                               np.float32)
+            except (ValueError, KeyError, json.JSONDecodeError) as exc:
+                self._reply(400, {"ok": False, "error": "bad_request",
+                                  "detail": f"expected JSON "
+                                            f'{{"x": [[...]]}}: {exc}'})
+                return
+            h, w = loop.executor.input_hw
+            if x.shape == (h, w, 1):
+                x = x[..., 0]
+            if x.shape != (h, w):
+                self._reply(400, {
+                    "ok": False, "error": "bad_request",
+                    "detail": f"window must be {h}x{w}, got "
+                              f"{list(x.shape)}"})
+                return
+            try:
+                res = loop.submit(x, timeout=request_timeout_s)
+            except FuturesTimeoutError:
+                self._reply(504, {"ok": False, "error": "timeout",
+                                  "detail": f"no response within "
+                                            f"{request_timeout_s}s"})
+                return
+            code = {None: 200, "shed": 503, "closed": 503,
+                    "nonfinite": 422}.get(res.error, 500)
+            self._reply(code, {
+                "ok": res.ok, "request_id": res.request_id,
+                "predictions": res.predictions, "error": res.error,
+                "detail": res.detail,
+                "latency_ms": round(res.latency_s * 1e3, 3),
+                "bucket": res.bucket})
+
+    return Handler
+
+
+def make_http_server(loop: ServeLoop, host: str = "127.0.0.1",
+                     port: int = 0, request_timeout_s: float = 30.0
+                     ) -> ThreadingHTTPServer:
+    """Bind (port 0 = ephemeral; read ``server_address[1]``) but do not
+    serve — callers run ``serve_forever`` and ``shutdown`` themselves."""
+    return ThreadingHTTPServer((host, port),
+                               _make_handler(loop, request_timeout_s))

@@ -137,13 +137,11 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
                 "resident='on' needs in-graph window slicing, which a "
                 "fixed exported computation cannot provide — stream from a "
                 "checkpoint for the resident path")
-        from jax import export as jax_export
+        from dasmtl.export import deserialize_exported, exported_input_hw
 
-        with open(exported_path, "rb") as f:
-            exported = jax_export.deserialize(bytearray(f.read()))
+        exported = deserialize_exported(exported_path)
         # The artifact's (b, h, w, 1) input spec dictates the window grid.
-        _, ah, aw, _ = exported.in_avals[0].shape
-        window = (int(ah), int(aw))
+        window = exported_input_hw(exported)
         artifact_call = exported.call
 
         plan = plan_windows(record.shape, window=window,

@@ -111,6 +111,16 @@ def collect() -> dict:
         "bn_sync": d.bn_sync,
     }
 
+    # Online-serving defaults (dasmtl/serve/, docs/SERVING.md): the knobs
+    # that decide latency-vs-occupancy and when the server sheds load.
+    info["serve_defaults"] = {
+        "buckets": list(d.serve_buckets),
+        "max_wait_ms": d.serve_max_wait_ms,
+        "queue_depth": d.serve_queue_depth,
+        "watermark": d.serve_watermark_resolved,
+        "endpoint": f"{d.serve_host}:{d.serve_port}",
+    }
+
     # Tracing-discipline tooling (dasmtl.analysis): the registered lint
     # rules and the runtime-guard flag defaults, so "is the linter seeing
     # rule X" / "are guards on by default" is answerable from one page.
@@ -170,15 +180,44 @@ def _determinism_baseline_summary() -> dict:
             "generated_with": data.get("generated_with", {})}
 
 
+def check_exported_artifact(path: str, window=None) -> dict:
+    """Serve-precheck: does this StableHLO artifact's input spec match the
+    window shape the server would feed it?  The same validation
+    ``dasmtl-serve --exported`` runs at startup — here it is answerable
+    without starting anything."""
+    from dasmtl.config import INPUT_HEIGHT, INPUT_WIDTH
+    from dasmtl.export import deserialize_exported, exported_input_hw
+
+    want = tuple(window or (INPUT_HEIGHT, INPUT_WIDTH))
+    try:
+        got = exported_input_hw(deserialize_exported(path))
+    except Exception as exc:  # noqa: BLE001 — diagnostic, not control flow
+        return {"path": path, "status": f"unreadable ({exc})"}
+    return {"path": path,
+            "status": "compatible" if got == want else "MISMATCH",
+            "artifact_hw": list(got), "configured_hw": list(want)}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="dasmtl environment doctor")
     ap.add_argument("--json", action="store_true",
                     help="one machine-readable JSON line")
+    ap.add_argument("--exported", type=str, default=None, metavar="PATH",
+                    help="also validate a StableHLO serving artifact's "
+                         "input spec against the configured window shape "
+                         "(what dasmtl-serve checks before accepting "
+                         "traffic)")
     args = ap.parse_args(argv)
     info = collect()
+    rc = 0
+    if args.exported:
+        info["exported_artifact"] = check_exported_artifact(args.exported)
+        # The one doctor check that gates an action (serving this
+        # artifact): surface it in the exit code for scripted prechecks.
+        rc = 0 if info["exported_artifact"]["status"] == "compatible" else 1
     if args.json:
         print(json.dumps(info))
-        return 0
+        return rc
     print("dasmtl doctor")
     print(f"  python {info['python']}")
     for mod, ver in info.get("versions", {}).items():
@@ -207,6 +246,22 @@ def main(argv=None) -> int:
           f"({nl['library']})")
     print("  perf defaults: " + ", ".join(
         f"{k}={v}" for k, v in info["perf_defaults"].items()))
+    print("  serve defaults: " + ", ".join(
+        f"{k}={v}" for k, v in info["serve_defaults"].items())
+        + " (dasmtl-serve; docs/SERVING.md)")
+    ea = info.get("exported_artifact")
+    if ea:
+        if ea["status"] == "compatible":
+            print(f"  exported artifact: {ea['path']} compatible — "
+                  f"{ea['artifact_hw'][0]}x{ea['artifact_hw'][1]} windows")
+        elif ea["status"] == "MISMATCH":
+            print(f"  exported artifact: {ea['path']} MISMATCH — artifact "
+                  f"takes {ea['artifact_hw'][0]}x{ea['artifact_hw'][1]}, "
+                  f"config expects {ea['configured_hw'][0]}x"
+                  f"{ea['configured_hw'][1]}; dasmtl-serve would refuse "
+                  f"to start")
+        else:
+            print(f"  exported artifact: {ea['path']} {ea['status']}")
     ana = info.get("analysis", {})
     print(f"  lint rules: {', '.join(ana.get('lint_rules', []))} "
           "(dasmtl-lint; docs/STATIC_ANALYSIS.md)")
@@ -238,7 +293,7 @@ def main(argv=None) -> int:
               f"{db.get('status', 'missing')} at {db.get('path')} — "
               f"generate with dasmtl-sanitize --update-baseline "
               f"--preset full")
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

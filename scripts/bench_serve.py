@@ -1,0 +1,201 @@
+"""Serving load generator: closed-loop and open-loop (Poisson) benchmarks
+against an in-process ServeLoop.
+
+Closed loop (``--clients N``): N threads each fire requests back-to-back —
+measures the *capacity* of the batcher + executor (throughput at full
+pressure, latency under self-induced queueing).
+
+Open loop (``--rps R``): requests arrive on a Poisson process regardless
+of completions — the honest model of a fiber that does not wait for the
+server, and the one that exposes shed behavior: when R exceeds capacity
+the queue hits the watermark and the shed rate (reported) becomes the
+safety valve instead of unbounded latency.
+
+Reports throughput, p50/p95/p99 latency, mean batch occupancy, and
+shed/reject rates per mode; writes ``BENCH_serve.json`` alongside the
+repo's other ``BENCH_*.json`` snapshots and prints one JSON line per mode.
+
+Run:  python scripts/bench_serve.py [--requests 2000] [--rps 300]
+      python scripts/bench_serve.py --smoke     # CI: small + invariants
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_loop(args):
+    from dasmtl.serve.executor import InferExecutor
+    from dasmtl.serve.server import ServeLoop
+
+    h, w = (int(v) for v in args.hw.lower().split("x"))
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    executor = InferExecutor.from_checkpoint(args.model, args.model_path,
+                                             buckets, input_hw=(h, w))
+    loop = ServeLoop(executor, buckets=buckets,
+                     max_wait_s=args.max_wait_ms / 1e3,
+                     queue_depth=args.queue_depth)
+    t0 = time.perf_counter()
+    loop.start()
+    print(f"warmup ({len(buckets)} buckets, {h}x{w}): "
+          f"{time.perf_counter() - t0:.2f}s", file=sys.stderr)
+    return loop, (h, w)
+
+
+def _report(mode, loop, outcomes, wall_s, n_requests):
+    stats = loop.stats()
+    ok = sum(1 for o in outcomes if o == "ok")
+    shed = sum(1 for o in outcomes if o == "shed")
+    rec = {
+        "metric": f"serve_{mode}_throughput",
+        "value": round(ok / wall_s, 1),
+        "unit": "req/s",
+        "requests": n_requests,
+        "ok": ok,
+        "shed": shed,
+        "shed_rate": round(shed / max(1, n_requests), 4),
+        "other_refusals": n_requests - ok - shed,
+        "wall_s": round(wall_s, 3),
+        "p50_ms": stats["latency_ms"]["p50"],
+        "p95_ms": stats["latency_ms"]["p95"],
+        "p99_ms": stats["latency_ms"]["p99"],
+        "mean_batch_occupancy": round(
+            stats["batches"]["mean_occupancy"], 4),
+        "batches": stats["batches"]["count"],
+        "post_warmup_recompiles": stats["executor"].get(
+            "post_warmup_compiles", 0),
+    }
+    print(json.dumps(rec))
+    return rec
+
+
+def closed_loop(loop, hw, n_requests, clients, rng):
+    """Every client waits for its answer before sending the next."""
+    windows = rng.normal(size=(32, *hw)).astype(np.float32)
+    outcomes, lock = [], threading.Lock()
+
+    def client(cid):
+        for k in range(cid, n_requests, clients):
+            res = loop.submit(windows[k % len(windows)], timeout=120.0)
+            with lock:
+                outcomes.append(res.outcome)
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return outcomes, time.perf_counter() - t0
+
+
+def open_loop(loop, hw, n_requests, rps, rng):
+    """Poisson arrivals at ``rps``: submit at the scheduled instant no
+    matter how the server is doing; collect futures afterwards."""
+    windows = rng.normal(size=(32, *hw)).astype(np.float32)
+    gaps = rng.exponential(1.0 / rps, size=n_requests)
+    futures = []
+    t0 = time.perf_counter()
+    due = t0
+    for k in range(n_requests):
+        due += gaps[k]
+        delay = due - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        futures.append(loop.submit_async(windows[k % len(windows)]))
+    outcomes = [f.result(timeout=120.0).outcome for f in futures]
+    return outcomes, time.perf_counter() - t0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", type=str, default="MTL")
+    ap.add_argument("--model_path", type=str, default=None,
+                    help="checkpoint to restore (default: fresh init — "
+                         "identical compute, no trained weights needed)")
+    ap.add_argument("--hw", type=str, default="100x250",
+                    help="window shape (smoke overrides to 52x64)")
+    ap.add_argument("--buckets", type=str, default="1,2,4,8,16,32")
+    ap.add_argument("--max_wait_ms", type=float, default=5.0)
+    ap.add_argument("--queue_depth", type=int, default=256)
+    ap.add_argument("--requests", type=int, default=2000)
+    ap.add_argument("--clients", type=int, default=16,
+                    help="closed-loop concurrency")
+    ap.add_argument("--rps", type=float, default=None,
+                    help="open-loop Poisson arrival rate (default: 1.5x "
+                         "the measured closed-loop throughput, to probe "
+                         "the shedding regime)")
+    ap.add_argument("--out", type=str, default="BENCH_serve.json")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI mode: tiny model, few hundred requests, exit "
+                         "nonzero if a serving invariant breaks")
+    args = ap.parse_args()
+    if args.smoke:
+        args.hw = "52x64"
+        args.buckets = "1,2,4,8"
+        args.requests = min(args.requests, 300)
+        args.clients = 8
+
+    loop, hw = _build_loop(args)
+    rng = np.random.default_rng(0)
+
+    outcomes, wall = closed_loop(loop, hw, args.requests, args.clients, rng)
+    closed = _report("closed_loop", loop, outcomes, wall, args.requests)
+
+    rps = args.rps or max(10.0, 1.5 * closed["value"])
+    # Fresh metrics for the open-loop leg so its percentiles aren't
+    # blended with the closed-loop run (the loop and executables persist —
+    # no recompiles between legs).
+    from dasmtl.serve.metrics import ServeMetrics
+
+    loop.metrics = loop.batcher.metrics = ServeMetrics()
+    outcomes, wall = open_loop(loop, hw, args.requests, rps, rng)
+    open_ = _report("open_loop", loop, outcomes, wall, args.requests)
+    open_["offered_rps"] = round(rps, 1)
+
+    loop.drain(timeout=30.0)
+    loop.close()
+
+    out = {"backend": "cpu", "hw": args.hw, "buckets": args.buckets,
+           "max_wait_ms": args.max_wait_ms, "smoke": args.smoke,
+           "closed_loop": closed, "open_loop": open_}
+    try:
+        import jax
+
+        out["backend"] = jax.default_backend()
+    except Exception:  # noqa: BLE001 — backend name is cosmetic here
+        pass
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+    print(f"wrote {args.out}", file=sys.stderr)
+
+    if args.smoke:
+        failures = []
+        for mode, rec in (("closed", closed), ("open", open_)):
+            if rec["post_warmup_recompiles"]:
+                failures.append(f"{mode}: post-warmup recompiles "
+                                f"{rec['post_warmup_recompiles']}")
+            if rec["ok"] + rec["shed"] + rec["other_refusals"] \
+                    != args.requests:
+                failures.append(f"{mode}: requests unaccounted for")
+        if closed["batches"] and closed["mean_batch_occupancy"] < 0.5:
+            failures.append(f"closed: occupancy "
+                            f"{closed['mean_batch_occupancy']} < 0.5")
+        for f_ in failures:
+            print(f"SMOKE FAIL: {f_}", file=sys.stderr)
+        return 1 if failures else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

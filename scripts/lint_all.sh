@@ -39,4 +39,15 @@ else
     echo "== dasmtl-sanitize skipped (DASMTL_LINT_SKIP_SANITIZE set)"
 fi
 
+# Online-serving smoke: the in-process selftest (concurrent clients, NaN
+# poisoning, SIGTERM drain, recompile/occupancy invariants) on a reduced
+# window — a few model compiles, so skippable for doc-only edits.
+# CI's serve job runs this plus the bench_serve.py --smoke leg.
+if [ "${DASMTL_LINT_SKIP_SERVE:-}" = "" ]; then
+    echo "== dasmtl serve --selftest"
+    python -m dasmtl.serve --selftest || rc=1
+else
+    echo "== dasmtl serve selftest skipped (DASMTL_LINT_SKIP_SERVE set)"
+fi
+
 exit $rc

@@ -1,0 +1,408 @@
+"""Micro-batcher / queue / backpressure unit tests (dasmtl/serve/).
+
+Everything here runs under a FAKE clock and (mostly) a fake executor: the
+batcher is a synchronous state machine that takes ``now`` as an argument,
+so deadline semantics are asserted exactly — no sleeps, no flaky timing.
+The real-model end-to-end path lives in tests/test_serve_smoke.py.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dasmtl.data.pipeline import pad_to_bucket
+from dasmtl.serve import (MicroBatcher, QueueClosed, Request, RequestQueue,
+                          ServeLoop, ServeMetrics, ServeResult,
+                          choose_bucket, make_http_server)
+
+HW = (4, 5)
+
+
+def win(seed=0):
+    return np.random.default_rng(seed).normal(size=HW).astype(np.float32)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeExecutor:
+    """Executor-protocol stand-in: numpy argmax over the window sum, a
+    poisoned row (NaN anywhere) rejects, optional artificial delay."""
+
+    def __init__(self, buckets=(1, 2, 4, 8), delay_s=0.0, fail=False):
+        self.buckets = tuple(sorted(buckets))
+        self.input_hw = HW
+        self.post_warmup_compiles = 0
+        self.batches = []
+        self.delay_s = delay_s
+        self.fail = fail
+        self.closed = False
+
+    def warmup(self):
+        return 0.0
+
+    def run(self, x):
+        if self.fail:
+            raise RuntimeError("injected executor fault")
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        assert x.shape[0] in self.buckets, "bucket miss"
+        self.batches.append(x.shape[0])
+        flat = x.reshape(x.shape[0], -1)
+        bad = ~np.isfinite(flat).all(axis=1)
+        preds = {"event": (np.nan_to_num(flat).sum(axis=1) > 0)
+                 .astype(np.int64)}
+        return preds, bad
+
+    def compile_summary(self):
+        return {"compiles": len(self.buckets), "post_warmup_compiles": 0}
+
+    def close(self):
+        self.closed = True
+
+
+def make_batcher(clock, buckets=(1, 2, 4, 8), max_wait_s=0.010,
+                 depth=16, watermark=12):
+    return MicroBatcher(buckets, max_wait_s, depth, watermark, clock=clock)
+
+
+# -- bucket / padding --------------------------------------------------------
+
+
+def test_choose_bucket_smallest_fit():
+    assert choose_bucket(1, (1, 2, 4, 8)) == 1
+    assert choose_bucket(3, (1, 2, 4, 8)) == 4
+    assert choose_bucket(8, (1, 2, 4, 8)) == 8
+    with pytest.raises(ValueError):
+        choose_bucket(9, (1, 2, 4, 8))
+
+
+def test_pad_to_bucket_convention():
+    batch = {"x": np.ones((3, 2, 2), np.float32),
+             "weight": np.ones((3,), np.float32),
+             "index": np.arange(3, dtype=np.int64),
+             "distance": np.full((3,), 7, np.int32)}
+    out = pad_to_bucket(batch, 5)
+    assert out["x"].shape == (5, 2, 2) and out["x"].dtype == np.float32
+    assert out["x"][3:].sum() == 0
+    assert out["weight"].tolist() == [1, 1, 1, 0, 0]  # padding weight 0
+    assert out["index"].tolist() == [0, 1, 2, -1, -1]  # padding index -1
+    assert out["distance"].tolist() == [7, 7, 7, 0, 0]  # others pad zero
+    assert out["distance"].dtype == np.int32
+    # Full batch passes through untouched; overfull refuses.
+    assert pad_to_bucket(batch, 3) is batch
+    with pytest.raises(ValueError):
+        pad_to_bucket(batch, 2)
+    with pytest.raises(ValueError):
+        pad_to_bucket({"a": np.zeros(2), "b": np.zeros(3)}, 4)
+
+
+def test_pad_to_bucket_matches_training_pipeline_padding():
+    """The refactored _make_batch / window_batches padding is identical to
+    the long-standing convention: weight 0 rows, zero x, index -1."""
+    from dasmtl.data.pipeline import eval_batches
+    from dasmtl.data.sources import ArraySource
+
+    x = np.random.default_rng(0).normal(size=(5, 4, 4)).astype(np.float32)
+    src = ArraySource(x[..., None], np.arange(5) % 16, np.arange(5) % 2)
+    batches = list(eval_batches(src, batch_size=4))
+    assert [b["x"].shape[0] for b in batches] == [4, 4]
+    tail = batches[-1]
+    assert tail["weight"].tolist() == [1.0, 0.0, 0.0, 0.0]
+    assert tail["x"][1:].sum() == 0
+    assert tail["distance"][1:].tolist() == [0, 0, 0]
+
+
+def test_pad_to_bucket_no_extra_compiles_for_partial_batches():
+    """A padded partial batch must hit the SAME executable as a full one
+    (shape-identical), asserted with the real recompile counter."""
+    import jax
+
+    from dasmtl.analysis.guards import StepGuards
+
+    @jax.jit
+    def f(x):
+        return x.sum(axis=tuple(range(1, x.ndim)))
+
+    full = {"x": np.ones((4, 3, 3), np.float32)}
+    partial = pad_to_bucket({"x": np.ones((2, 3, 3), np.float32)}, 4)
+    with StepGuards(warmup_steps=1, transfer="off") as g:
+        with g.step():
+            jax.block_until_ready(f(full["x"]))  # warmup: the one compile
+        with g.step():
+            jax.block_until_ready(f(partial["x"]))  # padded partial: cached
+    assert g.post_warmup_compiles == 0
+
+
+# -- queue -------------------------------------------------------------------
+
+
+def _req(i, deadline):
+    return Request(id=i, x=win(), enqueue_t=0.0, deadline_t=deadline)
+
+
+def test_queue_oldest_deadline_first():
+    q = RequestQueue(depth=8, watermark=8)
+    for i, dl in enumerate([3.0, 1.0, 2.0]):
+        assert q.offer(_req(i, dl))
+    assert [r.id for r in q.pop_oldest(2)] == [1, 2]
+    assert q.peek_deadline() == 3.0
+
+
+def test_queue_sheds_at_watermark_and_closes():
+    q = RequestQueue(depth=4, watermark=2)
+    assert q.offer(_req(0, 1.0)) and q.offer(_req(1, 1.0))
+    assert not q.offer(_req(2, 1.0))  # watermark hit: shed
+    q.close()
+    with pytest.raises(QueueClosed):
+        q.offer(_req(3, 1.0))
+    assert len(q.pop_oldest(10)) == 2  # queued work stays poppable
+
+
+# -- batcher flush policy (fake clock) ---------------------------------------
+
+
+def test_deadline_flush_exact_time():
+    clock = FakeClock()
+    mb = make_batcher(clock, max_wait_s=0.010)
+    mb.submit(win())
+    assert mb.take_batch() is None  # deadline not reached
+    assert mb.ready_at() == pytest.approx(0.010)
+    clock.advance(0.0099)
+    assert mb.take_batch() is None
+    clock.advance(0.0002)  # past the deadline
+    plan = mb.take_batch()
+    assert plan is not None and plan.n_real == 1 and plan.bucket == 1
+    assert plan.assemble().shape == (1, *HW, 1)
+
+
+def test_size_cap_flush_ignores_deadline():
+    clock = FakeClock()
+    mb = make_batcher(clock, buckets=(1, 2, 4), max_wait_s=10.0)
+    for _ in range(5):
+        mb.submit(win())
+    plan = mb.take_batch()  # 5 pending >= largest bucket 4: due NOW
+    assert plan.n_real == 4 and plan.bucket == 4
+    assert mb.take_batch() is None  # leftover 1 waits for its deadline
+    clock.advance(10.1)
+    plan = mb.take_batch()
+    assert plan.n_real == 1 and plan.bucket == 1
+
+
+def test_flush_takes_oldest_first_and_pads_to_smallest_fit():
+    clock = FakeClock()
+    mb = make_batcher(clock, max_wait_s=0.005)
+    first = mb.submit(win())
+    clock.advance(0.003)
+    second = mb.submit(win())
+    third = mb.submit(win())
+    clock.advance(0.0025)  # first's deadline passed, others' not
+    plan = mb.take_batch()
+    # Deadline flush takes EVERYTHING pending, oldest deadline first.
+    assert [r.id for r in plan.requests] == [first.id, second.id, third.id]
+    assert plan.bucket == 4  # smallest bucket >= 3
+    assert plan.assemble().shape == (4, *HW, 1)
+
+
+def test_shed_at_watermark_resolves_future_immediately():
+    clock = FakeClock()
+    mb = make_batcher(clock, depth=8, watermark=3)
+    accepted = [mb.submit(win()) for _ in range(3)]
+    shed = mb.submit(win())
+    res = shed.future.result(timeout=1.0)
+    assert not res.ok and res.error == "shed" and "watermark" in res.detail
+    assert all(not r.future.done() for r in accepted)
+    assert mb.depth == 3
+
+
+def test_drain_flushes_partial_and_refuses_new():
+    clock = FakeClock()
+    mb = make_batcher(clock, max_wait_s=10.0)
+    pending = mb.submit(win())
+    mb.begin_drain()
+    plan = mb.take_batch()  # draining: due immediately, deadline ignored
+    assert [r.id for r in plan.requests] == [pending.id]
+    late = mb.submit(win())
+    res = late.future.result(timeout=1.0)
+    assert not res.ok and res.error == "closed"
+    assert mb.take_batch() is None
+
+
+# -- metrics -----------------------------------------------------------------
+
+
+def test_metrics_percentiles_occupancy_and_counters():
+    m = ServeMetrics()
+    for ms in range(1, 101):
+        m.observe_submit()
+        m.observe_result("ok", ms / 1e3)
+    m.observe_result("shed", 0.0)
+    m.observe_batch(8, 8)
+    m.observe_batch(8, 4)
+    m.observe_batch(2, 1)
+    snap = m.snapshot()
+    assert snap["requests"]["submitted"] == 100
+    assert snap["requests"]["ok"] == 100
+    assert snap["requests"]["shed"] == 1
+    assert snap["latency_ms"]["p50"] == pytest.approx(50.5, abs=1.5)
+    assert snap["latency_ms"]["p99"] == pytest.approx(99.5, abs=1.5)
+    occ = snap["batches"]
+    assert occ["count"] == 3
+    assert occ["mean_occupancy"] == pytest.approx(13 / 18)
+    assert occ["per_bucket"]["8"]["mean_occupancy"] == pytest.approx(0.75)
+
+
+# -- ServeLoop with the fake executor (real threads, real clock) -------------
+
+
+def test_serveloop_end_to_end_with_fake_executor():
+    ex = FakeExecutor()
+    loop = ServeLoop(ex, max_wait_s=0.002, queue_depth=32).start()
+    try:
+        results = [loop.submit(win(i) + 1.0, timeout=10.0)
+                   for i in range(5)]
+        assert all(r.ok for r in results)
+        assert all(r.predictions["event"] in (0, 1) for r in results)
+        assert all(b in ex.buckets for b in ex.batches)
+    finally:
+        loop.close()
+    assert ex.closed
+
+
+def test_serveloop_nonfinite_request_rejected_others_survive():
+    """Seeded fault injection: one NaN-poisoned window in a concurrent
+    burst gets a structured rejection; its batch-mates answer normally."""
+    ex = FakeExecutor()
+    loop = ServeLoop(ex, max_wait_s=0.02, queue_depth=32).start()
+    try:
+        poisoned = win(1).copy()
+        poisoned[0, 0] = np.nan
+        futs = [loop.submit_async(win(i) + 1.0) for i in range(3)]
+        bad_fut = loop.submit_async(poisoned)
+        good = [f.result(timeout=10.0) for f in futs]
+        bad = bad_fut.result(timeout=10.0)
+    finally:
+        loop.close()
+    assert all(r.ok for r in good)
+    assert not bad.ok and bad.error == "nonfinite"
+    assert "SAN202" in bad.detail
+
+
+def test_serveloop_executor_failure_answers_all_callers():
+    ex = FakeExecutor(fail=True)
+    loop = ServeLoop(ex, max_wait_s=0.002, queue_depth=32).start()
+    try:
+        res = loop.submit(win(), timeout=10.0)
+    finally:
+        loop.close()
+    assert not res.ok and res.error == "error"
+    assert "injected executor fault" in res.detail
+
+
+def test_serveloop_slow_consumer_bounded_queue_sheds():
+    """A slow executor + fast submitters: the queue must shed beyond the
+    watermark instead of growing without bound (and nothing hangs)."""
+    ex = FakeExecutor(buckets=(1, 2), delay_s=0.05)
+    loop = ServeLoop(ex, buckets=(1, 2), max_wait_s=0.001, queue_depth=8,
+                     watermark=4).start()
+    try:
+        futs = [loop.submit_async(win(i) + 1.0) for i in range(40)]
+        results = [f.result(timeout=30.0) for f in futs]
+    finally:
+        loop.close()
+    outcomes = [r.outcome for r in results]
+    assert outcomes.count("shed") > 0  # backpressure engaged
+    assert set(outcomes) <= {"ok", "shed"}
+    assert loop.batcher.depth == 0  # nothing left behind
+    shed = [r for r in results if r.outcome == "shed"]
+    assert all("watermark" in r.detail for r in shed)
+
+
+def test_serveloop_graceful_drain_finishes_inflight():
+    ex = FakeExecutor(buckets=(1, 2, 4), delay_s=0.01)
+    loop = ServeLoop(ex, buckets=(1, 2, 4), max_wait_s=0.05,
+                     queue_depth=32).start()
+    futs = [loop.submit_async(win(i) + 1.0) for i in range(6)]
+    assert loop.drain(timeout=10.0)  # deadline far away: drain flushes now
+    results = [f.result(timeout=1.0) for f in futs]
+    assert all(r.ok for r in results)  # accepted work completed, not dropped
+    late = loop.submit(win(), timeout=1.0)
+    assert not late.ok and late.error == "closed"
+    loop.close()
+
+
+def test_http_front_end_infer_healthz_stats():
+    import json
+    import urllib.error
+    import urllib.request
+
+    ex = FakeExecutor()
+    loop = ServeLoop(ex, max_wait_s=0.002, queue_depth=32).start()
+    httpd = make_http_server(loop, port=0)
+    host, port = httpd.server_address[:2]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        body = json.dumps({"x": (win(0) + 1.0).tolist()}).encode()
+        req = urllib.request.Request(
+            f"http://{host}:{port}/infer", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            out = json.loads(resp.read())
+        assert out["ok"] and out["predictions"]["event"] in (0, 1)
+
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/healthz", timeout=10) as resp:
+            assert json.loads(resp.read())["status"] == "serving"
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/stats", timeout=10) as resp:
+            stats = json.loads(resp.read())
+        assert stats["requests"]["ok"] >= 1
+
+        # Wrong window shape: structured 400, never a queued request.
+        bad = json.dumps({"x": [[1.0, 2.0]]}).encode()
+        req = urllib.request.Request(
+            f"http://{host}:{port}/infer", data=bad,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+
+        # Draining flips healthz to 503 for load balancers.
+        loop.begin_drain()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://{host}:{port}/healthz",
+                                   timeout=10)
+        assert ei.value.code == 503
+    finally:
+        httpd.shutdown()
+        t.join(timeout=5)
+        loop.close()
+
+
+def test_config_serve_block_validation():
+    from dasmtl.config import Config
+
+    cfg = Config()
+    assert cfg.serve_buckets == (1, 2, 4, 8, 16, 32)
+    assert cfg.serve_watermark_resolved == int(0.9 * cfg.serve_queue_depth)
+    # from_json round-trip re-normalizes the JSON list back to a tuple.
+    assert Config.from_json(cfg.to_json()).serve_buckets == cfg.serve_buckets
+    with pytest.raises(ValueError):
+        Config(serve_buckets=())
+    with pytest.raises(ValueError):
+        Config(serve_buckets=(0, 4))
+    with pytest.raises(ValueError):
+        Config(serve_queue_depth=4)  # cannot hold one largest-bucket batch
+    with pytest.raises(ValueError):
+        Config(serve_watermark=10_000)
