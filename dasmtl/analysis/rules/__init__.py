@@ -55,6 +55,6 @@ def all_rules() -> List[Rule]:
     # this one without a cycle.
     from dasmtl.analysis.rules import (donation, dtype,  # noqa: F401
                                        host_sync, hygiene, loops, prng,
-                                       tracing)
+                                       serve_sync, tracing)
 
     return [r for _, r in sorted(_REGISTRY.items())]

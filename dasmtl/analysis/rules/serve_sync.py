@@ -1,0 +1,87 @@
+"""Serving data-plane synchronization rule.
+
+DAS111 — a blocking device->host sync inside ``dasmtl/serve/`` outside the
+designated ``collect()`` point.  The pipelined serve loop stays ahead of
+the device ONLY while nothing on the dispatch path blocks: one stray
+``jax.device_get`` / ``.block_until_ready()`` (or a numpy conversion of a
+device array, which syncs implicitly) re-serializes host and device and
+silently halves throughput — the serving twin of DAS101's step-path
+discipline.  The package carries exactly one suppression, on the single
+legal sync inside :meth:`dasmtl.serve.executor.InferExecutor.collect`.
+
+Scope (docs/STATIC_ANALYSIS.md): every function in every module under
+``dasmtl/serve/`` — not just jit-reachable code, because in serving the
+sync cost is paid on the HOST thread, outside any trace.  Numpy
+conversions are flagged when their argument syntactically contains a
+``jax.*`` call or an executor dispatch (``self._fn(...)``): converting a
+fresh device value is always a sync, while ``np.asarray`` over host
+request payloads stays legal.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dasmtl.analysis.lint import ModuleContext
+from dasmtl.analysis.rules import make_finding, rule
+
+#: Calls that block the host on device work, wherever they appear.
+_BLOCKING_CALLS = frozenset({"jax.device_get", "jax.block_until_ready"})
+
+#: Methods that block when invoked on a (device) array.
+_BLOCKING_METHODS = frozenset({"block_until_ready"})
+
+#: Numpy conversions that force a D2H copy when fed a device value.
+_NUMPY_CONVERSIONS = frozenset({"numpy.asarray", "numpy.array",
+                                "numpy.copy"})
+
+
+def _in_serve_package(path: str) -> bool:
+    return "dasmtl/serve/" in path.replace("\\", "/")
+
+
+def _mentions_device_value(ctx: ModuleContext, node: ast.AST) -> bool:
+    """Does the expression contain a ``jax.*`` call or an executor
+    dispatch (``self._fn(...)`` / ``*.call(...)``) — i.e. is its value
+    fresh off the device?"""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = ctx.resolve(sub.func)
+        if name is not None and name.split(".")[0] == "jax":
+            return True
+        if (isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in ("_fn", "call")):
+            return True
+    return False
+
+
+@rule("DAS111", "error",
+      "blocking host sync in dasmtl/serve/ outside the designated "
+      "collect() point")
+def check_serve_sync(ctx: ModuleContext):
+    if not _in_serve_package(ctx.path):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = ctx.resolve(node.func)
+        if name in _BLOCKING_CALLS:
+            yield make_finding(
+                ctx, "DAS111", node,
+                f"{name} blocks the serve data plane — the only legal "
+                f"host sync is InferExecutor.collect() (route results "
+                f"through the collector thread)")
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr in _BLOCKING_METHODS):
+            yield make_finding(
+                ctx, "DAS111", node,
+                f".{node.func.attr}() blocks the serve data plane — "
+                f"collect() is the designated sync point")
+        elif (name in _NUMPY_CONVERSIONS
+              and any(_mentions_device_value(ctx, a) for a in node.args)):
+            yield make_finding(
+                ctx, "DAS111", node,
+                f"{name} over a device value forces an implicit D2H "
+                f"sync on the dispatch path — pull results through "
+                f"collect() instead")

@@ -153,6 +153,16 @@ class Config:
     serve_watermark: Optional[int] = None  # None = 90% of queue depth
     serve_host: str = "127.0.0.1"
     serve_port: int = 8321
+    # Pipelined data plane (PR 5): how many batches may be dispatched but
+    # not yet collected at once (>= 2 overlaps batch i+1's assembly with
+    # batch i's device compute; 1 degrades to the old serial loop), how
+    # many devices the executor pool spans (-1 = all visible; one warmed
+    # executable per (bucket, device), round-robin placement), and whether
+    # a largest-bucket batch runs mesh-sharded over the WHOLE pool instead
+    # of on one device (dp NamedSharding, replicated params).
+    serve_inflight: int = 2
+    serve_devices: int = -1
+    serve_shard_largest: bool = False
 
     # ---- misc ----
     seed: int = 1
@@ -201,6 +211,13 @@ class Config:
             raise ValueError(
                 f"serve_watermark {self.serve_watermark} outside "
                 f"[1, serve_queue_depth={self.serve_queue_depth}]")
+        if self.serve_inflight < 1:
+            raise ValueError("serve_inflight must be >= 1 (1 = serial "
+                             "dispatch, >= 2 pipelines)")
+        if self.serve_devices < 1 and self.serve_devices != -1:
+            raise ValueError(f"serve_devices must be a positive device "
+                             f"count or -1 (all visible), got "
+                             f"{self.serve_devices}")
 
     @property
     def decay_at_epoch0(self) -> bool:
@@ -454,6 +471,18 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
                         "(default: 90%% of --serve_queue_depth)")
     p.add_argument("--serve_host", type=str, default=d.serve_host)
     p.add_argument("--serve_port", type=int, default=d.serve_port)
+    p.add_argument("--serve_inflight", type=int, default=d.serve_inflight,
+                   help="serving pipeline depth: batches dispatched but "
+                        "not yet collected (>= 2 overlaps host batch "
+                        "assembly with device compute)")
+    p.add_argument("--serve_devices", type=int, default=d.serve_devices,
+                   help="serving executor-pool size (-1 = all visible "
+                        "devices; one warmed executable per bucket per "
+                        "device, round-robin placement)")
+    p.add_argument("--serve_shard_largest", action=_CompatBoolAction,
+                   default=d.serve_shard_largest,
+                   help="run largest-bucket serve batches mesh-sharded "
+                        "over the whole pool instead of on one device")
 
 
 def _resolve_compat(ns: argparse.Namespace) -> dict:

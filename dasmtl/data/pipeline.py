@@ -85,7 +85,18 @@ def prefetch(iterator: Iterator, depth: int = 2,
         if failure:
             raise failure[0]
     finally:
+        # Consumer abandoned the iterator early (break -> GeneratorExit,
+        # or explicit close()): tell the worker to stop, drain whatever it
+        # already queued so a blocked put() can observe the flag, and JOIN
+        # it — an abandoned epoch must not leave a live dasmtl-prefetch
+        # thread gathering batches nobody will read.
         stop.set()
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        thread.join(timeout=5.0)
 
 
 #: Padding fill value per batch key.  Anything not listed pads with zeros;

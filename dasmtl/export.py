@@ -66,6 +66,45 @@ def make_infer_fn(spec, state) -> Callable:
     return infer
 
 
+def nonfinite_rows(out):
+    """Per-row finite-rejection mask over the ``log_probs_*`` heads:
+    ``mask[j]`` is True when ANY head's row ``j`` holds NaN/Inf.
+
+    Jittable (one fused reduction per head, no host sync) — the on-device
+    half of the serving SAN202 contract: decode happens on device, so the
+    host only ever pulls int predictions plus this bool vector instead of
+    the full per-head log-probability tensors.
+    """
+    import jax.numpy as jnp
+
+    heads = [v for k, v in sorted(out.items())
+             if k.startswith("log_probs_")]
+    if not heads:
+        first = next(iter(out.values()))
+        return jnp.zeros((first.shape[0],), jnp.bool_)
+    bad = jnp.zeros((heads[0].shape[0],), jnp.bool_)
+    for v in heads:
+        bad = bad | ~jnp.isfinite(v.reshape(v.shape[0], -1)).all(axis=1)
+    return bad
+
+
+def make_serve_infer_fn(spec, state) -> Callable:
+    """:func:`make_infer_fn` with the serving D2H contract fused in: the
+    output dict additionally carries ``bad_rows`` (:func:`nonfinite_rows`
+    computed INSIDE the compiled forward).  The serving executor then
+    transfers only the decoded int predictions and that bool vector per
+    batch; the ``log_probs_*`` heads stay device-resident and are pulled
+    only when a request explicitly asks for them."""
+    infer = make_infer_fn(spec, state)
+
+    def serve_infer(x):
+        out = infer(x)
+        out["bad_rows"] = nonfinite_rows(out)
+        return out
+
+    return serve_infer
+
+
 def export_infer(spec, state, *, input_hw=(100, 250),
                  platforms=("cpu", "tpu", "axon"),
                  disable_platform_check=False):

@@ -74,6 +74,15 @@ def replicated_sharding(plan: MeshPlan) -> NamedSharding:
     return NamedSharding(plan.mesh, P())
 
 
+def infer_batch_sharding(plan: MeshPlan) -> NamedSharding:
+    """Layout of one ``(bucket, h, w, 1)`` inference batch over the dp
+    axis — what the serving executor pool uses for its largest bucket
+    when a single batch is worth splitting across the whole mesh
+    (params replicated, rows partitioned; GSPMD inserts nothing for an
+    eval-mode forward because rows are independent)."""
+    return NamedSharding(plan.mesh, P("dp", None, None, None))
+
+
 def shard_batch(plan: MeshPlan, batch: dict) -> dict:
     """Place a host batch onto the mesh with the canonical layout."""
     shardings = batch_sharding(plan)

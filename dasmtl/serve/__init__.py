@@ -7,13 +7,17 @@ The offline surfaces (``dasmtl-stream``: sweep a recorded matrix;
 deployment layer (docs/SERVING.md):
 
 - :mod:`~dasmtl.serve.queue` — bounded deadline queue, load shedding;
-- :mod:`~dasmtl.serve.batcher` — micro-batch coalescing + bucket padding;
-- :mod:`~dasmtl.serve.executor` — one compiled executable per bucket,
-  warmup-compiled, recompile-guarded, per-request NaN rejection;
-- :mod:`~dasmtl.serve.server` — dispatcher thread, graceful drain,
-  stdlib HTTP front end;
+- :mod:`~dasmtl.serve.batcher` — micro-batch coalescing + bucket padding
+  into preallocated host staging buffers;
+- :mod:`~dasmtl.serve.executor` — one compiled executable per
+  (bucket, device), warmup-compiled, recompile-guarded per device,
+  async ``dispatch``/``collect`` split, on-device decode + per-request
+  NaN rejection, round-robin :class:`ExecutorPool` over ``jax.devices()``;
+- :mod:`~dasmtl.serve.server` — pipelined dispatcher + collector threads
+  under a bounded in-flight window, graceful drain, stdlib HTTP front
+  end;
 - :mod:`~dasmtl.serve.metrics` — latency percentiles, batch occupancy,
-  shed/reject counters.
+  per-stage pipeline timings, shed/reject counters.
 
 Entry points: ``dasmtl-serve`` / ``dasmtl serve`` /
 ``python -m dasmtl.serve``.  In-process use::
@@ -28,15 +32,17 @@ jax only loads when an executor is built — importing the package (or
 parsing the CLI) touches no backend.
 """
 
-from dasmtl.serve.batcher import BatchPlan, MicroBatcher, choose_bucket
-from dasmtl.serve.executor import InferExecutor
+from dasmtl.serve.batcher import (BatchPlan, MicroBatcher, StagingBuffers,
+                                  choose_bucket)
+from dasmtl.serve.executor import ExecutorPool, InferExecutor, InflightBatch
 from dasmtl.serve.metrics import ServeMetrics
 from dasmtl.serve.queue import QueueClosed, Request, RequestQueue, ServeResult
 from dasmtl.serve.server import (ServeLoop, install_signal_handlers,
                                  make_http_server)
 
 __all__ = [
-    "BatchPlan", "MicroBatcher", "choose_bucket", "InferExecutor",
+    "BatchPlan", "MicroBatcher", "StagingBuffers", "choose_bucket",
+    "ExecutorPool", "InferExecutor", "InflightBatch",
     "ServeMetrics", "QueueClosed", "Request", "RequestQueue", "ServeResult",
     "ServeLoop", "install_signal_handlers", "make_http_server",
 ]

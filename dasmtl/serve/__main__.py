@@ -52,6 +52,19 @@ def main(argv=None) -> int:
                         "(default: 90%% of --queue_depth)")
     p.add_argument("--host", type=str, default=d.serve_host)
     p.add_argument("--port", type=int, default=d.serve_port)
+    p.add_argument("--inflight", type=int, default=d.serve_inflight,
+                   help="pipeline depth: batches dispatched but not yet "
+                        "collected (>= 2 overlaps batch assembly with "
+                        "device compute; 1 = serial)")
+    p.add_argument("--devices", type=int, default=d.serve_devices,
+                   help="executor-pool size (-1 = all visible devices); "
+                        "batches round-robin across one warmed executable "
+                        "per (bucket, device)")
+    p.add_argument("--shard_largest", action="store_true",
+                   default=d.serve_shard_largest,
+                   help="run largest-bucket batches mesh-sharded over the "
+                        "whole pool (dp NamedSharding) instead of on one "
+                        "device")
     p.add_argument("--device", type=str, default="auto",
                    choices=["tpu", "cpu", "auto"])
     p.add_argument("--selftest", action="store_true",
@@ -60,6 +73,10 @@ def main(argv=None) -> int:
                         "0/1 — no network, CI-safe on CPU")
     p.add_argument("--selftest_requests", type=int, default=512)
     p.add_argument("--selftest_clients", type=int, default=8)
+    p.add_argument("--selftest_devices", type=int, default=1,
+                   help="executor-pool size for the selftest (use "
+                        "XLA_FLAGS=--xla_force_host_platform_device_count="
+                        "N for N virtual CPU devices)")
     args = p.parse_args(argv)
 
     from dasmtl.utils.platform import apply_device
@@ -67,10 +84,14 @@ def main(argv=None) -> int:
     apply_device(args.device)
 
     if args.selftest:
-        from dasmtl.serve.selftest import run_selftest
+        from dasmtl.serve.selftest import run_selftest, write_job_summary
 
         report = run_selftest(requests=args.selftest_requests,
-                              clients=args.selftest_clients)
+                              clients=args.selftest_clients,
+                              devices=args.selftest_devices,
+                              inflight=args.inflight)
+        # CI publishes warmup seconds + per-device compile counts.
+        write_job_summary(report)
         return 0 if report["passed"] else 1
 
     if bool(args.exported) == bool(args.model_path):
@@ -89,33 +110,36 @@ def main(argv=None) -> int:
         except ValueError:
             p.error(f"--window must look like 100x250, got {args.window!r}")
 
-    from dasmtl.serve.executor import InferExecutor
+    from dasmtl.serve.executor import ExecutorPool
     from dasmtl.serve.server import (ServeLoop, install_signal_handlers,
                                      make_http_server)
 
     # Input-spec compatibility is a STARTUP error (the doctor-style check):
     # an artifact exported for a different window must never reach traffic.
     if args.exported:
-        executor = InferExecutor.from_exported(args.exported, buckets,
-                                               expected_hw=window)
+        executor = ExecutorPool.from_exported(
+            args.exported, buckets, expected_hw=window,
+            devices=args.devices, shard_largest=args.shard_largest)
     else:
-        executor = InferExecutor.from_checkpoint(args.model,
-                                                 args.model_path, buckets,
-                                                 input_hw=window)
+        executor = ExecutorPool.from_checkpoint(
+            args.model, args.model_path, buckets, input_hw=window,
+            devices=args.devices, shard_largest=args.shard_largest)
     loop = ServeLoop(executor, buckets=buckets,
                      max_wait_s=args.max_wait_ms / 1e3,
                      queue_depth=args.queue_depth,
-                     watermark=args.watermark)
+                     watermark=args.watermark,
+                     inflight=args.inflight)
     print(f"warming {len(buckets)} bucket(s) "
           f"{list(buckets)} on {executor.input_hw[0]}x"
-          f"{executor.input_hw[1]} windows ...", file=sys.stderr)
+          f"{executor.input_hw[1]} windows across "
+          f"{len(executor.executors)} device(s) ...", file=sys.stderr)
     loop.start()
     httpd = make_http_server(loop, args.host, args.port)
     host, port = httpd.server_address[:2]
     print(f"serving {executor.source} on http://{host}:{port} "
           f"(POST /infer, GET /healthz, GET /stats); warmup "
-          f"{loop.stats()['warmup_s']:.2f}s; SIGTERM drains",
-          file=sys.stderr)
+          f"{loop.stats()['warmup_s']:.2f}s; in-flight window "
+          f"{loop.inflight_window}; SIGTERM drains", file=sys.stderr)
 
     # SIGTERM/SIGINT: refuse new work, let the dispatcher finish what is
     # queued, then stop accepting connections.  shutdown() must not run in

@@ -57,14 +57,71 @@ class BatchPlan:
     def n_real(self) -> int:
         return len(self.requests)
 
+    @property
+    def want_log_probs(self) -> bool:
+        """True when ANY member request asked for log-probs — the whole
+        batch's collect then pulls the full heads in its one sync."""
+        return any(r.want_log_probs for r in self.requests)
+
+    def assemble_into(self, buf: np.ndarray) -> np.ndarray:
+        """Write the padded batch into a preallocated ``(bucket, h, w, 1)``
+        host staging buffer: real rows copied in place, padding rows
+        zeroed — the same weight-0/zeros convention as
+        :func:`pad_to_bucket`, without the per-batch ``np.stack`` +
+        ``np.concatenate`` allocations the old path paid twice per
+        flush."""
+        if buf.shape[0] != self.bucket:
+            raise ValueError(f"staging buffer holds {buf.shape[0]} rows, "
+                             f"plan bucket is {self.bucket}")
+        for j, r in enumerate(self.requests):
+            buf[j, ..., 0] = r.x
+        if len(self.requests) < self.bucket:
+            buf[len(self.requests):] = 0.0
+        return buf
+
     def assemble(self) -> np.ndarray:
         """``(bucket, h, w, 1) float32`` — real rows then zero padding,
         through the same :func:`pad_to_bucket` as the training pipeline,
         so a partial batch is shape-identical to a full one (no
-        recompiles)."""
+        recompiles).  Allocating convenience for non-pipelined callers;
+        the serve loop assembles into staging buffers instead."""
         x = np.stack([np.asarray(r.x, np.float32) for r in self.requests])
         batch = pad_to_bucket({"x": x[..., None]}, self.bucket)
         return batch["x"]
+
+
+class StagingBuffers:
+    """Preallocated per-bucket host batches for the pipelined data plane.
+
+    ``jax.Array`` construction on some backends may alias or lazily read a
+    host buffer, so a staging buffer must not be rewritten while its batch
+    could still be reading it: each bucket keeps ``depth`` buffers on a
+    freelist, acquired at batch-form time and released only after the
+    batch's collect.  With the serve loop's in-flight window of ``W``,
+    ``depth = W + 1`` (one extra for the batch being formed) makes
+    ``acquire`` effectively non-blocking; the blocking wait below is the
+    correctness backstop, not the steady state.
+    """
+
+    def __init__(self, buckets: Sequence[int], input_hw, depth: int):
+        h, w = int(input_hw[0]), int(input_hw[1])
+        self.depth = max(1, int(depth))
+        self._lock = threading.Lock()
+        self._available = threading.Condition(self._lock)
+        self._free = {int(b): [np.zeros((int(b), h, w, 1), np.float32)
+                               for _ in range(self.depth)]
+                      for b in buckets}
+
+    def acquire(self, bucket: int) -> np.ndarray:
+        with self._available:
+            while not self._free[bucket]:
+                self._available.wait()
+            return self._free[bucket].pop()
+
+    def release(self, bucket: int, buf: np.ndarray) -> None:
+        with self._available:
+            self._free[bucket].append(buf)
+            self._available.notify()
 
 
 class MicroBatcher:
@@ -92,7 +149,8 @@ class MicroBatcher:
 
     # -- admission -----------------------------------------------------------
     def submit(self, x: np.ndarray, now: Optional[float] = None,
-               max_wait_s: Optional[float] = None) -> "Request":
+               max_wait_s: Optional[float] = None,
+               want_log_probs: bool = False) -> "Request":
         """Admit one window; the returned request's ``future`` resolves to
         a :class:`ServeResult`.  Refusals (shed / draining) resolve the
         future before returning — the caller never distinguishes."""
@@ -101,7 +159,8 @@ class MicroBatcher:
         self.metrics.observe_submit()
         with self._lock:
             req = Request(id=self._next_id, x=x, enqueue_t=now,
-                          deadline_t=now + wait)
+                          deadline_t=now + wait,
+                          want_log_probs=want_log_probs)
             self._next_id += 1
             try:
                 admitted = self._queue.offer(req)
@@ -113,6 +172,14 @@ class MicroBatcher:
                 self._refuse(req, "shed",
                              f"queue at watermark "
                              f"({self._queue.watermark}) — retry later")
+                return req
+            # Did this admission change the flush schedule?  Only a
+            # size-cap trip or a new earliest deadline (incl. the first
+            # pending request) needs to wake the dispatcher — per-submit
+            # notify_all churn is measurable at high request rates.
+            req.wake_dispatcher = (
+                len(self._queue) >= self.buckets[-1]
+                or self._queue.peek_deadline() >= req.deadline_t)
         return req
 
     def _refuse(self, req: Request, error: str, detail: str) -> None:

@@ -1,34 +1,62 @@
-"""Bucketed inference executor: one compiled executable per batch shape.
+"""Bucketed inference executors: async dispatch, on-device decode, pools.
 
-Wraps either a self-contained StableHLO artifact
-(:func:`dasmtl.export.deserialize_exported`) or an in-framework checkpoint
-forward (:func:`dasmtl.export.make_infer_fn` under ``jax.jit``) behind one
-contract:
+The serve data plane's device layer, in three pieces:
 
-    preds, bad_rows = executor.run(x)    # x: (bucket, h, w, 1) float32
+- :class:`InferExecutor` — one compiled executable per batch shape on ONE
+  placement (a device, or a ``NamedSharding`` over a mesh).  The old
+  blocking ``run(x)`` is split into the pipeline pair
 
-- **warmup** runs a zero batch through every configured bucket size, so
-  every shape the batcher can emit is compiled before the server accepts
-  traffic;
-- the recompile counter from :mod:`dasmtl.analysis.guards` wraps every
-  call — a compilation landing after warmup raises
-  :class:`~dasmtl.analysis.guards.RecompileError` (a bucket miss is a
-  bug, not a slow path);
-- **per-request NaN rejection** — ``bad_rows[j]`` is True when request
-  ``j``'s outputs hold NaN/Inf.  In eval mode (BN running stats, no
-  dropout) rows are independent through the network, so a poisoned window
-  condemns only itself: the serving-path SAN202 probe
-  (docs/STATIC_ANALYSIS.md) at per-request granularity, via the same
-  ``log_probs_*`` heads the export contract guarantees on every model
-  family.  The decoded argmax of NaN logits is a confidently wrong
-  integer — rejection must happen here, not downstream.
+      handle = executor.dispatch(x)     # enqueue, return device buffers
+      preds, bad, lp = executor.collect(handle)   # the ONE legal host sync
+
+  ``dispatch`` returns as soon as JAX's async dispatch has enqueued the
+  compiled call — the host is free to form and launch the next batch
+  while this one computes.  ``collect`` is the single designated
+  device->host synchronization of the whole serve package (lint rule
+  DAS111 flags any other blocking sync under ``dasmtl/serve/``).
+
+- **on-device decode** — the compiled forward already argmax-decodes each
+  head and (via :func:`dasmtl.export.nonfinite_rows`) computes the
+  per-row finite-rejection mask ``bad_rows`` in-graph, so the steady-state
+  D2H transfer is int predictions plus one bool vector per batch instead
+  of the full ``log_probs_*`` tensors.  The log-prob heads stay
+  device-resident and are pulled only when a request asks
+  (``collect(handle, want_log_probs=True)``).
+
+- :class:`ExecutorPool` — one warmed executor per device with round-robin
+  batch placement over ``jax.devices()`` (replicated params), plus an
+  optional mesh-sharded executor for the largest bucket
+  (:func:`dasmtl.parallel.mesh.infer_batch_sharding`).  Each pool member
+  keeps its own :class:`~dasmtl.analysis.guards.StepGuards` recompile
+  counter, so the zero-post-warmup-recompile invariant holds *per
+  device*.
+
+Per-request NaN rejection semantics are unchanged from PR 4: in eval mode
+(BN running stats, no dropout) rows are independent through the network,
+so a poisoned window condemns only itself — the serving-path SAN202 probe
+(docs/STATIC_ANALYSIS.md), now evaluated on device where the argmax of
+NaN logits would otherwise leave as a confidently wrong integer.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional, Sequence, Tuple
+import dataclasses
+import time
+from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple)
 
 import numpy as np
+
+
+@dataclasses.dataclass
+class InflightBatch:
+    """One dispatched batch: device output buffers plus routing info.
+    Opaque to callers — hand it back to ``collect`` (the executor that
+    dispatched it is recorded, so a pool routes collection for free)."""
+
+    outputs: Dict[str, Any]  # device arrays: <task> ints, bad_rows, log_probs_*
+    bucket: int
+    executor: "InferExecutor"
+    dispatch_s: float = 0.0  # host time inside dispatch (H2D + enqueue)
 
 
 class InferExecutor:
@@ -36,19 +64,27 @@ class InferExecutor:
 
     def __init__(self, infer_fn: Callable, input_hw: Tuple[int, int],
                  buckets: Sequence[int], *, jit: bool = True,
-                 strict_recompile: bool = True, source: str = "fn"):
+                 strict_recompile: bool = True, source: str = "fn",
+                 placement: Optional[Any] = None):
         import jax
 
         from dasmtl.analysis.guards import StepGuards
 
         self._fn = jax.jit(infer_fn) if jit else infer_fn
+        # The separately-jitted decode tail for computations whose body is
+        # fixed (an exported artifact cannot grow a bad_rows output):
+        # runs over the artifact's device outputs, so nothing transfers.
+        self._mask_fn = None
         self.input_hw = (int(input_hw[0]), int(input_hw[1]))
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.source = source
+        self.placement = placement  # jax.Device | Sharding | None (default)
         self._warm = False
-        # Warmup legitimately compiles once per bucket; anything after
-        # that is a bucket miss.  transfer="off": serving feeds host numpy
-        # batches by design (the H2D copy is the declared input path).
+        self.warmup_compiles = 0
+        # Warmup legitimately compiles once per bucket (twice on the
+        # exported path: artifact + decode tail); anything after that is a
+        # bucket miss.  transfer="off": serving feeds host numpy batches
+        # by design (the H2D copy is the declared input path).
         self._guards = StepGuards(warmup_steps=len(self.buckets),
                                   transfer="off",
                                   recompile_check=strict_recompile)
@@ -84,56 +120,89 @@ class InferExecutor:
                         **kw) -> "InferExecutor":
         """Serve an in-framework forward: build the model, restore weights
         (``model_path=None`` keeps fresh-init weights — selftest/bench),
-        jit :func:`~dasmtl.export.make_infer_fn`."""
-        from dasmtl.config import INPUT_HEIGHT, INPUT_WIDTH, Config
-        from dasmtl.export import make_infer_fn
-        from dasmtl.main import build_state
-        from dasmtl.models.registry import get_model_spec
-
-        hw = tuple(input_hw or (INPUT_HEIGHT, INPUT_WIDTH))
-        cfg = Config(model=model)
-        spec = get_model_spec(cfg.model)
-        state = build_state(cfg, spec, input_hw=hw)
-        if model_path:
-            from dasmtl.train.checkpoint import restore_weights
-
-            state = restore_weights(state, model_path)
-        return cls(make_infer_fn(spec, state), hw, buckets,
+        jit :func:`~dasmtl.export.make_serve_infer_fn` (decode + finite
+        mask fused into the executable)."""
+        fn, hw = _checkpoint_serve_fn(model, model_path, input_hw)
+        return cls(fn, hw, buckets,
                    source=f"checkpoint:{model_path or 'fresh-init'}", **kw)
 
     # -- execution -----------------------------------------------------------
     def warmup(self) -> float:
         """Compile every bucket shape; returns wall seconds spent.  After
-        this, a compilation inside ``run`` raises."""
-        import time
-
+        this, a compilation inside ``dispatch`` raises.  Per-executor
+        compile counts land in ``warmup_compiles`` (the pool publishes
+        them per device)."""
         h, w = self.input_hw
         t0 = time.perf_counter()
+        before = self._guards.compiles
         for b in self.buckets:
             self.run(np.zeros((b, h, w, 1), np.float32))
         self._warm = True
+        self.warmup_compiles = self._guards.compiles - before
         return time.perf_counter() - t0
 
-    def run(self, x: np.ndarray
-            ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
-        """One batch through the compiled forward.  ``x.shape[0]`` must be
-        a configured bucket.  Returns decoded per-task integer predictions
-        plus the per-row non-finite rejection mask."""
+    def dispatch(self, x: np.ndarray) -> InflightBatch:
+        """Enqueue one batch through the compiled forward and return its
+        device output buffers WITHOUT waiting for the computation.
+        ``x.shape[0]`` must be a configured bucket.  Compilation is
+        synchronous with dispatch, so the per-device recompile guard
+        wraps exactly this call."""
         if x.shape[0] not in self.buckets:
             raise ValueError(f"batch of {x.shape[0]} is not a configured "
                              f"bucket {self.buckets}")
         import jax
 
+        t0 = time.perf_counter()
+        if self.placement is not None:
+            # The declared H2D path: committed inputs route the compiled
+            # call onto this executor's device (or mesh sharding).
+            x = jax.device_put(x, self.placement)
         with self._guards.step():
-            out = self._fn(x)
-        out = {k: np.asarray(jax.device_get(v)) for k, v in out.items()}
-        bad = np.zeros((x.shape[0],), bool)
-        preds = {}
-        for k, v in out.items():
+            out = dict(self._fn(x))
+            if "bad_rows" not in out:
+                # Fixed computation (exported artifact): run the decode
+                # tail as its own tiny jitted program over the device
+                # outputs — still no host transfer.
+                if self._mask_fn is None:
+                    from dasmtl.export import nonfinite_rows
+
+                    self._mask_fn = jax.jit(nonfinite_rows)
+                out["bad_rows"] = self._mask_fn(
+                    {k: v for k, v in out.items()
+                     if k.startswith("log_probs_")})
+        return InflightBatch(outputs=out, bucket=int(x.shape[0]),
+                             executor=self,
+                             dispatch_s=time.perf_counter() - t0)
+
+    def collect(self, batch: InflightBatch, want_log_probs: bool = False
+                ) -> Tuple[Dict[str, np.ndarray], np.ndarray,
+                           Optional[Dict[str, np.ndarray]]]:
+        """THE designated host sync of the serve data plane: block on the
+        batch's small decoded outputs (int predictions + bool mask) and
+        pull them host-side in one transfer.  ``want_log_probs`` adds the
+        full per-head log-probabilities to that same single sync — the
+        only way log-probs ever cross D2H."""
+        out = batch.outputs
+        pull = {k: v for k, v in out.items()
+                if want_log_probs or not k.startswith("log_probs_")}
+        import jax
+
+        host = jax.device_get(pull)  # dasmtl: noqa[DAS111] — the one legal serve sync point
+        bad = np.asarray(host.pop("bad_rows"), bool)
+        preds, log_probs = {}, ({} if want_log_probs else None)
+        for k, v in host.items():
             if k.startswith("log_probs_"):
-                bad |= ~np.isfinite(v.reshape(v.shape[0], -1)).all(axis=1)
+                log_probs[k] = np.asarray(v)
             else:
-                preds[k] = v
+                preds[k] = np.asarray(v)
+        return preds, bad, log_probs
+
+    def run(self, x: np.ndarray
+            ) -> Tuple[Dict[str, np.ndarray], np.ndarray]:
+        """``dispatch`` + ``collect`` in one blocking call — warmup and
+        simple non-pipelined callers.  Returns decoded per-task integer
+        predictions plus the per-row non-finite rejection mask."""
+        preds, bad, _ = self.collect(self.dispatch(x))
         return preds, bad
 
     # -- reporting / lifecycle -----------------------------------------------
@@ -143,7 +212,203 @@ class InferExecutor:
 
     def compile_summary(self) -> dict:
         return {"buckets": list(self.buckets), "warm": self._warm,
-                "source": self.source, **self._guards.summary()}
+                "source": self.source,
+                "placement": _placement_name(self.placement),
+                "warmup_compiles": self.warmup_compiles,
+                **self._guards.summary()}
 
     def close(self) -> None:
         self._guards.__exit__(None, None, None)
+
+
+def _placement_name(placement) -> Optional[str]:
+    if placement is None:
+        return None
+    if hasattr(placement, "mesh"):  # NamedSharding
+        return f"mesh:{'x'.join(str(s) for s in placement.mesh.devices.shape)}"
+    return str(placement)
+
+
+def _checkpoint_serve_fn(model: str, model_path: Optional[str],
+                         input_hw: Optional[Tuple[int, int]]):
+    """Build the fused serve forward (decode + finite mask on device) for
+    a checkpoint, ONCE — the pool shares it across every device member."""
+    from dasmtl.config import INPUT_HEIGHT, INPUT_WIDTH, Config
+    from dasmtl.export import make_serve_infer_fn
+    from dasmtl.main import build_state
+    from dasmtl.models.registry import get_model_spec
+
+    hw = tuple(input_hw or (INPUT_HEIGHT, INPUT_WIDTH))
+    cfg = Config(model=model)
+    spec = get_model_spec(cfg.model)
+    state = build_state(cfg, spec, input_hw=hw)
+    if model_path:
+        from dasmtl.train.checkpoint import restore_weights
+
+        state = restore_weights(state, model_path)
+    return make_serve_infer_fn(spec, state), hw
+
+
+class ExecutorPool:
+    """One warmed :class:`InferExecutor` per device, round-robin placement.
+
+    The pool presents the exact executor protocol :class:`ServeLoop`
+    speaks (``warmup`` / ``dispatch`` / ``collect`` / ``close`` /
+    ``compile_summary``), so a loop is device-count agnostic.  Batches
+    round-robin across members (replicated params — each device compiled
+    its own executable of the same forward at warmup); with
+    ``shard_largest`` a batch at the largest bucket instead runs through
+    one mesh-sharded executable over ALL pool devices
+    (``NamedSharding`` over the dp axis), which is the right trade when
+    arrival bursts fill the top rung and per-device latency matters more
+    than per-device independence.
+
+    Collection routes through the member that dispatched the batch
+    (recorded on the handle), so per-device recompile counters stay
+    exact: 0 post-warmup compiles is asserted on EVERY pool device.
+    """
+
+    def __init__(self, executors: List[InferExecutor],
+                 shard_executor: Optional[InferExecutor] = None):
+        if not executors:
+            raise ValueError("a pool needs at least one executor")
+        hw = {e.input_hw for e in executors}
+        bk = {e.buckets for e in executors}
+        if len(hw) > 1 or len(bk) > 1:
+            raise ValueError(f"pool members disagree: windows {hw}, "
+                             f"buckets {bk}")
+        self.executors = list(executors)
+        self.shard_executor = shard_executor
+        self.input_hw = executors[0].input_hw
+        self.buckets = executors[0].buckets
+        self.source = getattr(executors[0], "source", "fn")
+        self._rr = 0
+
+    # -- constructors --------------------------------------------------------
+    @classmethod
+    def _pool_devices(cls, devices) -> list:
+        import jax
+
+        if devices is None or devices == -1:
+            return list(jax.devices())
+        if isinstance(devices, int):
+            avail = jax.devices()
+            if not 1 <= devices <= len(avail):
+                raise ValueError(f"pool of {devices} devices requested, "
+                                 f"{len(avail)} visible")
+            return list(avail[:devices])
+        return list(devices)
+
+    @classmethod
+    def _build(cls, make_executor, hw, buckets, devices, shard_largest,
+               **kw) -> "ExecutorPool":
+        devs = cls._pool_devices(devices)
+        executors = [make_executor(d, **kw) for d in devs]
+        shard_ex = None
+        largest = max(int(b) for b in buckets)
+        if shard_largest and len(devs) > 1:
+            if largest % len(devs):
+                raise ValueError(
+                    f"shard_largest needs the largest bucket ({largest}) "
+                    f"divisible by the pool size ({len(devs)})")
+            from dasmtl.parallel.mesh import create_mesh, infer_batch_sharding
+
+            plan = create_mesh(dp=len(devs), sp=1, devices=devs)
+            shard_ex = make_executor(infer_batch_sharding(plan),
+                                     buckets=(largest,), **kw)
+        return cls(executors, shard_ex)
+
+    @classmethod
+    def from_checkpoint(cls, model: str, model_path: Optional[str],
+                        buckets: Sequence[int],
+                        input_hw: Optional[Tuple[int, int]] = None,
+                        devices=None, shard_largest: bool = False,
+                        **kw) -> "ExecutorPool":
+        """Pool over a checkpoint forward: the model is built and the
+        weights restored ONCE; every member jits the same fused serve
+        forward onto its own device."""
+        fn, hw = _checkpoint_serve_fn(model, model_path, input_hw)
+        src = f"checkpoint:{model_path or 'fresh-init'}"
+
+        def make(placement, buckets=tuple(buckets)):
+            return InferExecutor(fn, hw, buckets, source=src,
+                                 placement=placement, **kw)
+
+        return cls._build(make, hw, buckets, devices, shard_largest)
+
+    @classmethod
+    def from_exported(cls, path: str, buckets: Sequence[int],
+                      expected_hw: Optional[Tuple[int, int]] = None,
+                      devices=None, shard_largest: bool = False,
+                      **kw) -> "ExecutorPool":
+        """Pool over one deserialized StableHLO artifact: the artifact's
+        compiled computation routes to each member's device via committed
+        inputs (validated against ``expected_hw`` before startup, exactly
+        like the single-executor path)."""
+        from dasmtl.export import deserialize_exported, exported_input_hw
+
+        exported = deserialize_exported(path)
+        hw = exported_input_hw(exported)
+        if expected_hw is not None and tuple(expected_hw) != hw:
+            raise ValueError(
+                f"exported artifact {path} takes {hw[0]}x{hw[1]} windows "
+                f"but the configured window is {expected_hw[0]}x"
+                f"{expected_hw[1]} — re-export or fix the window config")
+
+        def make(placement, buckets=tuple(buckets)):
+            return InferExecutor(exported.call, hw, buckets, jit=False,
+                                 source=f"exported:{path}",
+                                 placement=placement, **kw)
+
+        return cls._build(make, hw, buckets, devices, shard_largest)
+
+    # -- execution -----------------------------------------------------------
+    def warmup(self) -> float:
+        """Warm every member (and the mesh executor) serially; total wall
+        seconds.  Serial on purpose: per-member ``warmup_compiles`` deltas
+        stay attributable to their own device."""
+        total = 0.0
+        for ex in self.executors:
+            total += ex.warmup()
+        if self.shard_executor is not None:
+            total += self.shard_executor.warmup()
+        return total
+
+    def dispatch(self, x: np.ndarray) -> InflightBatch:
+        if (self.shard_executor is not None
+                and x.shape[0] == self.buckets[-1]):
+            return self.shard_executor.dispatch(x)
+        ex = self.executors[self._rr % len(self.executors)]
+        self._rr += 1
+        return ex.dispatch(x)
+
+    def collect(self, batch: InflightBatch, want_log_probs: bool = False):
+        return batch.executor.collect(batch, want_log_probs=want_log_probs)
+
+    def run(self, x: np.ndarray):
+        preds, bad, _ = self.collect(self.dispatch(x))
+        return preds, bad
+
+    # -- reporting / lifecycle -----------------------------------------------
+    @property
+    def post_warmup_compiles(self) -> int:
+        members = self.executors + ([self.shard_executor]
+                                    if self.shard_executor else [])
+        return sum(e.post_warmup_compiles for e in members)
+
+    def compile_summary(self) -> dict:
+        per_device = [e.compile_summary() for e in self.executors]
+        out = {"buckets": list(self.buckets), "source": self.source,
+               "pool_size": len(self.executors),
+               "warm": all(p.get("warm", True) for p in per_device),
+               "post_warmup_compiles": self.post_warmup_compiles,
+               "per_device": per_device}
+        if self.shard_executor is not None:
+            out["shard_largest"] = self.shard_executor.compile_summary()
+        return out
+
+    def close(self) -> None:
+        for ex in self.executors:
+            ex.close()
+        if self.shard_executor is not None:
+            self.shard_executor.close()

@@ -42,6 +42,13 @@ class ServeMetrics:
         self._buckets: Dict[int, list] = {}
         # Coarse occupancy histogram over all batches, 10 bins of 10%.
         self._occ_hist = [0] * 10
+        # Per-stage wall time of the pipelined data plane:
+        # stage name -> [count, total_s, max_s].
+        self._stages: Dict[str, list] = {}
+        # Deepest dispatched-but-uncollected point the loop ever reached
+        # (vs the configured in-flight window — the bench smoke asserts
+        # max <= window).
+        self._max_inflight = 0
 
     # -- recording -----------------------------------------------------------
     def observe_submit(self) -> None:
@@ -49,16 +56,38 @@ class ServeMetrics:
             self._submitted += 1
 
     def observe_result(self, outcome: str, latency_s: float) -> None:
-        if outcome not in self._outcomes:
-            outcome = "error"
+        self.observe_results([(outcome, latency_s)])
+
+    def observe_results(self, results) -> None:
+        """Record a whole batch's ``(outcome, latency_s)`` pairs under ONE
+        lock acquisition — the resolve path runs per batch, not per
+        request."""
         with self._lock:
-            self._outcomes[outcome] += 1
-            self._latency_count += 1
-            if len(self._latencies) >= _RESERVOIR:
-                # Overwrite a pseudo-random slot (cheap, lock already held).
-                self._latencies[self._latency_count % _RESERVOIR] = latency_s
-            else:
-                self._latencies.append(latency_s)
+            for outcome, latency_s in results:
+                if outcome not in self._outcomes:
+                    outcome = "error"
+                self._outcomes[outcome] += 1
+                self._latency_count += 1
+                if len(self._latencies) >= _RESERVOIR:
+                    # Overwrite a pseudo-random slot (cheap, lock held).
+                    self._latencies[self._latency_count % _RESERVOIR] = \
+                        latency_s
+                else:
+                    self._latencies.append(latency_s)
+
+    def observe_stage(self, stage: str, seconds: float) -> None:
+        """One per-batch stage measurement (queue_wait / form / dispatch /
+        collect / resolve) — the breakdown behind ``/stats`` and
+        ``BENCH_serve.json``."""
+        with self._lock:
+            rec = self._stages.setdefault(stage, [0, 0.0, 0.0])
+            rec[0] += 1
+            rec[1] += seconds
+            rec[2] = max(rec[2], seconds)
+
+    def observe_inflight(self, depth: int) -> None:
+        with self._lock:
+            self._max_inflight = max(self._max_inflight, depth)
 
     def observe_batch(self, bucket: int, n_real: int) -> None:
         with self._lock:
@@ -76,6 +105,8 @@ class ServeMetrics:
             submitted = self._submitted
             buckets = {b: tuple(v) for b, v in self._buckets.items()}
             occ_hist = list(self._occ_hist)
+            stages = {k: tuple(v) for k, v in self._stages.items()}
+            max_inflight = self._max_inflight
         n_batches = sum(nb for nb, _ in buckets.values())
         real_rows = sum(nr for _, nr in buckets.values())
         slot_rows = sum(b * nb for b, (nb, _) in buckets.items())
@@ -100,4 +131,13 @@ class ServeMetrics:
                              "mean_occupancy": nr / (b * nb) if nb else 0.0}
                     for b, (nb, nr) in sorted(buckets.items())},
             },
+            # Per-batch pipeline stage breakdown (seconds spent per stage;
+            # "collect" folds residual device compute into the D2H wait —
+            # dispatch is async, so the host never observes pure compute).
+            "stages": {
+                name: {"count": c,
+                       "mean_ms": round(total / c * 1e3, 3) if c else 0.0,
+                       "max_ms": round(mx * 1e3, 3)}
+                for name, (c, total, mx) in sorted(stages.items())},
+            "max_inflight_observed": max_inflight,
         }

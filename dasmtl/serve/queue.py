@@ -59,6 +59,10 @@ class ServeResult:
     detail: Optional[str] = None
     latency_s: float = 0.0
     bucket: Optional[int] = None
+    # Per-head log-probabilities for THIS request's row, present only when
+    # the request asked (``want_log_probs``) — the steady-state D2H
+    # contract stays int predictions + a bool mask.
+    log_probs: Optional[Dict[str, list]] = None
 
     @property
     def outcome(self) -> str:
@@ -75,6 +79,13 @@ class Request:
     x: np.ndarray
     enqueue_t: float
     deadline_t: float
+    # Ask for this request's per-head log-probabilities in the answer
+    # (forces the batch's collect to pull the full heads across D2H).
+    want_log_probs: bool = False
+    # Set by the batcher at admission: did this submit change the flush
+    # schedule (size-cap trip / new earliest deadline)?  True by default
+    # so direct constructors stay conservative.
+    wake_dispatcher: bool = True
     future: Future = dataclasses.field(default_factory=Future)
 
     def resolve(self, result: ServeResult) -> None:

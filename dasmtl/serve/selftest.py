@@ -9,17 +9,22 @@ invariants the subsystem exists to provide:
 
 1. every submitted request resolved — with predictions or an explicit
    shed / closed / nonfinite refusal; no drops, no timeouts;
-2. zero post-warmup XLA compilations (every bucket compiled up front;
-   the recompile counter is :mod:`dasmtl.analysis.guards`' — the same
-   instrument the trainer trusts);
+2. zero post-warmup XLA compilations on EVERY pool device (every bucket
+   compiled up front per device; the recompile counter is
+   :mod:`dasmtl.analysis.guards`' — the same instrument the trainer
+   trusts);
 3. mean batch occupancy >= 50% of the active bucket size (the
    power-of-two ladder's structural guarantee);
-4. graceful drain: requests accepted before the SIGTERM all completed,
-   submissions after it all resolved ``closed`` — nothing in flight was
-   dropped.
+4. graceful drain: requests accepted before the SIGTERM all completed —
+   including batches in flight through the pipelined data plane —
+   submissions after it all resolved ``closed``; nothing was dropped;
+5. the bounded in-flight window was honored (max observed depth never
+   exceeded the configured window).
 
-Run via ``python -m dasmtl.serve --selftest`` (the CI serve job) or from
-tests/test_serve_smoke.py.
+``devices`` sizes the executor pool (run under
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` to get N virtual
+CPU devices — the CI serve job runs both 1 and 2).  Run via
+``python -m dasmtl.serve --selftest`` or from tests/test_serve_smoke.py.
 """
 
 from __future__ import annotations
@@ -37,26 +42,30 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
                  max_wait_ms: float = 2.0, queue_depth: int = 64,
                  poison_every: int = 37, model: str = "MTL",
                  use_signal: bool = True, drain_frac: float = 0.7,
+                 devices: int = 1, inflight: int = 2,
                  verbose: bool = True) -> dict:
     """Returns a report dict: ``{"passed": bool, "failures": [...],
     "stats": <ServeLoop.stats()>, ...}``.  ``use_signal=False`` calls
     ``begin_drain`` directly (for callers not on the main thread, where
     ``signal.signal`` is unavailable)."""
-    from dasmtl.serve.executor import InferExecutor
+    from dasmtl.serve.executor import ExecutorPool
     from dasmtl.serve.server import ServeLoop, install_signal_handlers
 
-    executor = InferExecutor.from_checkpoint(model, None, buckets,
-                                             input_hw=input_hw)
+    executor = ExecutorPool.from_checkpoint(model, None, buckets,
+                                            input_hw=input_hw,
+                                            devices=devices)
     loop = ServeLoop(executor, buckets=buckets,
                      max_wait_s=max_wait_ms / 1e3,
-                     queue_depth=queue_depth)
+                     queue_depth=queue_depth, inflight=inflight)
     say = print if verbose else (lambda *_a, **_k: None)
     say(f"[serve-selftest] warming {len(buckets)} bucket(s) on "
-        f"{input_hw[0]}x{input_hw[1]} windows ...")
+        f"{input_hw[0]}x{input_hw[1]} windows across "
+        f"{len(executor.executors)} device(s) ...")
     loop.start()
     say(f"[serve-selftest] warmup {loop.stats()['warmup_s']:.2f}s; firing "
         f"{requests} requests from {clients} clients "
-        f"(poison every {poison_every}th, drain at {drain_frac:.0%})")
+        f"(poison every {poison_every}th, drain at {drain_frac:.0%}, "
+        f"in-flight window {loop.inflight_window})")
 
     rng = np.random.default_rng(0)
     h, w = executor.input_hw
@@ -100,7 +109,8 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
             t.start()
         # Let most of the load through, then deliver a real SIGTERM while
         # clients are still firing — the drain must finish accepted work
-        # and refuse the rest.
+        # (including dispatched-but-uncollected batches) and refuse the
+        # rest.
         for _ in range(drain_after):
             submitted.acquire()
         if use_signal:
@@ -122,7 +132,7 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
 
     # -- invariant checks ----------------------------------------------------
     if not fully_drained:
-        failures.append("dispatcher did not drain within 30s")
+        failures.append("pipeline did not drain within 30s")
     if len(outcomes) != requests:
         failures.append(f"{requests - len(outcomes)} request(s) never "
                         f"resolved")
@@ -154,10 +164,26 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
     occupancy = stats["batches"]["mean_occupancy"]
     if stats["batches"]["count"] and occupancy < 0.5:
         failures.append(f"mean batch occupancy {occupancy:.2f} < 0.5")
+    per_device = stats["executor"].get("per_device", [])
+    per_device_compiles = [
+        {"placement": p.get("placement"),
+         "warmup_compiles": p.get("warmup_compiles", 0),
+         "post_warmup_compiles": p.get("post_warmup_compiles", 0)}
+        for p in per_device]
+    for p in per_device_compiles:
+        if p["post_warmup_compiles"]:
+            failures.append(
+                f"device {p['placement']}: {p['post_warmup_compiles']} "
+                f"post-warmup recompile(s) — a batch shape escaped the "
+                f"bucket ladder on this pool member")
     recompiles = stats["executor"].get("post_warmup_compiles", 0)
-    if recompiles:
+    if recompiles and not per_device_compiles:
         failures.append(f"{recompiles} post-warmup recompile(s) — a batch "
                         f"shape escaped the bucket ladder")
+    max_inflight = stats.get("max_inflight_observed", 0)
+    if max_inflight > loop.inflight_window:
+        failures.append(f"in-flight window violated: observed "
+                        f"{max_inflight} > {loop.inflight_window}")
     answered = stats["requests"]["answered"]
     if answered != requests:
         failures.append(f"metrics answered={answered} != {requests}")
@@ -170,6 +196,11 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
         "refused": n_refused,
         "mean_occupancy": occupancy,
         "post_warmup_compiles": recompiles,
+        "devices": len(per_device_compiles) or 1,
+        "per_device_compiles": per_device_compiles,
+        "warmup_s": stats.get("warmup_s"),
+        "max_inflight_observed": max_inflight,
+        "inflight_window": loop.inflight_window,
         "p50_ms": stats["latency_ms"]["p50"],
         "p99_ms": stats["latency_ms"]["p99"],
         "stats": stats,
@@ -177,8 +208,42 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
     say(f"[serve-selftest] {n_ok} ok / {n_refused} refused over "
         f"{requests}; occupancy {occupancy:.2f}; "
         f"p50 {report['p50_ms']:.1f}ms p99 {report['p99_ms']:.1f}ms; "
-        f"post-warmup recompiles {recompiles}")
+        f"max in-flight {max_inflight}/{loop.inflight_window}; "
+        f"post-warmup recompiles {recompiles} across "
+        f"{report['devices']} device(s)")
     for f in failures:
         say(f"[serve-selftest] FAIL: {f}")
     say(f"[serve-selftest] {'PASSED' if report['passed'] else 'FAILED'}")
     return report
+
+
+def write_job_summary(report: dict, path: Optional[str] = None) -> None:
+    """Append a markdown summary of a selftest report to ``path`` (CI's
+    ``$GITHUB_STEP_SUMMARY``): warmup seconds plus the per-device
+    warmup/post-warmup compile counts the serve job publishes."""
+    path = path or os.environ.get("GITHUB_STEP_SUMMARY")
+    if not path:
+        return
+    lines = [
+        f"### serve selftest ({report['devices']} device(s))",
+        "",
+        f"- passed: **{report['passed']}**",
+        f"- warmup: **{report['warmup_s']:.2f}s**"
+        if report.get("warmup_s") is not None else "- warmup: n/a",
+        f"- throughput sample: p50 {report['p50_ms']:.1f}ms / "
+        f"p99 {report['p99_ms']:.1f}ms over {report['requests']} requests",
+        f"- max in-flight {report['max_inflight_observed']}"
+        f"/{report['inflight_window']}; occupancy "
+        f"{report['mean_occupancy']:.2f}",
+        "",
+        "| device | warmup compiles | post-warmup compiles |",
+        "|---|---|---|",
+    ]
+    for p in (report.get("per_device_compiles")
+              or [{"placement": "default", "warmup_compiles": "?",
+                   "post_warmup_compiles": report.get(
+                       "post_warmup_compiles", 0)}]):
+        lines.append(f"| {p['placement']} | {p['warmup_compiles']} "
+                     f"| {p['post_warmup_compiles']} |")
+    with open(path, "a", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")

@@ -1,27 +1,43 @@
-"""The drainable server loop + stdlib HTTP front end.
+"""The drainable pipelined server loop + stdlib HTTP front end.
 
-:class:`ServeLoop` owns one dispatcher thread that pulls due batches from
-the :class:`~dasmtl.serve.batcher.MicroBatcher`, runs them through the
-:class:`~dasmtl.serve.executor.InferExecutor`, and resolves every
-request's future — predictions for finite rows, a structured ``nonfinite``
-rejection for poisoned ones, a structured ``error`` if the executor itself
-fails (a broken batch must answer its callers, not strand them).
+:class:`ServeLoop` runs a bounded two-stage pipeline over the executor
+(or :class:`~dasmtl.serve.executor.ExecutorPool`):
+
+- the **dispatcher** thread pulls due batches from the
+  :class:`~dasmtl.serve.batcher.MicroBatcher`, writes their rows into a
+  preallocated per-bucket staging buffer, and calls
+  ``executor.dispatch`` — which returns device buffers immediately
+  (JAX's async dispatch), so batch *i+1* is formed and launched while
+  batch *i* computes;
+- the **collector** thread performs the single legal host sync
+  (``executor.collect``) and resolves every request's future —
+  predictions for finite rows, a structured ``nonfinite`` rejection for
+  poisoned ones, a structured ``error`` if the executor itself fails (a
+  broken batch must answer its callers, not strand them).
+
+A semaphore of ``inflight`` slots bounds how many batches may be
+dispatched-but-uncollected at once: the window is what converts "async"
+into "pipelined" without letting device queues (or result latency) grow
+unboundedly.  Batching and in-flight accounting are still plain state
+(the batcher is a fake-clock-testable state machine; the window is a
+counting semaphore), so every policy is unit-testable without real time.
 
 Lifecycle::
 
-    loop = ServeLoop(executor, buckets=..., max_wait_s=...)
+    loop = ServeLoop(executor, buckets=..., max_wait_s=..., inflight=2)
     loop.start()                  # warmup compiles every bucket, then serve
     res = loop.submit(window)     # blocking; submit_async() for a Future
     loop.drain()                  # SIGTERM path: finish queued work,
-                                  # refuse new, stop the dispatcher
+                                  # refuse new, stop both pipeline threads
     loop.close()
 
 Graceful drain is the contract the tests pin: after ``begin_drain`` every
 already-accepted request still gets its answer (the batcher flushes
-leftovers immediately, draining bypasses deadlines) and every later submit
-resolves instantly with ``closed``.  ``install_signal_handlers`` wires
-SIGTERM/SIGINT to ``begin_drain`` — signal-safe because it only flips
-flags and notifies; the blocking wait stays in the main loop.
+leftovers immediately, draining bypasses deadlines, batches already in
+flight are collected) and every later submit resolves instantly with
+``closed``.  ``install_signal_handlers`` wires SIGTERM/SIGINT to
+``begin_drain`` — signal-safe because it only flips flags and notifies;
+the blocking wait stays in the main loop.
 
 The HTTP front end is deliberately stdlib-only (``http.server``): a
 thread-per-connection ``ThreadingHTTPServer`` whose POST handler blocks on
@@ -32,6 +48,7 @@ transport.  POST /infer, GET /healthz, GET /stats (docs/SERVING.md).
 from __future__ import annotations
 
 import json
+import queue as _queue
 import signal
 import threading
 import time
@@ -41,7 +58,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from dasmtl.serve.batcher import BatchPlan, MicroBatcher
+from dasmtl.serve.batcher import BatchPlan, MicroBatcher, StagingBuffers
 from dasmtl.serve.metrics import ServeMetrics
 from dasmtl.serve.queue import ServeResult
 
@@ -53,13 +70,17 @@ EVENT_NAMES = ("striking", "excavating")
 #: short; this only bounds how long shutdown can lag a lost notify.
 _IDLE_WAIT_S = 0.5
 
+#: Completion-queue end marker: the dispatcher enqueues it AFTER the last
+#: in-flight batch, so the collector drains everything before exiting.
+_SENTINEL = object()
+
 
 class ServeLoop:
-    """Queue + micro-batcher + executor behind one submit() surface."""
+    """Queue + micro-batcher + pipelined executor behind one submit()."""
 
     def __init__(self, executor, *, buckets: Optional[Sequence[int]] = None,
                  max_wait_s: float = 0.005, queue_depth: int = 256,
-                 watermark: Optional[int] = None,
+                 watermark: Optional[int] = None, inflight: int = 2,
                  clock=time.monotonic,
                  metrics: Optional[ServeMetrics] = None):
         buckets = tuple(buckets or getattr(executor, "buckets", (1,)))
@@ -68,20 +89,31 @@ class ServeLoop:
         self.executor = executor
         self.metrics = metrics or ServeMetrics()
         self.clock = clock
+        self.inflight_window = max(1, int(inflight))
         self.batcher = MicroBatcher(buckets, max_wait_s, queue_depth,
                                     watermark, clock=clock,
                                     metrics=self.metrics)
+        self._staging = StagingBuffers(
+            buckets, getattr(executor, "input_hw", (1, 1)),
+            depth=self.inflight_window + 1)
         self._cv = threading.Condition()
         self._stop = False
+        self._slots = threading.BoundedSemaphore(self.inflight_window)
+        self._completion: "_queue.Queue" = _queue.Queue()
         self._thread: Optional[threading.Thread] = None
+        self._collector: Optional[threading.Thread] = None
         self._warmup_s: Optional[float] = None
-        self._inflight = 0
+        self._inflight = 0  # dispatched-but-uncollected batches (stats)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ServeLoop":
         if self._thread is not None:
             raise RuntimeError("ServeLoop.start is once-only")
         self._warmup_s = self.executor.warmup()
+        self._collector = threading.Thread(target=self._collect_loop,
+                                           name="dasmtl-serve-collect",
+                                           daemon=True)
+        self._collector.start()
         self._thread = threading.Thread(target=self._dispatch_loop,
                                         name="dasmtl-serve-dispatch",
                                         daemon=True)
@@ -97,12 +129,19 @@ class ServeLoop:
             self._cv.notify_all()
 
     def drain(self, timeout: Optional[float] = None) -> bool:
-        """``begin_drain`` + wait for the dispatcher to finish everything
-        already accepted.  True when the queue fully drained in time."""
+        """``begin_drain`` + wait for both pipeline stages to finish
+        everything already accepted (batches in flight are collected, not
+        dropped).  True when the pipeline fully drained in time."""
         self.begin_drain()
-        if self._thread is not None:
-            self._thread.join(timeout)
-            return not self._thread.is_alive()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for t in (self._thread, self._collector):
+            if t is None:
+                continue
+            left = (None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+            t.join(left)
+            if t.is_alive():
+                return False
         return True
 
     def close(self) -> None:
@@ -113,20 +152,34 @@ class ServeLoop:
     def draining(self) -> bool:
         return self.batcher.draining
 
-    # -- request surface -----------------------------------------------------
-    def submit_async(self, x: np.ndarray, max_wait_s: Optional[float] = None):
-        """Admit one ``(h, w)`` window; returns a Future[ServeResult]."""
-        req = self.batcher.submit(np.asarray(x, np.float32),
-                                  max_wait_s=max_wait_s)
+    @property
+    def inflight_depth(self) -> int:
         with self._cv:
-            self._cv.notify_all()
+            return self._inflight
+
+    # -- request surface -----------------------------------------------------
+    def submit_async(self, x: np.ndarray, max_wait_s: Optional[float] = None,
+                     want_log_probs: bool = False):
+        """Admit one ``(h, w)`` window; returns a Future[ServeResult].
+        ``want_log_probs`` asks for the per-head log-probabilities of this
+        window in the answer (pulled across D2H only on request — the
+        steady-state transfer is int predictions + a bool mask)."""
+        req = self.batcher.submit(np.asarray(x, np.float32),
+                                  max_wait_s=max_wait_s,
+                                  want_log_probs=want_log_probs)
+        if req.wake_dispatcher:
+            with self._cv:
+                self._cv.notify_all()
         return req.future
 
     def submit(self, x: np.ndarray, timeout: Optional[float] = 30.0,
-               max_wait_s: Optional[float] = None) -> ServeResult:
-        return self.submit_async(x, max_wait_s=max_wait_s).result(timeout)
+               max_wait_s: Optional[float] = None,
+               want_log_probs: bool = False) -> ServeResult:
+        return self.submit_async(x, max_wait_s=max_wait_s,
+                                 want_log_probs=want_log_probs
+                                 ).result(timeout)
 
-    # -- dispatcher ----------------------------------------------------------
+    # -- stage 1: dispatcher -------------------------------------------------
     def _dispatch_loop(self) -> None:
         while True:
             with self._cv:
@@ -135,48 +188,96 @@ class ServeLoop:
                     now = self.clock()
                     plan = self.batcher.take_batch(now)
                     if plan is not None:
-                        self._inflight = plan.n_real
                         break
                     if self._stop and self.batcher.depth == 0:
+                        self._completion.put(_SENTINEL)
                         return
                     due = self.batcher.ready_at(now)
                     self._cv.wait(timeout=_IDLE_WAIT_S if due is None
                                   else max(0.0, due - now))
-            try:
-                self._run_plan(plan)
-            finally:
-                with self._cv:
-                    self._inflight = 0
-                    self._cv.notify_all()
+            self._launch(plan)
 
-    def _run_plan(self, plan: BatchPlan) -> None:
-        now = self.clock()
+    def _launch(self, plan: BatchPlan) -> None:
+        t_taken = self.clock()
+        # Oldest member's queueing delay — what max_wait tuning controls.
+        self.metrics.observe_stage(
+            "queue_wait", max(0.0, t_taken - plan.requests[0].enqueue_t))
+        self._slots.acquire()  # the bounded in-flight window
+        buf = self._staging.acquire(plan.bucket)
+        t_form = self.clock()
         try:
-            preds, bad = self.executor.run(plan.assemble())
+            plan.assemble_into(buf)
+            t_formed = self.clock()
+            handle = self.executor.dispatch(buf)
         except Exception as exc:  # noqa: BLE001 — must answer the callers
-            detail = f"{type(exc).__name__}: {exc}"
-            for req in plan.requests:
-                self._finish(req, ServeResult(
-                    ok=False, request_id=req.id, error="error",
-                    detail=detail, bucket=plan.bucket))
+            self._staging.release(plan.bucket, buf)
+            self._slots.release()
+            self._fail_plan(plan, exc)
             return
+        self.metrics.observe_stage("form", t_formed - t_form)
+        self.metrics.observe_stage("dispatch", handle.dispatch_s)
+        with self._cv:
+            self._inflight += 1
+            self.metrics.observe_inflight(self._inflight)
+        self._completion.put((plan, handle, buf))
+
+    # -- stage 2: collector --------------------------------------------------
+    def _collect_loop(self) -> None:
+        while True:
+            item = self._completion.get()
+            if item is _SENTINEL:
+                return
+            plan, handle, buf = item
+            t0 = self.clock()
+            try:
+                preds, bad, log_probs = self.executor.collect(
+                    handle, want_log_probs=plan.want_log_probs)
+            except Exception as exc:  # noqa: BLE001 — answer the callers
+                self._fail_plan(plan, exc)
+                continue
+            finally:
+                self._staging.release(plan.bucket, buf)
+                self._slots.release()
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+            self.metrics.observe_stage("collect", self.clock() - t0)
+            self._resolve_plan(plan, preds, bad, log_probs)
+
+    def _resolve_plan(self, plan: BatchPlan, preds, bad, log_probs) -> None:
         done = self.clock()
+        observed = []
         for j, req in enumerate(plan.requests):
             latency = done - req.enqueue_t
             if bad[j]:
-                self._finish(req, ServeResult(
+                result = ServeResult(
                     ok=False, request_id=req.id, error="nonfinite",
                     detail="model outputs for this window hold NaN/Inf — "
                            "poisoned input or weights (SAN202, "
                            "docs/STATIC_ANALYSIS.md)",
-                    latency_s=latency, bucket=plan.bucket))
-                continue
-            out = {k: int(v[j]) for k, v in preds.items()}
-            if "event" in out:
-                out["event_name"] = EVENT_NAMES[out["event"]]
+                    latency_s=latency, bucket=plan.bucket)
+            else:
+                out = {k: int(v[j]) for k, v in preds.items()}
+                if "event" in out:
+                    out["event_name"] = EVENT_NAMES[out["event"]]
+                lp = None
+                if req.want_log_probs and log_probs is not None:
+                    lp = {k: np.asarray(v[j]).tolist()
+                          for k, v in log_probs.items()}
+                result = ServeResult(
+                    ok=True, request_id=req.id, predictions=out,
+                    latency_s=latency, bucket=plan.bucket, log_probs=lp)
+            req.resolve(result)
+            observed.append((result.outcome, latency))
+        self.metrics.observe_results(observed)
+        self.metrics.observe_stage("resolve", self.clock() - done)
+
+    def _fail_plan(self, plan: BatchPlan, exc: Exception) -> None:
+        detail = f"{type(exc).__name__}: {exc}"
+        for req in plan.requests:
             self._finish(req, ServeResult(
-                ok=True, request_id=req.id, predictions=out,
-                latency_s=latency, bucket=plan.bucket))
+                ok=False, request_id=req.id, error="error",
+                detail=detail, bucket=plan.bucket))
 
     def _finish(self, req, result: ServeResult) -> None:
         req.resolve(result)
@@ -187,7 +288,8 @@ class ServeLoop:
         snap = self.metrics.snapshot()
         snap["queue"] = {"depth": self.batcher.depth,
                          "draining": self.batcher.draining,
-                         "inflight": self._inflight}
+                         "inflight": self.inflight_depth,
+                         "inflight_window": self.inflight_window}
         snap["executor"] = self.executor.compile_summary()
         snap["warmup_s"] = self._warmup_s
         return snap
@@ -197,6 +299,7 @@ class ServeLoop:
             "status": "draining" if self.batcher.draining else "serving",
             "warm": self._warmup_s is not None,
             "queue_depth": self.batcher.depth,
+            "inflight": self.inflight_depth,
             "post_warmup_recompiles": getattr(
                 self.executor, "post_warmup_compiles", 0),
         }
@@ -255,8 +358,9 @@ def _make_handler(loop: ServeLoop, request_timeout_s: float):
                 return
             try:
                 n = int(self.headers.get("Content-Length", 0))
-                x = np.asarray(json.loads(self.rfile.read(n))["x"],
-                               np.float32)
+                body = json.loads(self.rfile.read(n))
+                x = np.asarray(body["x"], np.float32)
+                want_log_probs = bool(body.get("log_probs", False))
             except (ValueError, KeyError, json.JSONDecodeError) as exc:
                 self._reply(400, {"ok": False, "error": "bad_request",
                                   "detail": f"expected JSON "
@@ -272,7 +376,8 @@ def _make_handler(loop: ServeLoop, request_timeout_s: float):
                               f"{list(x.shape)}"})
                 return
             try:
-                res = loop.submit(x, timeout=request_timeout_s)
+                res = loop.submit(x, timeout=request_timeout_s,
+                                  want_log_probs=want_log_probs)
             except FuturesTimeoutError:
                 self._reply(504, {"ok": False, "error": "timeout",
                                   "detail": f"no response within "
@@ -280,12 +385,15 @@ def _make_handler(loop: ServeLoop, request_timeout_s: float):
                 return
             code = {None: 200, "shed": 503, "closed": 503,
                     "nonfinite": 422}.get(res.error, 500)
-            self._reply(code, {
+            payload = {
                 "ok": res.ok, "request_id": res.request_id,
                 "predictions": res.predictions, "error": res.error,
                 "detail": res.detail,
                 "latency_ms": round(res.latency_s * 1e3, 3),
-                "bucket": res.bucket})
+                "bucket": res.bucket}
+            if res.log_probs is not None:
+                payload["log_probs"] = res.log_probs
+            self._reply(code, payload)
 
     return Handler
 

@@ -137,12 +137,18 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
                 "resident='on' needs in-graph window slicing, which a "
                 "fixed exported computation cannot provide — stream from a "
                 "checkpoint for the resident path")
-        from dasmtl.export import deserialize_exported, exported_input_hw
+        from dasmtl.export import (deserialize_exported, exported_input_hw,
+                                   nonfinite_rows)
 
         exported = deserialize_exported(exported_path)
         # The artifact's (b, h, w, 1) input spec dictates the window grid.
         window = exported_input_hw(exported)
         artifact_call = exported.call
+        # The serving decode-tail convention (dasmtl/serve): the per-row
+        # finite mask is computed ON DEVICE over the artifact's log_probs
+        # heads, so the sanitize check pulls one (b,) bool vector per
+        # batch instead of every head's full tensor.
+        row_mask = jax.jit(nonfinite_rows) if sanitize else None
 
         plan = plan_windows(record.shape, window=window,
                             stride=_resolve_stride(stride, window))
@@ -150,16 +156,17 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
         def forward_artifact(x):
             out = artifact_call(x)
             if sanitize:
-                bad = [k for k in sorted(out) if k.startswith("log_probs_")
-                       and not np.isfinite(
-                           np.asarray(jax.device_get(out[k]))).all()]
-                if bad:
+                bad = np.asarray(jax.device_get(row_mask(
+                    {k: v for k, v in out.items()
+                     if k.startswith("log_probs_")})))
+                if bad.any():
                     from dasmtl.analysis.sanitize.common import \
                         NonFiniteError
 
                     raise NonFiniteError(
-                        f"SAN202: non-finite artifact outputs in {bad} — "
-                        f"the exported weights or the input record are "
+                        f"SAN202: non-finite artifact outputs in "
+                        f"{int(bad.sum())} row(s) of this batch — the "
+                        f"exported weights or the input record are "
                         f"poisoned")
             return {k: v for k, v in out.items()
                     if not k.startswith("log_probs_")}

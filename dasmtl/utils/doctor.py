@@ -119,6 +119,9 @@ def collect() -> dict:
         "queue_depth": d.serve_queue_depth,
         "watermark": d.serve_watermark_resolved,
         "endpoint": f"{d.serve_host}:{d.serve_port}",
+        "inflight": d.serve_inflight,
+        "devices": d.serve_devices,
+        "shard_largest": d.serve_shard_largest,
     }
 
     # Tracing-discipline tooling (dasmtl.analysis): the registered lint

@@ -1,21 +1,26 @@
 """Serving load generator: closed-loop and open-loop (Poisson) benchmarks
-against an in-process ServeLoop.
+against an in-process pipelined ServeLoop.
 
 Closed loop (``--clients N``): N threads each fire requests back-to-back —
-measures the *capacity* of the batcher + executor (throughput at full
+measures the *capacity* of the batcher + executor pool (throughput at full
 pressure, latency under self-induced queueing).
 
-Open loop (``--rps R``): requests arrive on a Poisson process regardless
-of completions — the honest model of a fiber that does not wait for the
-server, and the one that exposes shed behavior: when R exceeds capacity
-the queue hits the watermark and the shed rate (reported) becomes the
-safety valve instead of unbounded latency.
+Open loop (``--rps R`` / the ``--sweep`` multipliers): requests arrive on
+a Poisson process regardless of completions — the honest model of a fiber
+that does not wait for the server, and the one that exposes shed behavior:
+when R exceeds capacity the queue hits the watermark and the shed rate
+(reported) becomes the safety valve instead of unbounded latency.  The
+sweep runs several offered rates scaled off the measured closed-loop
+capacity, so the knee of the throughput/shed curve lands in the recorded
+data instead of being a guess.
 
-Reports throughput, p50/p95/p99 latency, mean batch occupancy, and
-shed/reject rates per mode; writes ``BENCH_serve.json`` alongside the
-repo's other ``BENCH_*.json`` snapshots and prints one JSON line per mode.
+Every mode records the per-stage pipeline breakdown from ``/stats``
+(queue wait / batch form / dispatch incl. H2D / collect incl. residual
+compute + D2H / resolve) plus the max observed in-flight depth.  Reports
+land in ``BENCH_serve.json`` alongside the repo's other ``BENCH_*.json``
+snapshots, one JSON line per mode on stdout.
 
-Run:  python scripts/bench_serve.py [--requests 2000] [--rps 300]
+Run:  python scripts/bench_serve.py [--requests 2000] [--sweep 0.5,1,1.5]
       python scripts/bench_serve.py --smoke     # CI: small + invariants
 """
 
@@ -34,19 +39,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _build_loop(args):
-    from dasmtl.serve.executor import InferExecutor
+    from dasmtl.serve.executor import ExecutorPool
     from dasmtl.serve.server import ServeLoop
 
     h, w = (int(v) for v in args.hw.lower().split("x"))
     buckets = tuple(int(b) for b in args.buckets.split(","))
-    executor = InferExecutor.from_checkpoint(args.model, args.model_path,
-                                             buckets, input_hw=(h, w))
+    executor = ExecutorPool.from_checkpoint(
+        args.model, args.model_path, buckets, input_hw=(h, w),
+        devices=args.devices, shard_largest=args.shard_largest)
     loop = ServeLoop(executor, buckets=buckets,
                      max_wait_s=args.max_wait_ms / 1e3,
-                     queue_depth=args.queue_depth)
+                     queue_depth=args.queue_depth,
+                     inflight=args.inflight)
     t0 = time.perf_counter()
     loop.start()
-    print(f"warmup ({len(buckets)} buckets, {h}x{w}): "
+    print(f"warmup ({len(buckets)} buckets, {h}x{w}, "
+          f"{len(executor.executors)} device(s)): "
           f"{time.perf_counter() - t0:.2f}s", file=sys.stderr)
     return loop, (h, w)
 
@@ -55,6 +63,7 @@ def _report(mode, loop, outcomes, wall_s, n_requests):
     stats = loop.stats()
     ok = sum(1 for o in outcomes if o == "ok")
     shed = sum(1 for o in outcomes if o == "shed")
+    per_device = stats["executor"].get("per_device", [])
     rec = {
         "metric": f"serve_{mode}_throughput",
         "value": round(ok / wall_s, 1),
@@ -71,11 +80,25 @@ def _report(mode, loop, outcomes, wall_s, n_requests):
         "mean_batch_occupancy": round(
             stats["batches"]["mean_occupancy"], 4),
         "batches": stats["batches"]["count"],
+        "stages": stats["stages"],
+        "max_inflight_observed": stats["max_inflight_observed"],
+        "inflight_window": stats["queue"]["inflight_window"],
+        "devices": len(per_device) or 1,
         "post_warmup_recompiles": stats["executor"].get(
             "post_warmup_compiles", 0),
+        "post_warmup_recompiles_per_device": [
+            p.get("post_warmup_compiles", 0) for p in per_device],
     }
     print(json.dumps(rec))
     return rec
+
+
+def _reset_metrics(loop):
+    """Fresh metrics between legs so percentiles/stages aren't blended
+    (the loop and executables persist — no recompiles between legs)."""
+    from dasmtl.serve.metrics import ServeMetrics
+
+    loop.metrics = loop.batcher.metrics = ServeMetrics()
 
 
 def closed_loop(loop, hw, n_requests, clients, rng):
@@ -128,13 +151,22 @@ def main() -> int:
     ap.add_argument("--buckets", type=str, default="1,2,4,8,16,32")
     ap.add_argument("--max_wait_ms", type=float, default=5.0)
     ap.add_argument("--queue_depth", type=int, default=256)
+    ap.add_argument("--inflight", type=int, default=2,
+                    help="pipeline depth (dispatched-but-uncollected "
+                         "batches)")
+    ap.add_argument("--devices", type=int, default=-1,
+                    help="executor-pool size (-1 = all visible devices)")
+    ap.add_argument("--shard_largest", action="store_true",
+                    help="mesh-shard largest-bucket batches over the pool")
     ap.add_argument("--requests", type=int, default=2000)
     ap.add_argument("--clients", type=int, default=16,
                     help="closed-loop concurrency")
     ap.add_argument("--rps", type=float, default=None,
-                    help="open-loop Poisson arrival rate (default: 1.5x "
-                         "the measured closed-loop throughput, to probe "
-                         "the shedding regime)")
+                    help="single open-loop Poisson rate (overrides "
+                         "--sweep)")
+    ap.add_argument("--sweep", type=str, default="0.5,1.0,1.5",
+                    help="offered-load sweep: comma-separated multipliers "
+                         "of the measured closed-loop throughput")
     ap.add_argument("--out", type=str, default="BENCH_serve.json")
     ap.add_argument("--smoke", action="store_true",
                     help="CI mode: tiny model, few hundred requests, exit "
@@ -143,8 +175,12 @@ def main() -> int:
     if args.smoke:
         args.hw = "52x64"
         args.buckets = "1,2,4,8"
-        args.requests = min(args.requests, 300)
-        args.clients = 8
+        args.requests = min(args.requests, 600)
+        # Closed-loop concurrency ABOVE the largest bucket, so the
+        # pipeline actually fills (batch i+1 queues while i computes) —
+        # with clients == bucket the window can never exceed depth 1.
+        args.clients = 16
+        args.sweep = "1.0,1.5"
 
     loop, hw = _build_loop(args)
     rng = np.random.default_rng(0)
@@ -152,23 +188,32 @@ def main() -> int:
     outcomes, wall = closed_loop(loop, hw, args.requests, args.clients, rng)
     closed = _report("closed_loop", loop, outcomes, wall, args.requests)
 
-    rps = args.rps or max(10.0, 1.5 * closed["value"])
-    # Fresh metrics for the open-loop leg so its percentiles aren't
-    # blended with the closed-loop run (the loop and executables persist —
-    # no recompiles between legs).
-    from dasmtl.serve.metrics import ServeMetrics
-
-    loop.metrics = loop.batcher.metrics = ServeMetrics()
-    outcomes, wall = open_loop(loop, hw, args.requests, rps, rng)
-    open_ = _report("open_loop", loop, outcomes, wall, args.requests)
-    open_["offered_rps"] = round(rps, 1)
+    # Offered-load sweep: Poisson arrivals at multipliers of the measured
+    # capacity, so the recorded curve brackets the shedding knee.
+    if args.rps is not None:
+        multipliers = [args.rps / max(1.0, closed["value"])]
+    else:
+        multipliers = [float(m) for m in args.sweep.split(",") if m.strip()]
+    sweep = []
+    for m in multipliers:
+        rps = max(10.0, m * closed["value"])
+        _reset_metrics(loop)
+        outcomes, wall = open_loop(loop, hw, args.requests, rps, rng)
+        rec = _report(f"open_loop_x{m:g}", loop, outcomes, wall,
+                      args.requests)
+        rec["offered_rps"] = round(rps, 1)
+        rec["offered_multiplier"] = m
+        sweep.append(rec)
+    open_ = sweep[-1]  # highest offered rate: the legacy "open_loop" slot
 
     loop.drain(timeout=30.0)
     loop.close()
 
     out = {"backend": "cpu", "hw": args.hw, "buckets": args.buckets,
            "max_wait_ms": args.max_wait_ms, "smoke": args.smoke,
-           "closed_loop": closed, "open_loop": open_}
+           "inflight": args.inflight, "devices": closed["devices"],
+           "closed_loop": closed, "open_loop": open_,
+           "open_loop_sweep": sweep}
     try:
         import jax
 
@@ -181,13 +226,26 @@ def main() -> int:
 
     if args.smoke:
         failures = []
-        for mode, rec in (("closed", closed), ("open", open_)):
+        for mode, rec in [("closed", closed)] + [
+                (r["metric"], r) for r in sweep]:
             if rec["post_warmup_recompiles"]:
                 failures.append(f"{mode}: post-warmup recompiles "
                                 f"{rec['post_warmup_recompiles']}")
+            for di, n in enumerate(
+                    rec["post_warmup_recompiles_per_device"]):
+                if n:
+                    failures.append(f"{mode}: device {di} recompiled "
+                                    f"{n}x post-warmup")
             if rec["ok"] + rec["shed"] + rec["other_refusals"] \
                     != args.requests:
                 failures.append(f"{mode}: requests unaccounted for")
+            if rec["max_inflight_observed"] > rec["inflight_window"]:
+                failures.append(
+                    f"{mode}: in-flight window violated "
+                    f"({rec['max_inflight_observed']} > "
+                    f"{rec['inflight_window']})")
+            if not rec["stages"]:
+                failures.append(f"{mode}: no stage breakdown recorded")
         if closed["batches"] and closed["mean_batch_occupancy"] < 0.5:
             failures.append(f"closed: occupancy "
                             f"{closed['mean_batch_occupancy']} < 0.5")

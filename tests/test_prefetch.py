@@ -74,6 +74,46 @@ def test_prefetch_abandoned_consumer_does_not_hang():
     assert len(produced) < 1000
 
 
+def _live_prefetch_threads():
+    return [t for t in threading.enumerate()
+            if t.name == "dasmtl-prefetch" and t.is_alive()]
+
+
+def test_prefetch_break_leaves_no_live_worker_thread():
+    """Abandoning the iterator mid-epoch (plain ``break`` out of a for
+    loop -> GeneratorExit on GC) must stop, drain, and JOIN the worker:
+    no live dasmtl-prefetch thread may survive the loop."""
+    assert not _live_prefetch_threads()  # clean slate
+
+    def gen():
+        for i in range(10_000):
+            yield i
+
+    def consume():
+        for i, _item in enumerate(prefetch(gen(), depth=2)):
+            if i == 2:
+                break  # the generator is GC-closed when the frame exits
+
+    consume()
+    deadline = time.monotonic() + 5.0
+    while _live_prefetch_threads() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not _live_prefetch_threads(), \
+        "worker thread survived an abandoned iterator"
+
+
+def test_prefetch_explicit_close_joins_worker_thread():
+    def gen():
+        for i in range(10_000):
+            yield i
+
+    it = prefetch(gen(), depth=2)
+    assert next(it) == 0
+    it.close()  # runs the generator's finally: stop + drain + join
+    assert not _live_prefetch_threads(), \
+        "worker thread survived close()"
+
+
 def test_prefetch_runs_ahead_of_consumer():
     started = threading.Event()
 

@@ -1,9 +1,14 @@
-"""Micro-batcher / queue / backpressure unit tests (dasmtl/serve/).
+"""Micro-batcher / queue / backpressure / pipeline unit tests
+(dasmtl/serve/).
 
 Everything here runs under a FAKE clock and (mostly) a fake executor: the
 batcher is a synchronous state machine that takes ``now`` as an argument,
-so deadline semantics are asserted exactly — no sleeps, no flaky timing.
-The real-model end-to-end path lives in tests/test_serve_smoke.py.
+so deadline semantics are asserted exactly — no sleeps, no flaky timing —
+and the fake executor speaks the pipelined ``dispatch``/``collect``
+protocol, with a gated variant whose ``collect`` blocks until the test
+releases it (so dispatch/collect ordering, the bounded in-flight window,
+and drain-with-batches-in-flight are asserted deterministically).  The
+real-model end-to-end path lives in tests/test_serve_smoke.py.
 """
 
 import threading
@@ -13,8 +18,9 @@ import numpy as np
 import pytest
 
 from dasmtl.data.pipeline import pad_to_bucket
-from dasmtl.serve import (MicroBatcher, QueueClosed, Request, RequestQueue,
-                          ServeLoop, ServeMetrics, ServeResult,
+from dasmtl.serve import (ExecutorPool, InflightBatch, MicroBatcher,
+                          QueueClosed, Request, RequestQueue, ServeLoop,
+                          ServeMetrics, ServeResult, StagingBuffers,
                           choose_bucket, make_http_server)
 
 HW = (4, 5)
@@ -36,32 +42,50 @@ class FakeClock:
 
 
 class FakeExecutor:
-    """Executor-protocol stand-in: numpy argmax over the window sum, a
-    poisoned row (NaN anywhere) rejects, optional artificial delay."""
+    """Executor-protocol stand-in (pipelined dispatch/collect): numpy
+    argmax over the window sum, a poisoned row (NaN anywhere) rejects,
+    optional artificial collect delay."""
 
     def __init__(self, buckets=(1, 2, 4, 8), delay_s=0.0, fail=False):
         self.buckets = tuple(sorted(buckets))
         self.input_hw = HW
         self.post_warmup_compiles = 0
         self.batches = []
+        self.events = []  # ("dispatch"|"collect", bucket) in call order
         self.delay_s = delay_s
         self.fail = fail
         self.closed = False
+        self._lock = threading.Lock()
 
     def warmup(self):
         return 0.0
 
-    def run(self, x):
+    def dispatch(self, x):
         if self.fail:
             raise RuntimeError("injected executor fault")
-        if self.delay_s:
-            time.sleep(self.delay_s)
         assert x.shape[0] in self.buckets, "bucket miss"
-        self.batches.append(x.shape[0])
         flat = x.reshape(x.shape[0], -1)
         bad = ~np.isfinite(flat).all(axis=1)
         preds = {"event": (np.nan_to_num(flat).sum(axis=1) > 0)
                  .astype(np.int64)}
+        with self._lock:
+            self.batches.append(x.shape[0])
+            self.events.append(("dispatch", x.shape[0]))
+        return InflightBatch(outputs={"preds": preds, "bad": bad},
+                             bucket=int(x.shape[0]), executor=self)
+
+    def collect(self, handle, want_log_probs=False):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        with self._lock:
+            self.events.append(("collect", handle.bucket))
+        lp = None
+        if want_log_probs:
+            lp = {"log_probs_0": np.zeros((handle.bucket, 3), np.float32)}
+        return handle.outputs["preds"], handle.outputs["bad"], lp
+
+    def run(self, x):
+        preds, bad, _ = self.collect(self.dispatch(x))
         return preds, bad
 
     def compile_summary(self):
@@ -69,6 +93,29 @@ class FakeExecutor:
 
     def close(self):
         self.closed = True
+
+
+class GatedExecutor(FakeExecutor):
+    """FakeExecutor whose ``collect`` blocks until ``release()`` — the
+    deterministic way to hold batches in flight."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.gate = threading.Semaphore(0)
+        self.dispatched = threading.Semaphore(0)
+
+    def dispatch(self, x):
+        handle = super().dispatch(x)
+        self.dispatched.release()
+        return handle
+
+    def collect(self, handle, want_log_probs=False):
+        assert self.gate.acquire(timeout=30.0), "gate never released"
+        return super().collect(handle, want_log_probs=want_log_probs)
+
+    def release(self, n=1):
+        for _ in range(n):
+            self.gate.release()
 
 
 def make_batcher(clock, buckets=(1, 2, 4, 8), max_wait_s=0.010,
@@ -339,6 +386,169 @@ def test_serveloop_graceful_drain_finishes_inflight():
     late = loop.submit(win(), timeout=1.0)
     assert not late.ok and late.error == "closed"
     loop.close()
+
+
+# -- pipelined data plane: ordering, in-flight window, drain -----------------
+
+
+def test_pipeline_dispatches_next_batch_before_collecting_previous():
+    """The tentpole overlap: with an in-flight window of 2, batch B is
+    DISPATCHED while batch A is still uncollected (collect gated)."""
+    ex = GatedExecutor(buckets=(1,))
+    loop = ServeLoop(ex, buckets=(1,), max_wait_s=0.001, queue_depth=8,
+                     inflight=2).start()
+    try:
+        fut_a = loop.submit_async(win(0) + 1.0)
+        assert ex.dispatched.acquire(timeout=10.0)
+        fut_b = loop.submit_async(win(1) + 1.0)
+        assert ex.dispatched.acquire(timeout=10.0)
+        # Two dispatches happened; zero collects — the device pipeline is
+        # 2 deep while the host stays free.
+        assert ex.events == [("dispatch", 1), ("dispatch", 1)]
+        ex.release(2)
+        assert fut_a.result(timeout=10.0).ok
+        assert fut_b.result(timeout=10.0).ok
+        # Collection is FIFO: A then B, after both dispatches.
+        assert ex.events[2:] == [("collect", 1), ("collect", 1)]
+        assert loop.stats()["max_inflight_observed"] == 2
+    finally:
+        ex.release(8)  # unblock any drain-path collects
+        loop.close()
+
+
+def test_pipeline_inflight_window_bounds_dispatch_depth():
+    """window=1: the dispatcher must NOT launch batch B while batch A is
+    uncollected, even though B is due — the semaphore is the bound."""
+    ex = GatedExecutor(buckets=(1,))
+    loop = ServeLoop(ex, buckets=(1,), max_wait_s=0.001, queue_depth=8,
+                     inflight=1).start()
+    try:
+        fut_a = loop.submit_async(win(0) + 1.0)
+        assert ex.dispatched.acquire(timeout=10.0)
+        fut_b = loop.submit_async(win(1) + 1.0)
+        # B is due (deadline 1ms) but the window is full: no second
+        # dispatch may happen while A is in flight.
+        assert not ex.dispatched.acquire(timeout=0.3)
+        assert ex.batches == [1]
+        ex.release(1)  # collect A -> slot frees -> B dispatches
+        assert ex.dispatched.acquire(timeout=10.0)
+        ex.release(1)
+        assert fut_a.result(timeout=10.0).ok
+        assert fut_b.result(timeout=10.0).ok
+        assert loop.stats()["max_inflight_observed"] == 1
+    finally:
+        ex.release(8)
+        loop.close()
+
+
+def test_drain_with_batches_in_flight_completes_them():
+    """SIGTERM while batches sit dispatched-but-uncollected: drain must
+    wait for the collector to answer them, never drop them."""
+    ex = GatedExecutor(buckets=(1,))
+    loop = ServeLoop(ex, buckets=(1,), max_wait_s=0.001, queue_depth=8,
+                     inflight=2).start()
+    futs = [loop.submit_async(win(i) + 1.0) for i in range(2)]
+    for _ in range(2):
+        assert ex.dispatched.acquire(timeout=10.0)
+
+    drained = []
+    t = threading.Thread(target=lambda: drained.append(
+        loop.drain(timeout=15.0)), daemon=True)
+    t.start()
+    t.join(timeout=0.3)
+    assert t.is_alive()  # batches in flight: drain must still be waiting
+    ex.release(2)
+    t.join(timeout=15.0)
+    assert drained == [True]
+    results = [f.result(timeout=1.0) for f in futs]
+    assert all(r.ok for r in results)  # in-flight work completed, not dropped
+    late = loop.submit(win(), timeout=1.0)
+    assert not late.ok and late.error == "closed"
+    loop.close()
+
+
+def test_want_log_probs_per_request():
+    """log-probs cross the data plane only for requests that ask."""
+    ex = FakeExecutor()
+    loop = ServeLoop(ex, max_wait_s=0.002, queue_depth=32).start()
+    try:
+        plain = loop.submit(win(0) + 1.0, timeout=10.0)
+        asked = loop.submit(win(1) + 1.0, timeout=10.0,
+                            want_log_probs=True)
+    finally:
+        loop.close()
+    assert plain.ok and plain.log_probs is None
+    assert asked.ok and list(asked.log_probs) == ["log_probs_0"]
+    assert len(asked.log_probs["log_probs_0"]) == 3  # this row only
+
+
+# -- staging buffers ----------------------------------------------------------
+
+
+def _plan(n, bucket, fill=1.0):
+    reqs = [Request(id=i, x=np.full(HW, fill, np.float32), enqueue_t=0.0,
+                    deadline_t=0.0) for i in range(n)]
+    from dasmtl.serve import BatchPlan
+
+    return BatchPlan(requests=reqs, bucket=bucket)
+
+
+def test_assemble_into_pads_and_survives_buffer_reuse():
+    sb = StagingBuffers((2, 4), HW, depth=1)
+    buf = sb.acquire(4)
+    out = _plan(4, 4, fill=7.0).assemble_into(buf)
+    assert out is buf and (out == 7.0).all()
+    sb.release(4, buf)
+    # Reuse: a partial batch into the same (dirty) buffer must zero the
+    # padding rows — the pad_to_bucket convention, in place.
+    buf = sb.acquire(4)
+    out = _plan(1, 4, fill=3.0).assemble_into(buf)
+    assert (out[0] == 3.0).all() and (out[1:] == 0.0).all()
+    # Same bytes as the allocating path.
+    np.testing.assert_array_equal(out, _plan(1, 4, fill=3.0).assemble())
+    with pytest.raises(ValueError):
+        _plan(1, 2).assemble_into(buf)  # wrong bucket buffer
+
+
+def test_staging_acquire_blocks_until_release():
+    sb = StagingBuffers((2,), HW, depth=1)
+    buf = sb.acquire(2)
+    got = []
+    t = threading.Thread(target=lambda: got.append(sb.acquire(2)),
+                         daemon=True)
+    t.start()
+    t.join(timeout=0.2)
+    assert t.is_alive()  # exhausted: second acquire must wait
+    sb.release(2, buf)
+    t.join(timeout=5.0)
+    assert not t.is_alive() and got and got[0] is buf
+
+
+# -- executor pool (fake members) --------------------------------------------
+
+
+def test_executor_pool_round_robin_and_collect_routing():
+    f1, f2 = FakeExecutor(buckets=(1, 2)), FakeExecutor(buckets=(1, 2))
+    pool = ExecutorPool([f1, f2])
+    x = np.ones((1, *HW, 1), np.float32)
+    handles = [pool.dispatch(x) for _ in range(4)]
+    assert len(f1.batches) == len(f2.batches) == 2  # round-robin
+    preds, bad, _ = pool.collect(handles[0])
+    assert preds["event"][0] == 1 and not bad[0]
+    # Collection routed to the member that dispatched the batch.
+    assert ("collect", 1) in f1.events and ("collect", 1) not in f2.events
+    summary = pool.compile_summary()
+    assert summary["pool_size"] == 2
+    assert len(summary["per_device"]) == 2
+    pool.close()
+    assert f1.closed and f2.closed
+
+
+def test_executor_pool_rejects_mismatched_members():
+    f1 = FakeExecutor(buckets=(1, 2))
+    f2 = FakeExecutor(buckets=(1, 4))
+    with pytest.raises(ValueError, match="disagree"):
+        ExecutorPool([f1, f2])
 
 
 def test_http_front_end_infer_healthz_stats():

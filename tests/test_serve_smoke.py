@@ -81,6 +81,50 @@ def test_serve_exported_input_spec_mismatch_is_startup_error(
                                     expected_hw=(100, 250))
 
 
+def test_pool_two_devices_matches_single_device():
+    """The executor-pool parity check (PR 3 convention: ints exact,
+    floats under tolerance): the same requests through a 1-member and a
+    2-member pool produce identical integer predictions, and per-head
+    log-probs agree within 1e-6 — round-robin placement must be
+    invisible to callers.  Runs on the suite's virtual CPU devices
+    (conftest forces 8; CI additionally runs the selftest under
+    ``--xla_force_host_platform_device_count=2``)."""
+    import jax
+
+    from dasmtl.serve import ExecutorPool, ServeLoop
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    rng = np.random.default_rng(7)
+    windows = [rng.normal(size=HW).astype(np.float32) for _ in range(6)]
+
+    def run_pool(n_devices):
+        # Fresh-init weights are seed-deterministic (the determinism
+        # suite pins this), so both pools serve identical params.
+        pool = ExecutorPool.from_checkpoint("MTL", None, (1, 2, 4),
+                                            input_hw=HW,
+                                            devices=n_devices)
+        loop = ServeLoop(pool, max_wait_s=0.002, queue_depth=16,
+                         inflight=2).start()
+        try:
+            return [loop.submit(w, timeout=60.0, want_log_probs=True)
+                    for w in windows]
+        finally:
+            stats = loop.stats()
+            loop.close()
+            for p in stats["executor"]["per_device"]:
+                assert p["post_warmup_compiles"] == 0, p
+
+    single = run_pool(1)
+    pooled = run_pool(2)
+    assert all(r.ok for r in single + pooled)
+    for s, p in zip(single, pooled):
+        assert s.predictions == p.predictions  # ints: exactly equal
+        for head in s.log_probs:
+            np.testing.assert_allclose(s.log_probs[head],
+                                       p.log_probs[head], atol=1e-6)
+
+
 def test_doctor_validates_exported_artifact(exported_artifact):
     from dasmtl.utils.doctor import check_exported_artifact
 
