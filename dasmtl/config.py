@@ -78,8 +78,19 @@ class Config:
     mat_key: str = "data"
     # Background-thread batch prefetch depth: gather + device_put of batch
     # i+1 overlap step i's device compute (the reference's loader is fully
-    # synchronous, utils.py:152-156).  0 disables.
+    # synchronous, utils.py:152-156).  0 disables.  (Evaluation pipeline;
+    # the training epoch runs the loader_* worker pool below.)
     prefetch_batches: int = 2
+    # ---- training input pipeline (dasmtl/data/pipeline.py worker pool) ----
+    # loader_workers decode/augment/assemble threads fill preallocated
+    # staging buffers behind a bounded queue of loader_queue_depth batches,
+    # emitted in deterministic epoch order at ANY worker count; 0 = fully
+    # synchronous inline assembly (no threads).  loader_native selects the
+    # .mat reader: auto (native C++ when it builds, scipy otherwise), on
+    # (require native — startup error if unavailable), off (force scipy).
+    loader_workers: int = 2
+    loader_queue_depth: int = 4
+    loader_native: str = "auto"  # auto | on | off
     # Opt-in SNR-targeted Gaussian noise for robustness evals
     # (reference dataset_preparation.py:83-105; disabled there at :244-245).
     noise_snr_db: Optional[float] = None
@@ -185,6 +196,15 @@ class Config:
             raise ValueError(f"unknown device_data {self.device_data!r}")
         if self.steps_per_dispatch < 1:
             raise ValueError("steps_per_dispatch must be >= 1")
+        if self.loader_workers < 0:
+            raise ValueError("loader_workers must be >= 0 (0 = synchronous "
+                             "inline assembly)")
+        if self.loader_queue_depth < 1:
+            raise ValueError("loader_queue_depth must be >= 1")
+        if self.loader_native not in ("auto", "on", "off"):
+            raise ValueError(
+                f"unknown loader_native {self.loader_native!r}; expected "
+                "auto | on | off")
         if self.guard_transfer not in ("off", "log", "disallow"):
             raise ValueError(
                 f"unknown guard_transfer {self.guard_transfer!r}")
@@ -415,6 +435,19 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
                    help="opt-in Gaussian noise SNR (dB) for robustness evals")
     p.add_argument("--prefetch_batches", type=int, default=d.prefetch_batches,
                    help="batch prefetch depth (0 disables the overlap thread)")
+    p.add_argument("--loader_workers", type=int, default=d.loader_workers,
+                   help="training-pipeline decode/augment/assemble worker "
+                        "threads (deterministic batch order at any count; "
+                        "0 = synchronous inline)")
+    p.add_argument("--loader_queue_depth", type=int,
+                   default=d.loader_queue_depth,
+                   help="bounded queue of assembled batches ahead of the "
+                        "train step (staging freelist sizes itself from "
+                        "this)")
+    p.add_argument("--loader_native", type=str, default=d.loader_native,
+                   choices=["auto", "on", "off"],
+                   help=".mat reader: native C++ when it builds (auto), "
+                        "required (on), or forced scipy fallback (off)")
     p.add_argument("--device_data", type=str, default=d.device_data,
                    choices=["auto", "on", "off"],
                    help="keep the training set in device HBM and gather "

@@ -36,6 +36,39 @@ _SRC = os.path.join(os.path.dirname(os.path.dirname(
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
+_mode = "auto"  # auto | on | off — Config.loader_native, via configure()
+
+
+def configure(mode: str) -> None:
+    """Select the reader per ``Config.loader_native``: ``auto`` uses the
+    native library when it loads, ``off`` forces the scipy fallback, and
+    ``on`` *requires* the native path — a startup error beats silently
+    training at scipy speed when the operator asked for native."""
+    global _mode
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"loader_native must be auto|on|off, got {mode!r}")
+    _mode = mode
+    if mode == "on" and _load() is None:
+        raise RuntimeError(
+            "loader_native='on' but the native MAT reader did not "
+            "build/load (check g++/zlib, or the packaged dasmtl.data."
+            "_dasmat extension) — use loader_native=auto for the "
+            "transparent scipy fallback")
+
+
+def _packaged_lib() -> Optional[str]:
+    """The extension built at install time by setup.py (an ordinary
+    setuptools Extension — never imported, only ctypes-loaded), living
+    next to this module.  Absent in editable/source installs, where the
+    on-demand cache build below takes over."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    for pattern in ("_dasmat*.so", "_dasmat*.dylib", "_dasmat*.pyd"):
+        hits = sorted(glob.glob(os.path.join(here, pattern)))
+        if hits:
+            return hits[0]
+    return None
 
 
 def _cache_dir() -> Optional[str]:
@@ -105,12 +138,23 @@ def _load() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
+        lib = None
+        packaged = _packaged_lib()
+        if packaged is not None:
+            # Install-time extension first (no compiler needed at runtime);
+            # a broken artifact (wrong arch/libc) falls through to the
+            # on-demand cache build rather than disabling the native path.
+            try:
+                lib = ctypes.CDLL(packaged)
+            except OSError:
+                lib = None
         try:
-            path = _build()
-            if path is None:
-                _build_failed = True
-                return None
-            lib = ctypes.CDLL(path)
+            if lib is None:
+                path = _build()
+                if path is None:
+                    _build_failed = True
+                    return None
+                lib = ctypes.CDLL(path)
             lib.das_mat_dims.argtypes = [
                 ctypes.c_char_p, ctypes.c_char_p,
                 ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int)]
@@ -135,7 +179,10 @@ def _load() -> Optional[ctypes.CDLL]:
 
 
 def available() -> bool:
-    """True when the native library compiled and loaded."""
+    """True when the native library loaded AND the configured mode allows
+    it (``loader_native='off'`` forces the scipy fallback)."""
+    if _mode == "off":
+        return False
     return _load() is not None
 
 

@@ -17,14 +17,16 @@ A batch is a dict of numpy arrays:
 
 from __future__ import annotations
 
+import dataclasses
 import math
 import queue
 import threading
-from typing import Callable, Dict, Iterator, Optional
+from typing import Any, Callable, Dict, Iterator, Optional
 
 import numpy as np
 
 from dasmtl.data.sources import _SourceBase
+from dasmtl.data.staging import StagingBuffers
 
 Batch = Dict[str, np.ndarray]
 
@@ -99,6 +101,90 @@ def prefetch(iterator: Iterator, depth: int = 2,
         thread.join(timeout=5.0)
 
 
+def worker_pool(items: Iterator, work_fn: Callable, *, workers: int = 2,
+                depth: int = 4, name: str = "dasmtl-loader") -> Iterator:
+    """Order-preserving parallel map: ``workers`` threads apply ``work_fn``
+    to the items of ``items``; results are yielded in **input order**
+    regardless of completion order, so a fixed seed produces the identical
+    batch stream at any worker count.
+
+    - at most ``max(depth, workers)`` items are in flight (in progress or
+      completed-but-unconsumed) — the bounded queue of the decode pool;
+    - ``workers <= 0`` degrades to inline synchronous mapping (no threads);
+    - an exception while producing item *k* re-raises at position *k*,
+      after items ``< k`` were delivered — the serial semantics (the
+      underlying iterator may have been advanced past *k* by then);
+    - abandoning the iterator (``break`` -> GeneratorExit, or ``close()``)
+      stops, wakes and JOINS every worker — same contract as
+      :func:`prefetch`, pinned by tests/test_prefetch.py.
+    """
+    if workers <= 0:
+        for item in items:
+            yield work_fn(item)
+        return
+    depth = max(int(depth), int(workers))
+    it = iter(items)
+    cond = threading.Condition()
+    state = {"next_in": 0, "next_out": 0, "exhausted": False, "stop": False}
+    results: Dict[int, tuple] = {}  # seq -> ("ok", value) | ("err", exc)
+
+    def worker():
+        while True:
+            with cond:
+                while (not state["stop"] and not state["exhausted"] and
+                       state["next_in"] - state["next_out"] >= depth):
+                    cond.wait()
+                if state["stop"] or state["exhausted"]:
+                    return
+                seq = state["next_in"]
+                try:
+                    item = next(it)
+                except StopIteration:
+                    state["exhausted"] = True
+                    cond.notify_all()
+                    return
+                except BaseException as exc:  # iterator itself failed
+                    state["next_in"] += 1
+                    results[seq] = ("err", exc)
+                    state["exhausted"] = True
+                    cond.notify_all()
+                    return
+                state["next_in"] += 1
+            try:
+                out = ("ok", work_fn(item))
+            except BaseException as exc:  # surfaced at position seq
+                out = ("err", exc)
+            with cond:
+                results[seq] = out
+                cond.notify_all()
+
+    threads = [threading.Thread(target=worker, daemon=True,
+                                name=f"{name}-{i}") for i in range(workers)]
+    for t in threads:
+        t.start()
+    try:
+        while True:
+            with cond:
+                seq = state["next_out"]
+                while seq not in results and not (
+                        state["exhausted"] and seq >= state["next_in"]):
+                    cond.wait()
+                if seq not in results:
+                    break  # exhausted and fully drained
+                kind, value = results.pop(seq)
+                state["next_out"] = seq + 1
+                cond.notify_all()  # frees one in-flight ticket
+            if kind == "err":
+                raise value
+            yield value
+    finally:
+        with cond:
+            state["stop"] = True
+            cond.notify_all()
+        for t in threads:
+            t.join(timeout=5.0)
+
+
 #: Padding fill value per batch key.  Anything not listed pads with zeros;
 #: ``weight`` 0.0 marks the row as padding for losses/metrics, ``index`` -1
 #: keeps padded rows from mapping to a real window-grid position.
@@ -142,6 +228,82 @@ def _make_batch(source: _SourceBase, idx: np.ndarray, batch_size: int) -> Batch:
          "weight": np.ones((n_real,), np.float32)}, batch_size)
 
 
+@dataclasses.dataclass
+class StagedBatch:
+    """One assembled batch plus its staging-slot lease.  ``data`` is the
+    batch dict (the staging buffers themselves, or freshly allocated
+    arrays for the shape-learning first batch); the consumer calls
+    :meth:`release` when the host copy is no longer needed — passing the
+    placed device pytree routes through the alias-safe
+    :meth:`~dasmtl.data.staging.StagingBuffers.release_placed`."""
+
+    data: Batch
+    _staging: Optional[StagingBuffers] = None
+
+    def release(self, placed: Optional[Any] = None) -> None:
+        if self._staging is None:
+            return  # unstaged (shape-learning) batch: nothing leased
+        staging, self._staging = self._staging, None
+        if placed is None:
+            staging.release(self.data)
+        else:
+            staging.release_placed(self.data, placed)
+
+
+class BatchAssembler:
+    """The decode/augment/assemble stage of the training input pipeline:
+    builds fixed-shape batches from a source **into preallocated staging
+    buffers** (:mod:`dasmtl.data.staging`) instead of a per-batch
+    ``np.stack`` — the PR 5 serve-side trick applied to training.
+
+    The first batch is assembled through the allocating `_make_batch`
+    path to learn the window shape (a lazy :class:`DiskSource` only knows
+    it after one decode); the slot is registered from it and every later
+    batch writes straight into a reused buffer via ``gather_into``.
+
+    Thread-safe: designed to be driven by :func:`worker_pool` workers.
+    ``rng`` (per-batch, derived from ``(noise_seed, epoch, seq)`` by the
+    epoch pipeline) keeps opt-in SNR augmentation deterministic at ANY
+    worker count — the old shared sequential generator would race.
+    """
+
+    def __init__(self, source: _SourceBase, batch_size: int, *,
+                 staging: Optional[StagingBuffers] = None, depth: int = 4):
+        self.source = source
+        self.batch_size = int(batch_size)
+        self.staging = staging or StagingBuffers(depth=depth)
+        self.noise_seed = int(getattr(source, "noise_seed", 0) or 0)
+        self._slot = ("train_batch", self.batch_size)
+        self._lock = threading.Lock()
+
+    def assemble(self, idx: np.ndarray,
+                 rng: Optional[np.random.Generator] = None) -> StagedBatch:
+        idx = np.asarray(idx)
+        n = idx.shape[0]
+        bucket = self.batch_size
+        if not self.staging.has_slot(self._slot):
+            batch = pad_to_bucket(
+                {"x": self.source.gather(idx, rng=rng),
+                 "distance": self.source.distance[idx],
+                 "event": self.source.event[idx],
+                 "weight": np.ones((n,), np.float32)}, bucket)
+            with self._lock:
+                if not self.staging.has_slot(self._slot):
+                    self.staging.add_slot(
+                        self._slot,
+                        {k: (v.shape, v.dtype) for k, v in batch.items()})
+            return StagedBatch(batch, None)
+        buf = self.staging.acquire(self._slot)
+        self.source.gather_into(idx, buf["x"], rng=rng)
+        np.take(self.source.distance, idx, axis=0, out=buf["distance"][:n])
+        np.take(self.source.event, idx, axis=0, out=buf["event"][:n])
+        buf["weight"][:n] = 1.0
+        if n < bucket:  # zero the (reused) padding rows
+            for k, v in buf.items():
+                v[n:] = _PAD_FILL.get(k, 0)
+        return StagedBatch(buf, self.staging)
+
+
 class BatchIterator:
     """Shuffled, epoch-addressable train batches with static shapes.
 
@@ -179,6 +341,35 @@ class BatchIterator:
         for start in range(0, stop, self.batch_size):
             idx = order[start:start + self.batch_size]
             yield _make_batch(self.source, idx, self.batch_size)
+
+    def epoch_staged(self, epoch_idx: int, assembler: BatchAssembler, *,
+                     workers: int = 2, depth: int = 4
+                     ) -> Iterator[StagedBatch]:
+        """The epoch as a multi-worker staged pipeline: ``workers`` decode/
+        augment/assemble threads fill preallocated staging buffers through
+        ``assembler``, results emitted in the exact order :meth:`epoch`
+        yields (same ``(seed, epoch)`` permutation — deterministic at any
+        worker count).  Opt-in SNR noise draws from a per-batch generator
+        seeded ``(noise_seed, epoch, batch)`` so augmentation is equally
+        order-independent.  The consumer must ``release()`` each
+        :class:`StagedBatch` when its host copy is done (the train loop
+        releases after device placement, docs/ARCHITECTURE.md)."""
+        order = self._epoch_order(epoch_idx)
+        n = len(self.source)
+        stop = (n // self.batch_size) * self.batch_size \
+            if self.drop_last else n
+
+        def tasks():
+            for seq, start in enumerate(range(0, stop, self.batch_size)):
+                yield seq, order[start:start + self.batch_size]
+
+        def work(task):
+            seq, idx = task
+            rng = np.random.default_rng(np.random.SeedSequence(
+                [assembler.noise_seed, epoch_idx, seq]))
+            return assembler.assemble(idx, rng=rng)
+
+        return worker_pool(tasks(), work, workers=workers, depth=depth)
 
     def epoch_index_plan(self, epoch_idx: int):
         """The epoch as a static-shape index plan: ``(idx [S, B] int32,

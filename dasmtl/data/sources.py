@@ -10,6 +10,7 @@ shuffling, padding and sharding.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
 import numpy as np
@@ -19,6 +20,16 @@ from dasmtl.data.splits import Example
 from dasmtl.data.transforms import add_gaussian_snr, to_sample
 
 
+@functools.lru_cache(maxsize=65536)
+def _mat_dims_cached(path: str, key: str):
+    """Per-file (rows, cols) via the native header parse, memoized: the
+    batch loader probes the first file of EVERY batch for its dims, which
+    is a full MAT-5 header walk per batch for archives whose shapes never
+    change mid-run.  Failures are not cached (lru_cache propagates and
+    forgets raising calls)."""
+    return native.mat_dims(path, key)
+
+
 class _SourceBase:
     distance: np.ndarray  # [N] int32
     event: np.ndarray  # [N] int32
@@ -26,8 +37,19 @@ class _SourceBase:
     def __len__(self) -> int:
         return self.distance.shape[0]
 
-    def gather(self, indices: np.ndarray) -> np.ndarray:
+    def gather(self, indices: np.ndarray,
+               rng: Optional[np.random.Generator] = None) -> np.ndarray:
         raise NotImplementedError
+
+    def gather_into(self, indices: np.ndarray, out: np.ndarray,
+                    rng: Optional[np.random.Generator] = None) -> None:
+        """Gather ``len(indices)`` examples into ``out[:n]`` — the
+        allocation-free path of the staged pipeline
+        (:class:`dasmtl.data.pipeline.BatchAssembler`).  ``out`` is a
+        preallocated ``[>=n, H, W, 1]`` buffer; subclasses override to
+        write in place, this default pays one gather allocation."""
+        n = np.asarray(indices).shape[0]
+        out[:n] = self.gather(indices, rng=rng)
 
 
 def _load_one(path: str, key: str, noise_snr_db: Optional[float],
@@ -39,23 +61,40 @@ def _load_one(path: str, key: str, noise_snr_db: Optional[float],
 
 
 def _load_batch(paths, key: str, noise_snr_db: Optional[float],
-                rng: Optional[np.random.Generator]) -> np.ndarray:
+                rng: Optional[np.random.Generator],
+                out: Optional[np.ndarray] = None) -> np.ndarray:
     """Load a list of same-shaped .mat files as [N, H, W, 1] float32, using
     the native multithreaded loader when it is available and falling back to
-    the per-file scipy path otherwise."""
+    the per-file scipy path otherwise.  With ``out`` (a preallocated
+    ``[N, H, W, 1]`` buffer) both paths decode straight into it — no
+    per-batch ``np.stack`` allocation."""
     paths = list(paths)
+    n = len(paths)
     if not paths:
-        return np.zeros((0, 0, 0, 1), np.float32)
+        return out if out is not None else np.zeros((0, 0, 0, 1), np.float32)
     if native.available():
         try:
-            rows, cols = native.mat_dims(paths[0], key)
-            batch = native.load_many_f32(paths, key, rows, cols)
+            rows, cols = _mat_dims_cached(paths[0], key)
+            if out is not None:
+                # [n, H, W] view of the NHWC buffer (contiguous: the
+                # trailing channel axis is 1 element).
+                view = out[:n, :, :, 0]
+                if not view.flags.c_contiguous:
+                    raise native.NativeMatError(-1, "non-contiguous out")
+                batch = native.load_many_f32(paths, key, rows, cols,
+                                             out=view)
+            else:
+                batch = native.load_many_f32(paths, key, rows, cols)
             if noise_snr_db is not None:
                 for i in range(batch.shape[0]):
                     batch[i] = add_gaussian_snr(batch[i], noise_snr_db, rng)
-            return batch[..., None]
+            return out[:n] if out is not None else batch[..., None]
         except native.NativeMatError:
             pass  # e.g. heterogeneous shapes or exotic MAT features
+    if out is not None:
+        for i, p in enumerate(paths):
+            out[i] = _load_one(p, key, noise_snr_db, rng)
+        return out[:n]
     return np.stack([_load_one(p, key, noise_snr_db, rng) for p in paths])
 
 
@@ -66,6 +105,7 @@ class RamSource(_SourceBase):
                  noise_snr_db: Optional[float] = None,
                  noise_seed: int = 0, show_progress: bool = False):
         self.examples = list(examples)
+        self.noise_seed = noise_seed
         rng = np.random.default_rng(noise_seed)
         if show_progress:
             print(f"preloading {len(self.examples)} .mat files "
@@ -75,8 +115,14 @@ class RamSource(_SourceBase):
         self.distance = np.array([ex.distance for ex in self.examples], np.int32)
         self.event = np.array([ex.event for ex in self.examples], np.int32)
 
-    def gather(self, indices: np.ndarray) -> np.ndarray:
-        return self.x[indices]
+    def gather(self, indices: np.ndarray,
+               rng: Optional[np.random.Generator] = None) -> np.ndarray:
+        return self.x[indices]  # noise (if any) was drawn once at preload
+
+    def gather_into(self, indices: np.ndarray, out: np.ndarray,
+                    rng: Optional[np.random.Generator] = None) -> None:
+        idx = np.asarray(indices)
+        np.take(self.x, idx, axis=0, out=out[:idx.shape[0]])
 
 
 class DiskSource(_SourceBase):
@@ -87,14 +133,26 @@ class DiskSource(_SourceBase):
         self.examples = list(examples)
         self.key = key
         self.noise_snr_db = noise_snr_db
+        self.noise_seed = noise_seed
         self._rng = np.random.default_rng(noise_seed)
         self.distance = np.array([ex.distance for ex in self.examples], np.int32)
         self.event = np.array([ex.event for ex in self.examples], np.int32)
 
-    def gather(self, indices: np.ndarray) -> np.ndarray:
+    def gather(self, indices: np.ndarray,
+               rng: Optional[np.random.Generator] = None) -> np.ndarray:
+        # The shared sequential generator is the legacy path; the staged
+        # pipeline passes a per-batch rng so parallel workers stay
+        # deterministic (dasmtl/data/pipeline.py BatchAssembler).
         return _load_batch(
             [self.examples[i].path for i in np.asarray(indices)],
-            self.key, self.noise_snr_db, self._rng)
+            self.key, self.noise_snr_db, rng if rng is not None
+            else self._rng)
+
+    def gather_into(self, indices: np.ndarray, out: np.ndarray,
+                    rng: Optional[np.random.Generator] = None) -> None:
+        _load_batch([self.examples[i].path for i in np.asarray(indices)],
+                    self.key, self.noise_snr_db,
+                    rng if rng is not None else self._rng, out=out)
 
 
 class ArraySource(_SourceBase):
@@ -106,8 +164,14 @@ class ArraySource(_SourceBase):
         self.distance = np.asarray(distance, np.int32)
         self.event = np.asarray(event, np.int32)
 
-    def gather(self, indices: np.ndarray) -> np.ndarray:
+    def gather(self, indices: np.ndarray,
+               rng: Optional[np.random.Generator] = None) -> np.ndarray:
         return self.x[indices]
+
+    def gather_into(self, indices: np.ndarray, out: np.ndarray,
+                    rng: Optional[np.random.Generator] = None) -> None:
+        idx = np.asarray(indices)
+        np.take(self.x, idx, axis=0, out=out[:idx.shape[0]])
 
 
 class SubsetSource(_SourceBase):
@@ -120,5 +184,11 @@ class SubsetSource(_SourceBase):
         self.distance = np.asarray(base.distance)[self.indices]
         self.event = np.asarray(base.event)[self.indices]
 
-    def gather(self, indices: np.ndarray) -> np.ndarray:
-        return self.base.gather(self.indices[np.asarray(indices)])
+    def gather(self, indices: np.ndarray,
+               rng: Optional[np.random.Generator] = None) -> np.ndarray:
+        return self.base.gather(self.indices[np.asarray(indices)], rng=rng)
+
+    def gather_into(self, indices: np.ndarray, out: np.ndarray,
+                    rng: Optional[np.random.Generator] = None) -> None:
+        self.base.gather_into(self.indices[np.asarray(indices)], out,
+                              rng=rng)

@@ -191,9 +191,19 @@ def main_process(cfg: Config, is_test: bool = False,
     if cfg.debug_nans:
         jax.config.update("jax_debug_nans", True)
 
+    # Reader selection BEFORE any source loads data: loader_native='on'
+    # must fail at startup, 'off' must force scipy for every later gather.
+    from dasmtl.data import native
+
+    native.configure(cfg.loader_native)
+
     run_dir = make_run_dir(cfg.output_savedir, cfg.model,  is_test)
     with Logger(os.path.join(run_dir, "console_output.log")):
         print(f"devices: {[str(d) for d in jax.devices()]}")
+        print(f"loader: workers={cfg.loader_workers} "
+              f"queue_depth={cfg.loader_queue_depth} "
+              f"native={cfg.loader_native} (resolved: "
+              f"{'native' if native.available() else 'scipy'})")
         with open(os.path.join(run_dir, "config.json"), "w") as f:
             f.write(cfg.to_json())
 

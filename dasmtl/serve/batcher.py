@@ -33,6 +33,9 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from dasmtl.data.pipeline import pad_to_bucket
+#: Re-export: the per-bucket staging freelist started here (PR 5) and now
+#: lives in the shared home both training and serving assemble through.
+from dasmtl.data.staging import StagingBuffers  # noqa: F401
 from dasmtl.serve.metrics import ServeMetrics
 from dasmtl.serve.queue import QueueClosed, Request, RequestQueue, ServeResult
 
@@ -88,40 +91,6 @@ class BatchPlan:
         x = np.stack([np.asarray(r.x, np.float32) for r in self.requests])
         batch = pad_to_bucket({"x": x[..., None]}, self.bucket)
         return batch["x"]
-
-
-class StagingBuffers:
-    """Preallocated per-bucket host batches for the pipelined data plane.
-
-    ``jax.Array`` construction on some backends may alias or lazily read a
-    host buffer, so a staging buffer must not be rewritten while its batch
-    could still be reading it: each bucket keeps ``depth`` buffers on a
-    freelist, acquired at batch-form time and released only after the
-    batch's collect.  With the serve loop's in-flight window of ``W``,
-    ``depth = W + 1`` (one extra for the batch being formed) makes
-    ``acquire`` effectively non-blocking; the blocking wait below is the
-    correctness backstop, not the steady state.
-    """
-
-    def __init__(self, buckets: Sequence[int], input_hw, depth: int):
-        h, w = int(input_hw[0]), int(input_hw[1])
-        self.depth = max(1, int(depth))
-        self._lock = threading.Lock()
-        self._available = threading.Condition(self._lock)
-        self._free = {int(b): [np.zeros((int(b), h, w, 1), np.float32)
-                               for _ in range(self.depth)]
-                      for b in buckets}
-
-    def acquire(self, bucket: int) -> np.ndarray:
-        with self._available:
-            while not self._free[bucket]:
-                self._available.wait()
-            return self._free[bucket].pop()
-
-    def release(self, bucket: int, buf: np.ndarray) -> None:
-        with self._available:
-            self._free[bucket].append(buf)
-            self._available.notify()
 
 
 class MicroBatcher:

@@ -93,7 +93,11 @@ class ServeLoop:
         self.batcher = MicroBatcher(buckets, max_wait_s, queue_depth,
                                     watermark, clock=clock,
                                     metrics=self.metrics)
-        self._staging = StagingBuffers(
+        # Per-bucket staging freelist (shared home: dasmtl/data/staging.py).
+        # depth = in-flight window + 1 (one extra for the batch being
+        # formed) keeps acquire effectively non-blocking; slots release at
+        # collect, when the computation is done with the host buffer.
+        self._staging = StagingBuffers.for_buckets(
             buckets, getattr(executor, "input_hw", (1, 1)),
             depth=self.inflight_window + 1)
         self._cv = threading.Condition()
@@ -210,7 +214,7 @@ class ServeLoop:
             t_formed = self.clock()
             handle = self.executor.dispatch(buf)
         except Exception as exc:  # noqa: BLE001 — must answer the callers
-            self._staging.release(plan.bucket, buf)
+            self._staging.release(buf)
             self._slots.release()
             self._fail_plan(plan, exc)
             return
@@ -236,7 +240,7 @@ class ServeLoop:
                 self._fail_plan(plan, exc)
                 continue
             finally:
-                self._staging.release(plan.bucket, buf)
+                self._staging.release(buf)
                 self._slots.release()
                 with self._cv:
                     self._inflight -= 1

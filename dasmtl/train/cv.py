@@ -36,6 +36,7 @@ from dasmtl.config import Config, mixed_label
 from dasmtl.data.device import DeviceDataset, resident_bytes, unwrap_source
 from dasmtl.data.pipeline import BatchIterator
 from dasmtl.data.sources import SubsetSource, _SourceBase
+from dasmtl.data.staging import StagingBuffers, stack_leaf
 from dasmtl.models.registry import ModelSpec
 from dasmtl.train import metrics as host_metrics
 from dasmtl.train.checkpoint import (CheckpointManager, best_metric_on_disk,
@@ -47,17 +48,46 @@ from dasmtl.train.state import TrainState
 from dasmtl.train.steps import make_cv_scan_train_step, make_gather_eval_step
 
 
+def _fold_leaves(states: Sequence[TrainState]):
+    treedef = jax.tree.structure(states[0])
+    return treedef, list(zip(*(jax.tree.leaves(s) for s in states)))
+
+
 def stack_states(states: Sequence[TrainState]) -> TrainState:
     """Fold-stack: every array leaf gains a leading ``[F]`` axis.
 
     Stacks by flattened leaves against the first state's treedef — the
     states' static fields (``apply_fn``, ``tx``) are distinct closure
     instances per ``build_state`` call, which a multi-tree ``tree.map``
-    would reject; the first state's statics serve the whole pack."""
-    treedef = jax.tree.structure(states[0])
-    leaves = zip(*(jax.tree.leaves(s) for s in states))
-    return jax.tree.unflatten(
-        treedef, [np.stack([np.asarray(x) for x in ls]) for ls in leaves])
+    would reject; the first state's statics serve the whole pack.  Each
+    leaf is written straight into one ``[F, ...]`` output
+    (:func:`dasmtl.data.staging.stack_leaf`) — not the old
+    ``np.stack([np.asarray(x) for x in ls])``, which paid a host copy per
+    fold per leaf *plus* the stack's own allocation."""
+    treedef, leaf_lists = _fold_leaves(states)
+    return jax.tree.unflatten(treedef,
+                              [stack_leaf(ls) for ls in leaf_lists])
+
+
+def stack_states_staged(states: Sequence[TrainState],
+                        staging: StagingBuffers):
+    """:func:`stack_states` through a reused staging slot: the ``[F, ...]``
+    pack buffers come from (and return to) ``staging``'s freelist, so a
+    repeated pack (init + every ``--resume``) reuses one allocation.
+    Returns ``(packed_state, buf)`` — after placing the pack on device the
+    caller MUST hand the lease back via
+    ``staging.release_placed(buf, placed_state)`` (alias-checked, see
+    dasmtl/data/staging.py)."""
+    treedef, leaf_lists = _fold_leaves(states)
+    key = ("state_pack", len(states))
+    if not staging.has_slot(key):
+        staging.add_slot(key, [((len(ls),) + tuple(np.shape(ls[0])),
+                                np.dtype(ls[0].dtype))
+                               for ls in leaf_lists])
+    buf = staging.acquire(key)
+    for out, ls in zip(buf, leaf_lists):
+        stack_leaf(ls, out=out)
+    return jax.tree.unflatten(treedef, buf), buf
 
 
 def slice_state(packed: TrainState, fold: int) -> TrainState:
@@ -134,7 +164,10 @@ class CVTrainer:
         if states is None:
             states = [build_state(cfg, spec) for _ in range(self.n_folds)]
         self._template = states[0]  # shapes/statics for checkpoint restore
-        self.states = self._place_states(stack_states(states))
+        # One pack buffer, reused by every fold-stack of the run (init +
+        # resume) — the shared staging home of dasmtl/data/staging.py.
+        self._staging = StagingBuffers(depth=1)
+        self.states = self._pack_and_place(states)
         self.cv_step = make_cv_scan_train_step(spec, mesh_plan)
         self.eval_step = make_gather_eval_step(spec)
         self.iters = [BatchIterator(_IndexSpace(len(ix)), cfg.batch_size,
@@ -157,6 +190,14 @@ class CVTrainer:
         self._preempted = True
 
     # -- placement -----------------------------------------------------------
+    def _pack_and_place(self, states: Sequence[TrainState]) -> TrainState:
+        """Fold-stack through the reused staging slot, place on device,
+        and return the pack buffers to the freelist (alias-checked)."""
+        packed, buf = stack_states_staged(states, self._staging)
+        placed = self._place_states(packed)
+        self._staging.release_placed(buf, placed)
+        return placed
+
     def _place_states(self, packed: TrainState) -> TrainState:
         if self.mesh_plan is None:
             return jax.device_put(packed)
@@ -337,7 +378,7 @@ class CVTrainer:
             return None
         restored = [self.fold_ckpts[f].restore(self._template, best_paths[f])
                     for f in range(self.n_folds)]
-        self.states = self._place_states(stack_states(restored))
+        self.states = self._pack_and_place(restored)
         for f in range(self.n_folds):
             self.fold_ckpts[f].seed_best(best_metric_on_disk(
                 os.path.join(best_run, f"fold{f}")))

@@ -50,7 +50,8 @@ from dasmtl.analysis.sanitize.checks import StepSanitizer
 from dasmtl.analysis.sanitize.divergence import DivergenceMonitor
 from dasmtl.config import Config, mixed_label
 from dasmtl.data.device import DeviceDataset, resident_bytes, unwrap_source
-from dasmtl.data.pipeline import BatchIterator, eval_batches, prefetch
+from dasmtl.data.pipeline import (BatchAssembler, BatchIterator, eval_batches,
+                                  prefetch)
 from dasmtl.models.registry import ModelSpec
 from dasmtl.parallel.mesh import MeshPlan, shard_batch
 from dasmtl.train import metrics as host_metrics
@@ -199,6 +200,10 @@ class Trainer:
         self._val_device: Optional[DeviceDataset] = None
         self._gather_eval_step = None
         self._val_device_noticed = False
+        # Staged training input pipeline (decode -> augment -> assemble
+        # into reused staging buffers; dasmtl/data/pipeline.py), lazily
+        # built so eval-only uses never allocate the freelist.
+        self._assembler: Optional[BatchAssembler] = None
         # Runtime tracing-discipline guards (dasmtl/analysis/guards.py),
         # armed by fit() when cfg.tracing_guards is set.
         self.guards: Optional[StepGuards] = None
@@ -480,45 +485,93 @@ class Trainer:
         if not self._preempted:
             self.state = self.state.replace(epoch=self.state.epoch + 1)
 
+    def _get_assembler(self) -> BatchAssembler:
+        """The staged-batch assembler, persistent across epochs so the
+        staging freelist is allocated once per run.  Depth covers the
+        worker pool's bounded queue plus the loop's double buffer (the
+        current batch and the one whose H2D is in flight)."""
+        if self._assembler is None:
+            cfg = self.cfg
+            depth = max(cfg.loader_queue_depth, cfg.loader_workers, 1) + 2
+            self._assembler = BatchAssembler(self.train_iter.source,
+                                             self.train_iter.batch_size,
+                                             depth=depth)
+        return self._assembler
+
     def _train_epoch(self, epoch: int, lr: float) -> None:
+        """One epoch on the host pipeline, fully staged:
+
+            workers: decode -> augment -> assemble (staging buffers)
+            loop:    H2D of batch i+1 (async device_put)  ||  step i compute
+
+        The worker pool (``loader_workers`` threads, deterministic batch
+        order at any count) keeps ``loader_queue_depth`` assembled host
+        batches ready; the loop double-buffers device placement — batch
+        i+1 is placed (an *explicit*, sharding-aware ``device_put``,
+        outside the guarded step body) right after step i's async
+        dispatch, so its H2D overlaps step i's compute instead of
+        preceding step i+1 on the critical path.  Each staging slot is
+        released once its placement is transfer-complete and
+        alias-checked (dasmtl/data/staging.py)."""
         if self._use_device_data():
             self._train_epoch_device(epoch, lr)
             return
+        cfg = self.cfg
         window: Dict[str, float] = {}
         t0 = time.perf_counter()
         # jnp scalar, not np.float32: a numpy argument is an implicit H2D
         # transfer on EVERY step — the exact defect the transfer guard
         # polices.  One explicit placement per epoch instead.
         lr_arr = jnp.float32(lr)
-        batches = prefetch(self.train_iter.epoch(epoch),
-                           depth=self.cfg.prefetch_batches,
-                           place_fn=self._place)
-        last_step = -1
-        for i, batch in enumerate(batches):
-            last_step = i
-            prev_state = self.state  # alive for the sanitize replay
-            with self._step_guard():
-                self.state, step_metrics = self.train_step(
-                    self.state, batch, lr_arr)
-            if self._sanitizer is not None:
-                # Outside the guarded region: the probe/fingerprint pulls
-                # are explicit, but they block on the step.
-                where = f"epoch {epoch} step {i}"
-                self._sanitizer.after_step(prev_state, batch, lr_arr,
-                                           self.state, step_metrics,
-                                           context=where)
-                self._divergence.maybe_check(self.state, context=where)
-            # Accumulate device scalars without forcing a sync each step.
-            for k, v in step_metrics.items():
-                window[k] = window.get(k, 0.0) + v
-            if (i + 1) % self.cfg.log_every_steps == 0:
-                self._flush_window(epoch, i, window, t0)
-                window = {}
-                t0 = time.perf_counter()
-            if self._preempted:
-                break
+        stream = self.train_iter.epoch_staged(
+            epoch, self._get_assembler(), workers=cfg.loader_workers,
+            depth=cfg.loader_queue_depth)
+        i = -1
+        cur = placed = None
+        try:
+            cur = next(stream, None)
+            placed = self._place(cur.data) if cur is not None else None
+            while cur is not None:
+                i += 1
+                prev_state = self.state  # alive for the sanitize replay
+                with self._step_guard():
+                    self.state, step_metrics = self.train_step(
+                        self.state, placed, lr_arr)
+                # Pull + place batch i+1 NOW: the dispatch above returned
+                # immediately (async), so this H2D runs while step i
+                # computes.
+                nxt = next(stream, None)
+                nxt_placed = self._place(nxt.data) if nxt is not None \
+                    else None
+                cur.release(placed)  # staging slot back, alias-safe
+                cur, done_placed = nxt, placed
+                if self._sanitizer is not None:
+                    # Outside the guarded region: the probe/fingerprint
+                    # pulls are explicit, but they block on the step.
+                    where = f"epoch {epoch} step {i}"
+                    self._sanitizer.after_step(prev_state, done_placed,
+                                               lr_arr, self.state,
+                                               step_metrics, context=where)
+                    self._divergence.maybe_check(self.state, context=where)
+                placed = nxt_placed
+                # Accumulate device scalars without forcing a per-step sync.
+                for k, v in step_metrics.items():
+                    window[k] = window.get(k, 0.0) + v
+                if (i + 1) % cfg.log_every_steps == 0:
+                    self._flush_window(epoch, i, window, t0)
+                    window = {}
+                    t0 = time.perf_counter()
+                if self._preempted:
+                    # Preemption stops at the step boundary AFTER the step
+                    # that observed it — same semantics as the pre-staged
+                    # loop (pinned by test_preempt_stops_early...).
+                    break
+        finally:
+            if cur is not None:  # preemption/exception: return the lease
+                cur.release(placed)
+            stream.close()  # stop + join the worker pool
         if window:
-            self._flush_window(epoch, last_step, window, t0)
+            self._flush_window(epoch, i, window, t0)
         if not self._preempted:
             # A preempted (partial) epoch keeps its counter so resume re-runs
             # the epoch from its shuffle-deterministic start.

@@ -111,6 +111,18 @@ def collect() -> dict:
         "bn_sync": d.bn_sync,
     }
 
+    # Training input pipeline (dasmtl/data/pipeline.py worker pool +
+    # staging freelist): the resolved loader config plus which .mat
+    # reader the default mode would actually use on this host.
+    info["loader"] = {
+        "workers": d.loader_workers,
+        "queue_depth": d.loader_queue_depth,
+        "native_mode": d.loader_native,
+        "native_resolved": "native" if (
+            d.loader_native != "off" and info["native_loader"]["available"]
+        ) else "scipy-fallback",
+    }
+
     # Online-serving defaults (dasmtl/serve/, docs/SERVING.md): the knobs
     # that decide latency-vs-occupancy and when the server sheds load.
     info["serve_defaults"] = {
@@ -249,6 +261,11 @@ def main(argv=None) -> int:
           f"({nl['library']})")
     print("  perf defaults: " + ", ".join(
         f"{k}={v}" for k, v in info["perf_defaults"].items()))
+    ld = info["loader"]
+    print(f"  loader: workers={ld['workers']} "
+          f"queue_depth={ld['queue_depth']} native={ld['native_mode']} "
+          f"-> {ld['native_resolved']} "
+          "(dasmtl/data/pipeline.py; docs/ARCHITECTURE.md input pipeline)")
     print("  serve defaults: " + ", ".join(
         f"{k}={v}" for k, v in info["serve_defaults"].items())
         + " (dasmtl-serve; docs/SERVING.md)")

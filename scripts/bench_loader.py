@@ -1,13 +1,38 @@
-"""Native C++ MAT loader vs scipy: data-path throughput measurement.
+"""Staged training-input-pipeline benchmark (the evidence behind
+BENCH_loader.json and the CI ``loader`` job).
 
 The reference's whole data path is single-threaded ``scipy.io.loadmat``
-(reference dataset_preparation.py:263,312 + ``num_workers=0`` DataLoaders,
-utils.py:152-156).  This measures the framework's GIL-free multithreaded C++
-loader (native/dasmat.cpp) against the scipy fallback on the same synthetic
-tree and prints one JSON line per path — the evidence behind the loader row
-in BASELINE.md.
+plus a per-batch ``np.stack`` (reference dataset_preparation.py:263,312 +
+``num_workers=0`` DataLoaders, utils.py:152-156), and BENCH_r02-r05 show
+training samples/s flat since seed because of it.  This script measures
+the rebuilt pipeline (dasmtl/data/pipeline.py) stage by stage, each stage
+adding one component, so a regression names its own culprit:
+
+    decode          .mat bytes -> float32 windows (native AND scipy legs)
+    decode_augment  + SNR-targeted Gaussian noise (the augmentation hook)
+    assemble        + staging-buffer batch assembly (BatchAssembler, inline)
+    assemble_h2d    + jax.device_put + alias-checked staging release
+    e2e_staged      the full pipeline: worker pool + staging + the train
+                    loop's double-buffered H2D overlap
+    baseline_*      the pre-rebuild path: np.stack assembly behind a single
+                    prefetch thread + device_put (the scipy leg is the
+                    reference-equivalent configuration BENCH_r* measured)
+
+``--smoke`` additionally asserts the pipeline's invariants and exits
+nonzero on any violation (the CI gate):
+
+    * deterministic batch order: workers=1 vs workers=4 produce an
+      int-exact identical batch stream (the PR 3 convention), augmentation
+      noise included;
+    * staging freelist bounds: no leaked leases, peak outstanding within
+      the configured depth;
+    * train-loop overlap discipline: a short guarded training run
+      (Config.tracing_guards) finishes with 0 transfer-guard violations
+      and 0 post-warmup recompiles.
 
     python scripts/bench_loader.py [--files 256] [--repeats 3]
+                                   [--workers 4] [--out BENCH_loader.json]
+    python scripts/bench_loader.py --smoke        # CI: small + asserts
 """
 
 from __future__ import annotations
@@ -15,6 +40,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import sys
 import tempfile
 import time
@@ -23,80 +49,348 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
+HW = (100, 250)  # the paper's window (PAPER.md)
+SMOKE_HW = (52, 64)  # CI-sized
+AUGMENT_SNR_DB = 10.0
+
+
+def _write_tree(tmp, n_files, hw, compressed):
+    from dasmtl.data import matio
+    from dasmtl.data.splits import Example
+
+    rng = np.random.default_rng(0)
+    examples = []
+    for i in range(n_files):
+        p = os.path.join(tmp, f"s{i:05d}.mat")
+        matio.save_mat(p, rng.normal(size=hw), do_compression=compressed)
+        examples.append(Example(path=p, distance=i % 16, event=i % 2))
+    return examples
+
+
+def _timed(fn, repeats):
+    """Best wall time over ``repeats`` runs of ``fn`` (returns last out)."""
+    best, out = None, None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return out, best
+
+
+def _stage(samples, dt):
+    return {"samples_per_s": round(samples / dt, 1),
+            "wall_ms": round(dt * 1e3, 1), "samples": samples}
+
+
+def _decode_leg(paths, batch_size, snr, seed):
+    """One pass over every file through _load_batch in batch_size chunks."""
+    from dasmtl.data.sources import _load_batch
+
+    rng = np.random.default_rng(seed) if snr is not None else None
+    for start in range(0, len(paths), batch_size):
+        _load_batch(paths[start:start + batch_size], "data", snr, rng)
+
+
+def _assemble_epoch(it, assembler, snr_epoch=0, h2d=False):
+    """Inline (workers=0) assembly of one epoch; optionally + device_put."""
+    import jax
+
+    order = it._epoch_order(snr_epoch)
+    n = len(it.source)
+    for seq, start in enumerate(range(0, n, it.batch_size)):
+        idx = order[start:start + it.batch_size]
+        rng = np.random.default_rng(np.random.SeedSequence(
+            [assembler.noise_seed, snr_epoch, seq]))
+        sb = assembler.assemble(idx, rng=rng)
+        if h2d:
+            placed = jax.device_put(sb.data)
+            sb.release(placed)
+        else:
+            sb.release()
+
+
+def _e2e_epoch(it, assembler, workers, depth, epoch=0):
+    """The train loop's data plane: worker pool + double-buffered H2D."""
+    import jax
+
+    stream = it.epoch_staged(epoch, assembler, workers=workers, depth=depth)
+    try:
+        cur = next(stream, None)
+        placed = jax.device_put(cur.data) if cur is not None else None
+        while cur is not None:
+            nxt = next(stream, None)
+            nxt_placed = jax.device_put(nxt.data) if nxt is not None else None
+            cur.release(placed)
+            cur, placed = nxt, nxt_placed
+    finally:
+        stream.close()
+
+
+def _baseline_epoch(it, prefetch_depth=2, epoch=0):
+    """The pre-rebuild path, exactly as Trainer._train_epoch ran it: one
+    prefetch thread doing np.stack assembly (_make_batch) AND the
+    device_put (place_fn ran in the worker), the consumer just iterating."""
+    import jax
+
+    from dasmtl.data.pipeline import prefetch
+
+    for _placed in prefetch(it.epoch(epoch), depth=prefetch_depth,
+                            place_fn=jax.device_put):
+        pass
+
+
+def check_determinism(examples, batch_size, snr, key="data"):
+    """workers=1 vs workers=4 must yield an int-exact identical batch
+    stream (the PR 3 convention), SNR augmentation included.  Returns the
+    number of batches compared; raises AssertionError on any mismatch."""
+    from dasmtl.data.pipeline import BatchAssembler, BatchIterator
+    from dasmtl.data.sources import DiskSource
+
+    streams, batches = [], 0
+    for workers in (1, 4):
+        src = DiskSource(examples, key=key, noise_snr_db=snr, noise_seed=7)
+        it = BatchIterator(src, batch_size, seed=3)
+        asm = BatchAssembler(src, batch_size, depth=8)
+        streams.append(it.epoch_staged(1, asm, workers=workers, depth=4))
+    try:
+        for a, b in zip(*streams):
+            for k in a.data:
+                if not np.array_equal(a.data[k], b.data[k]):
+                    raise AssertionError(
+                        f"batch {batches} key {k!r}: workers=1 and "
+                        f"workers=4 streams diverge")
+            a.release()
+            b.release()
+            batches += 1
+    finally:
+        for s in streams:
+            s.close()
+    if batches == 0:
+        raise AssertionError("determinism check compared zero batches")
+    return batches
+
+
+def guarded_train_smoke(workers, tmp):
+    """A short REAL training run (tiny synthetic set, full MTL step) with
+    StepGuards armed: epoch 0 warms up, epoch 1 runs with the transfer
+    guard at 'disallow' and the recompile counter raising — proving the
+    overlap loop introduces no hidden syncs/recompiles.  Returns the
+    guards summary."""
+    import jax
+
+    from dasmtl.config import Config
+    from dasmtl.data.pipeline import BatchIterator
+    from dasmtl.data.sources import ArraySource
+    from dasmtl.main import build_state
+    from dasmtl.models.registry import get_model_spec
+    from dasmtl.train.loop import Trainer
+
+    hw = SMOKE_HW
+    rng = np.random.default_rng(0)
+    n = 48
+    x = rng.normal(size=(n,) + hw + (1,)).astype(np.float32)
+    src = ArraySource(x, rng.integers(0, 16, n), rng.integers(0, 2, n))
+    cfg = Config(model="MTL", batch_size=16, epoch_num=2, val_every=10,
+                 ckpt_every_epochs=0, log_every_steps=100,
+                 tracing_guards=True, guard_transfer="disallow",
+                 loader_workers=workers, output_savedir=tmp)
+    spec = get_model_spec("MTL")
+    state = build_state(cfg, spec, input_hw=hw)
+    run_dir = os.path.join(tmp, "guard_run")
+    os.makedirs(run_dir, exist_ok=True)
+    tr = Trainer(cfg, spec, state, BatchIterator(src, cfg.batch_size, seed=0),
+                 src, run_dir)
+    tr.fit()
+    summary = dict(tr.guards.summary())
+    summary["backend"] = jax.default_backend()
+    return summary
+
+
+def write_job_summary(report: dict, path=None) -> None:
+    """Append the staged breakdown as markdown to ``path`` (CI's
+    ``$GITHUB_STEP_SUMMARY``)."""
+    path = path or os.environ.get("GITHUB_STEP_SUMMARY")
+    if not path:
+        return
+    lines = [
+        "### loader bench "
+        f"({report['config']['files']} files @ {report['config']['hw']}, "
+        f"workers={report['config']['workers']})",
+        "",
+        f"- native reader: **{report['native_available']}**",
+        f"- e2e vs scipy/np.stack baseline: "
+        f"**{report.get('speedup_e2e_vs_baseline_scipy', 'n/a')}x** "
+        f"(vs native/np.stack: "
+        f"{report.get('speedup_e2e_vs_baseline_native', 'n/a')}x)",
+        "",
+        "| stage | samples/s |",
+        "|---|---|",
+    ]
+    for name, st in report["stages"].items():
+        lines.append(f"| {name} | {st['samples_per_s']} |")
+    guards = report.get("train_guards")
+    if guards:
+        lines += ["",
+                  f"- train-loop overlap guards: "
+                  f"{guards['steps']} steps, "
+                  f"post-warmup recompiles **"
+                  f"{guards['post_warmup_compiles']}**, transfer guard "
+                  f"`{guards['transfer_guard']}` (0 violations — a "
+                  "violation raises)"]
+    with open(path, "a") as f:
+        f.write("\n".join(lines) + "\n\n")
+
 
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--files", type=int, default=256)
+    ap.add_argument("--batch-size", type=int, default=32)
     ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--queue-depth", type=int, default=4)
     ap.add_argument("--compressed", action="store_true",
                     help="write zlib-compressed MAT files")
+    ap.add_argument("--out", type=str, default=None,
+                    help="write the full report JSON here "
+                         "(e.g. BENCH_loader.json)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI mode: small fixture + invariant asserts "
+                         "(determinism, staging bounds, guarded train run)")
+    ap.add_argument("--skip-train-smoke", action="store_true",
+                    help="skip the guarded training leg (bench-only)")
     args = ap.parse_args()
 
-    import shutil
+    import jax
 
-    from dasmtl.data import matio, native
+    from dasmtl.data import native
+    from dasmtl.data.pipeline import BatchAssembler, BatchIterator
+    from dasmtl.data.sources import DiskSource
+
+    if args.smoke:
+        args.files = min(args.files, 96)
+        args.repeats = min(args.repeats, 2)
+    hw = SMOKE_HW if args.smoke else HW
 
     tmp = tempfile.mkdtemp(prefix="dasmtl_loaderbench_")
+    failures = []
     try:
-        return _run(args, tmp, matio, native)
+        examples = _write_tree(tmp, args.files, hw, args.compressed)
+        paths = [ex.path for ex in examples]
+        n = len(paths)
+        report = {
+            "bench": "loader",
+            "backend": jax.default_backend(),
+            "cpus": os.cpu_count(),
+            "native_available": native.available(),
+            "config": {"files": n, "hw": f"{hw[0]}x{hw[1]}",
+                       "batch_size": args.batch_size,
+                       "workers": args.workers,
+                       "queue_depth": args.queue_depth,
+                       "compressed": bool(args.compressed),
+                       "repeats": args.repeats},
+            "stages": {},
+        }
+        stages = report["stages"]
+
+        # -- decode (scipy, then native) ---------------------------------
+        native.configure("off")
+        _, dt = _timed(lambda: _decode_leg(paths, args.batch_size, None, 0),
+                       args.repeats)
+        stages["decode_scipy"] = _stage(n, dt)
+        native.configure("auto")
+        if native.available():
+            _, dt = _timed(
+                lambda: _decode_leg(paths, args.batch_size, None, 0),
+                args.repeats)
+            stages["decode_native"] = _stage(n, dt)
+            _, dt = _timed(
+                lambda: _decode_leg(paths, args.batch_size,
+                                    AUGMENT_SNR_DB, 0),
+                args.repeats)
+            stages["decode_augment"] = _stage(n, dt)
+        else:
+            print("loader bench: native reader unavailable — scipy legs "
+                  "only", file=sys.stderr)
+
+        # -- assemble / +H2D (inline, staging buffers) -------------------
+        src = DiskSource(examples, noise_snr_db=None, noise_seed=0)
+        it = BatchIterator(src, args.batch_size, seed=3)
+        asm = BatchAssembler(src, args.batch_size,
+                             depth=args.queue_depth + 2)
+        _, dt = _timed(lambda: _assemble_epoch(it, asm), args.repeats)
+        stages["assemble"] = _stage(n, dt)
+        _, dt = _timed(lambda: _assemble_epoch(it, asm, h2d=True),
+                       args.repeats)
+        stages["assemble_h2d"] = _stage(n, dt)
+
+        # -- end-to-end staged pipeline vs the pre-rebuild baseline ------
+        _, dt = _timed(lambda: _e2e_epoch(it, asm, args.workers,
+                                          args.queue_depth), args.repeats)
+        stages["e2e_staged"] = _stage(n, dt)
+        staging_stats = asm.staging.stats()
+        report["staging"] = staging_stats
+
+        _, dt = _timed(lambda: _baseline_epoch(it), args.repeats)
+        stages["baseline_stack_native" if native.available()
+               else "baseline_stack"] = _stage(n, dt)
+        native.configure("off")
+        _, dt = _timed(lambda: _baseline_epoch(it), args.repeats)
+        stages["baseline_stack_scipy"] = _stage(n, dt)
+        native.configure("auto")
+
+        e2e = stages["e2e_staged"]["samples_per_s"]
+        base_scipy = stages["baseline_stack_scipy"]["samples_per_s"]
+        report["speedup_e2e_vs_baseline_scipy"] = round(e2e / base_scipy, 2)
+        if "baseline_stack_native" in stages:
+            report["speedup_e2e_vs_baseline_native"] = round(
+                e2e / stages["baseline_stack_native"]["samples_per_s"], 2)
+
+        # -- invariants ---------------------------------------------------
+        if staging_stats["outstanding"] != 0:
+            failures.append(f"staging leak: {staging_stats['outstanding']} "
+                            "leases never released")
+        if staging_stats["peak_outstanding"] > asm.staging.depth:
+            failures.append(
+                f"staging bound violated: peak outstanding "
+                f"{staging_stats['peak_outstanding']} > depth "
+                f"{asm.staging.depth}")
+        batches = check_determinism(examples, args.batch_size,
+                                    AUGMENT_SNR_DB)
+        report["determinism"] = {"batches_compared": batches,
+                                 "workers_compared": [1, 4], "exact": True}
+
+        if not args.skip_train_smoke:
+            report["train_guards"] = guarded_train_smoke(args.workers, tmp)
+            if report["train_guards"]["post_warmup_compiles"] != 0:
+                failures.append(
+                    f"train overlap loop: "
+                    f"{report['train_guards']['post_warmup_compiles']} "
+                    "post-warmup recompiles (expected 0)")
+
+        report["passed"] = not failures
+        report["failures"] = failures
+        for name, st in stages.items():
+            print(json.dumps({"metric": f"loader_{name}_samples_per_s",
+                              "value": st["samples_per_s"],
+                              "unit": "samples/s", **report["config"]}))
+        print(json.dumps({
+            "metric": "loader_e2e_speedup_vs_baseline_scipy",
+            "value": report["speedup_e2e_vs_baseline_scipy"], "unit": "x"}))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=2, sort_keys=True)
+                f.write("\n")
+        write_job_summary(report)
+        for f in failures:
+            print(f"loader bench FAIL: {f}", file=sys.stderr)
+        return 0 if not failures else 1
+    except AssertionError as exc:
+        print(f"loader bench FAIL: {exc}", file=sys.stderr)
+        return 1
     finally:
+        native.configure("auto")
         shutil.rmtree(tmp, ignore_errors=True)
-
-
-def _run(args, tmp, matio, native) -> int:
-    rng = np.random.default_rng(0)
-    paths = []
-    for i in range(args.files):
-        p = os.path.join(tmp, f"s{i:05d}.mat")
-        matio.save_mat(p, rng.normal(size=(100, 250)),
-                       do_compression=args.compressed)
-        paths.append(p)
-
-    def timed(fn):
-        best = None
-        for _ in range(args.repeats):
-            t0 = time.perf_counter()
-            out = fn()
-            dt = time.perf_counter() - t0
-            best = dt if best is None else min(best, dt)
-        return out, best
-
-    results = {}
-    if native.available():
-        rows, cols = native.mat_dims(paths[0])
-        (batch, dt) = timed(lambda: native.load_many_f32(
-            paths, "data", rows, cols))
-        assert batch.shape == (args.files, rows, cols)
-        results["native"] = dt
-    else:
-        print("native loader unavailable; scipy only", file=sys.stderr)
-
-    def scipy_batch():
-        return np.stack([matio.load_mat(p) for p in paths])
-
-    (ref, dt) = timed(scipy_batch)
-    results["scipy"] = dt
-
-    if "native" in results:
-        # Parity while we're here.
-        np.testing.assert_allclose(batch, ref.astype(np.float32), rtol=1e-6)
-
-    for name, dt in results.items():
-        print(json.dumps({
-            "metric": f"mat_load_files_per_s_{name}",
-            "value": round(args.files / dt, 1),
-            "unit": "files/s",
-            "files": args.files,
-            "compressed": bool(args.compressed),
-            "batch_ms": round(dt * 1e3, 1),
-        }))
-    if "native" in results:
-        print(json.dumps({
-            "metric": "native_vs_scipy_speedup",
-            "value": round(results["scipy"] / results["native"], 2),
-            "unit": "x",
-        }))
-    return 0
 
 
 if __name__ == "__main__":

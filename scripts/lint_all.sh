@@ -50,4 +50,14 @@ else
     echo "== dasmtl serve selftest skipped (DASMTL_LINT_SKIP_SERVE set)"
 fi
 
+# Training-loader smoke: staged-pipeline invariants (worker-determinism,
+# staging bounds, guarded short train run) on a small synthetic tree.
+# CI's loader job runs the same leg after building the native extension.
+if [ "${DASMTL_LINT_SKIP_LOADER:-}" = "" ]; then
+    echo "== bench_loader --smoke"
+    python scripts/bench_loader.py --smoke || rc=1
+else
+    echo "== bench_loader smoke skipped (DASMTL_LINT_SKIP_LOADER set)"
+fi
+
 exit $rc

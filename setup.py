@@ -1,0 +1,52 @@
+"""Optional native-extension build hook (pyproject.toml carries the real
+package metadata; setuptools invokes this for the ext_modules only).
+
+``native/dasmat.cpp`` — the GIL-free MAT-5 parser + multithreaded batch
+loader behind ``dasmtl.data.native`` — is compiled at install time into an
+ordinary setuptools extension ``dasmtl.data._dasmat``.  It is never
+imported (no ``PyInit`` needed): ``native.py`` ctypes-loads the shared
+object it finds next to the package.  The build is strictly OPTIONAL —
+any toolchain failure (no g++, no zlib headers, exotic platform) degrades
+to a pure-Python install, where ``native.py`` falls back to its on-demand
+cached build and, failing that, the scipy reader.  A failed compile must
+never fail ``pip install``.
+"""
+
+from setuptools import Extension, setup
+from setuptools.command.build_ext import build_ext
+
+
+class optional_build_ext(build_ext):  # noqa: N801 — setuptools convention
+    """build_ext that downgrades every failure to a warning."""
+
+    def run(self):
+        try:
+            super().run()
+        except Exception as exc:  # noqa: BLE001 — optional by design
+            self._skip(exc)
+
+    def build_extension(self, ext):
+        try:
+            super().build_extension(ext)
+        except Exception as exc:  # noqa: BLE001 — optional by design
+            self._skip(exc)
+
+    @staticmethod
+    def _skip(exc):
+        print(f"WARNING: optional native MAT reader not built ({exc}); "
+              "dasmtl will compile it on demand or fall back to scipy "
+              "(dasmtl/data/native.py)")
+
+
+setup(
+    ext_modules=[
+        Extension(
+            "dasmtl.data._dasmat",
+            sources=["native/dasmat.cpp"],
+            language="c++",
+            extra_compile_args=["-O3", "-std=c++17"],
+            libraries=["z"],
+        )
+    ],
+    cmdclass={"build_ext": optional_build_ext},
+)

@@ -1,0 +1,269 @@
+"""Multi-worker staged training input pipeline (dasmtl/data/pipeline.py
+worker_pool/BatchAssembler/epoch_staged + dasmtl/data/staging.py).
+
+Pins the PR invariants: deterministic batch order at ANY worker count
+(int-exact, the PR 3 convention — augmentation noise included), staging
+freelist reuse/bounds and the alias-retirement release protocol, and
+clean worker shutdown on an abandoned iterator (extending the PR 5
+prefetch-join tests)."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+import scipy.io
+
+from dasmtl.data.pipeline import (BatchAssembler, BatchIterator,
+                                  worker_pool)
+from dasmtl.data.sources import ArraySource, DiskSource
+from dasmtl.data.splits import Example
+from dasmtl.data.staging import (StagingBuffers, aligned_zeros,
+                                 leaf_aliased, stack_leaf)
+
+
+def _array_source(n=40, hw=(8, 9)):
+    rng = np.random.default_rng(0)
+    return ArraySource(rng.normal(size=(n,) + hw + (1,)),
+                       rng.integers(0, 16, n), rng.integers(0, 2, n))
+
+
+def _disk_source(tmp_path, n=20, hw=(8, 9), snr=None):
+    rng = np.random.default_rng(5)
+    examples = []
+    for i in range(n):
+        p = str(tmp_path / f"w{i:03d}.mat")
+        scipy.io.savemat(p, {"data": rng.normal(size=hw)})
+        examples.append(Example(path=p, distance=i % 16, event=i % 2))
+    return DiskSource(examples, noise_snr_db=snr, noise_seed=11)
+
+
+# -- worker_pool ------------------------------------------------------------
+@pytest.mark.parametrize("workers", [0, 1, 2, 4])
+def test_worker_pool_preserves_input_order(workers):
+    # Make later items finish FIRST so order preservation is actually
+    # exercised, not coincidental.
+    def work(i):
+        time.sleep(0.02 if i < 3 else 0.0)
+        return i * i
+
+    out = list(worker_pool(iter(range(12)), work, workers=workers, depth=4))
+    assert out == [i * i for i in range(12)]
+
+
+def test_worker_pool_exception_surfaces_at_its_position():
+    def work(i):
+        if i == 5:
+            raise RuntimeError("boom at 5")
+        return i
+
+    it = worker_pool(iter(range(10)), work, workers=3, depth=4)
+    got = [next(it) for _ in range(5)]
+    assert got == list(range(5))
+    with pytest.raises(RuntimeError, match="boom at 5"):
+        next(it)
+
+
+def test_worker_pool_bounds_in_flight_items():
+    lock = threading.Lock()
+    active = {"now": 0, "peak": 0}
+
+    def work(i):
+        with lock:
+            active["now"] += 1
+            active["peak"] = max(active["peak"], active["now"])
+        time.sleep(0.005)
+        with lock:
+            active["now"] -= 1
+        return i
+
+    depth = 3
+    out = list(worker_pool(iter(range(24)), work, workers=2, depth=depth))
+    assert out == list(range(24))
+    # in-progress items can never exceed the in-flight ticket budget
+    assert active["peak"] <= max(depth, 2)
+
+
+def _live_loader_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("dasmtl-loader") and t.is_alive()]
+
+
+def test_worker_pool_break_joins_all_workers():
+    """break -> GeneratorExit must stop, wake and JOIN every worker —
+    the prefetch shutdown contract (PR 5) extended to the pool."""
+    assert not _live_loader_threads()
+
+    def consume():
+        for i, _ in enumerate(worker_pool(iter(range(10_000)), lambda x: x,
+                                          workers=4, depth=4)):
+            if i == 3:
+                break
+
+    consume()
+    deadline = time.monotonic() + 5.0
+    while _live_loader_threads() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not _live_loader_threads(), \
+        "worker threads survived an abandoned iterator"
+
+
+def test_worker_pool_close_joins_all_workers():
+    it = worker_pool(iter(range(10_000)), lambda x: x, workers=3, depth=4)
+    assert next(it) == 0
+    it.close()
+    assert not _live_loader_threads()
+
+
+# -- staging ----------------------------------------------------------------
+def test_aligned_zeros_alignment_and_content():
+    for shape, dtype in [((3, 5), np.float32), ((7,), np.int32),
+                         ((), np.float64), ((0, 4), np.float32)]:
+        a = aligned_zeros(shape, dtype)
+        assert a.shape == shape and a.dtype == np.dtype(dtype)
+        assert not a.any()
+        if a.size:
+            assert a.ctypes.data % 64 == 0
+
+
+def test_staging_slot_specs_and_freelist_bounds():
+    sb = StagingBuffers({"pair": [((2, 3), np.float32), ((2,), np.int32)],
+                         "one": ((4,), np.float32)}, depth=2)
+    a = sb.acquire("pair")
+    b = sb.acquire("pair")
+    assert isinstance(a, list) and a[0].shape == (2, 3)
+    got = []
+    t = threading.Thread(target=lambda: got.append(sb.acquire("pair")),
+                         daemon=True)
+    t.start()
+    t.join(timeout=0.2)
+    assert t.is_alive()  # freelist exhausted: third acquire must block
+    sb.release(a)
+    t.join(timeout=5.0)
+    assert not t.is_alive() and got and got[0] is a
+    sb.release(b)
+    sb.release(got[0])
+    stats = sb.stats()
+    assert stats["outstanding"] == 0
+    assert stats["peak_outstanding"] == 2
+    assert stats["blocked_acquires"] == 1
+
+
+def test_release_placed_retires_aliased_buffers():
+    """A device_put that zero-copy aliases the staging buffer must retire
+    it — the freelist gets a DIFFERENT array, never the aliased one (the
+    device value still reads that memory)."""
+    sb = StagingBuffers({"x": ((64, 32), np.float32)}, depth=1)
+    buf = sb.acquire("x")
+    placed = jax.device_put(buf)
+    jax.block_until_ready(placed)
+    was_aliased = leaf_aliased(buf, placed)
+    sb.release_placed(buf, placed)
+    assert sb.outstanding == 0
+    replacement = sb.acquire("x")
+    if was_aliased:  # CPU zero-copy: buffer retired, fresh one handed out
+        assert replacement is not buf
+        assert sb.stats()["replaced_aliased"] >= 1
+        # the aliased memory still backs the device value, untouched
+        np.testing.assert_array_equal(np.asarray(placed), buf)
+    else:  # real-transfer backend: true freelist reuse
+        assert replacement is buf
+    sb.release(replacement)
+
+
+def test_release_placed_rejects_mismatched_tree():
+    sb = StagingBuffers({"x": ((4,), np.float32)}, depth=1)
+    buf = sb.acquire("x")
+    with pytest.raises(ValueError, match="leaves"):
+        sb.release_placed(buf, {"a": jax.numpy.zeros(4),
+                                "b": jax.numpy.zeros(4)})
+    sb.release(buf)
+
+
+def test_stack_leaf_matches_np_stack_for_arrays_and_scalars():
+    arrays = [np.full((3, 2), f, np.float32) for f in range(4)]
+    np.testing.assert_array_equal(stack_leaf(arrays), np.stack(arrays))
+    scalars = [np.int32(7), np.int32(9)]
+    np.testing.assert_array_equal(stack_leaf(scalars), np.stack(scalars))
+    out = np.empty((4, 3, 2), np.float32)
+    assert stack_leaf(arrays, out=out) is out
+    np.testing.assert_array_equal(out, np.stack(arrays))
+
+
+# -- staged epochs ----------------------------------------------------------
+def test_epoch_staged_matches_plain_epoch_content():
+    src = _array_source(n=37)  # ragged tail: padding path included
+    it = BatchIterator(src, batch_size=8, seed=3)
+    plain = list(it.epoch(2))
+    asm = BatchAssembler(src, 8, depth=4)
+    staged = it.epoch_staged(2, asm, workers=2, depth=4)
+    count = 0
+    for ref, sb in zip(plain, staged):
+        for k in ref:
+            np.testing.assert_array_equal(ref[k], sb.data[k])
+        sb.release()
+        count += 1
+    assert count == len(plain)
+
+
+@pytest.mark.parametrize("epoch", [0, 1])
+def test_epoch_staged_deterministic_across_worker_counts(tmp_path, epoch):
+    """workers=1 vs workers=4 must emit an int-exact identical batch
+    stream — augmentation noise included (per-batch rng seeded from
+    (noise_seed, epoch, batch), so completion order cannot matter)."""
+    streams = []
+    for workers in (1, 4):
+        src = _disk_source(tmp_path, snr=10.0)
+        it = BatchIterator(src, batch_size=4, seed=9)
+        asm = BatchAssembler(src, 4, depth=8)
+        streams.append(it.epoch_staged(epoch, asm, workers=workers,
+                                       depth=4))
+    n = 0
+    for a, b in zip(*streams):
+        for k in a.data:
+            np.testing.assert_array_equal(a.data[k], b.data[k])
+        a.release()
+        b.release()
+        n += 1
+    assert n == 5
+
+
+def test_epoch_staged_reuses_staging_and_respects_bounds():
+    src = _array_source(n=64)
+    it = BatchIterator(src, batch_size=8, seed=0)
+    asm = BatchAssembler(src, 8, depth=4)
+    for epoch in range(3):
+        for sb in it.epoch_staged(epoch, asm, workers=2, depth=3):
+            sb.release()
+    stats = asm.staging.stats()
+    assert stats["outstanding"] == 0  # no leaked leases
+    assert stats["peak_outstanding"] <= asm.staging.depth
+    # 24 batches total; all but the shape-learning first one are staged
+    assert stats["acquires"] == 23
+    assert stats["slots"] == 1
+
+
+def test_epoch_staged_break_releases_and_joins(tmp_path):
+    src = _array_source()
+    it = BatchIterator(src, batch_size=8, seed=0)
+    asm = BatchAssembler(src, 8, depth=4)
+    stream = it.epoch_staged(0, asm, workers=4, depth=4)
+    first = next(stream)
+    first.release()
+    stream.close()  # abandon mid-epoch
+    assert not _live_loader_threads()
+
+
+def test_gather_into_matches_gather(tmp_path):
+    """The allocation-free gather_into path must write exactly what
+    gather returns — native reader and scipy fallback alike (the batch
+    loader falls back per-call, so both paths serve the same source)."""
+    for src in (_array_source(n=12, hw=(5, 6)),
+                _disk_source(tmp_path, n=8, hw=(5, 6))):
+        idx = np.array([3, 1, 4, 1])
+        ref = src.gather(idx)
+        out = np.full((6, 5, 6, 1), -1.0, np.float32)
+        src.gather_into(idx, out)
+        np.testing.assert_array_equal(out[:4], ref)
+        assert (out[4:] == -1.0).all()  # rows past n untouched

@@ -494,11 +494,11 @@ def _plan(n, bucket, fill=1.0):
 
 
 def test_assemble_into_pads_and_survives_buffer_reuse():
-    sb = StagingBuffers((2, 4), HW, depth=1)
+    sb = StagingBuffers.for_buckets((2, 4), HW, depth=1)
     buf = sb.acquire(4)
     out = _plan(4, 4, fill=7.0).assemble_into(buf)
     assert out is buf and (out == 7.0).all()
-    sb.release(4, buf)
+    sb.release(buf)
     # Reuse: a partial batch into the same (dirty) buffer must zero the
     # padding rows — the pad_to_bucket convention, in place.
     buf = sb.acquire(4)
@@ -511,7 +511,7 @@ def test_assemble_into_pads_and_survives_buffer_reuse():
 
 
 def test_staging_acquire_blocks_until_release():
-    sb = StagingBuffers((2,), HW, depth=1)
+    sb = StagingBuffers.for_buckets((2,), HW, depth=1)
     buf = sb.acquire(2)
     got = []
     t = threading.Thread(target=lambda: got.append(sb.acquire(2)),
@@ -519,7 +519,7 @@ def test_staging_acquire_blocks_until_release():
     t.start()
     t.join(timeout=0.2)
     assert t.is_alive()  # exhausted: second acquire must wait
-    sb.release(2, buf)
+    sb.release(buf)
     t.join(timeout=5.0)
     assert not t.is_alive() and got and got[0] is buf
 
