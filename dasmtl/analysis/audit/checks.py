@@ -18,6 +18,10 @@ AUD103    error     dtype discipline: any f64 tensor, or an f32
                     convolution / dot_general in a bf16 target
 AUD104    error     no gradient all-reduce in a multi-device train step —
                     replicas silently diverge
+AUD108    error     int8 serving preset's quantize/dequantize inventory
+                    wrong: dequantize converts != quantized kernel count,
+                    missing/extra native int8 dot_generals, or no int8 in
+                    the program at all (quantization silently dropped)
 ========  ========  =====================================================
 
 AUD105 (budget regression), AUD106 (collective-inventory drift) and AUD107
@@ -79,6 +83,7 @@ def audit_target(name: str, lowered, *, n_devices: int = 1,
                  expect_grad_sync: bool = False,
                  allowed_collectives: Iterable[str] = ("all-reduce",),
                  analytic_by_dtype: Optional[Dict[str, float]] = None,
+                 expect_int8: Optional[Dict[str, int]] = None,
                  ) -> "tuple[TargetReport, List[AuditFinding]]":
     """Compile ``lowered`` (a ``jax.stages.Lowered``) and run every
     structural rule over the artifacts.  Returns (report, findings).
@@ -89,6 +94,10 @@ def audit_target(name: str, lowered, *, n_devices: int = 1,
     ``analytic_by_dtype`` (dtype -> MXU FLOPs, from
     :func:`~dasmtl.analysis.audit.analytic.analytic_flops_of`) upgrades the
     bf16 discipline check from op counts to FLOPs share.
+    ``expect_int8`` arms AUD108 for int8 serving targets:
+    ``{"dequantize": <conv kernels dequantized in-graph>,
+    "native_dots": <dense kernels served int8 x int8 -> int32>}`` — the
+    counts :class:`dasmtl.models.precision.PrecisionMeta` promises.
     """
     stablehlo = lowered.as_text()
     compiled = lowered.compile()
@@ -116,6 +125,8 @@ def audit_target(name: str, lowered, *, n_devices: int = 1,
     findings.extend(_check_dtypes(report, stablehlo, analytic_by_dtype))
     if expect_grad_sync:
         findings.extend(_check_grad_sync(report))
+    if expect_int8 is not None:
+        findings.extend(_check_int8(report, stablehlo, expect_int8))
     return report, findings
 
 
@@ -200,6 +211,50 @@ def _check_dtypes(report: TargetReport, stablehlo: str,
             f"a cast is missing on that path (census: {dict(census)})")
     else:
         report.metrics.setdefault("mxu_ops_f32", float(census.get("f32", 0)))
+
+
+def _check_int8(report: TargetReport, stablehlo: str,
+                expect: Dict[str, int]) -> Iterable[AuditFinding]:
+    """AUD108 — the int8 preset's op inventory, pinned exactly: every
+    quantized conv kernel must dequantize in-graph (one ``convert`` from
+    i8 each), every native dense kernel must reach an int8 x int8
+    ``dot_general`` (with its activation-quantize convert), and a program
+    with no int8 at all silently dropped the quantization — it would
+    serve bf16 while claiming int8 (and its artifact would be 4x larger
+    than the preset promises)."""
+    census = hlo.int8_census(stablehlo)
+    report.metrics.setdefault("int8_dequant_converts",
+                              float(census["convert_from_i8"]))
+    report.metrics.setdefault("int8_native_dots",
+                              float(census["i8_dot_general"]))
+    want_deq = int(expect.get("dequantize", 0))
+    want_dots = int(expect.get("native_dots", 0))
+    if want_deq + want_dots and not any(census.values()):
+        yield AuditFinding(
+            "AUD108", "error", report.name,
+            f"no int8 anywhere in the lowered program (census {census}) "
+            f"— the quantization transform was dropped; this target "
+            f"serves plain bf16 under an int8 label")
+        return
+    if census["convert_from_i8"] != want_deq:
+        yield AuditFinding(
+            "AUD108", "error", report.name,
+            f"{census['convert_from_i8']} dequantize convert(s) from i8, "
+            f"expected {want_deq} (one per quantized conv kernel, "
+            f"PrecisionMeta.n_kernels_quantized - n_dense_native): "
+            f"kernels fell out of (or into) the quantized set")
+    if census["i8_dot_general"] != want_dots:
+        yield AuditFinding(
+            "AUD108", "error", report.name,
+            f"{census['i8_dot_general']} native int8 dot_general(s), "
+            f"expected {want_dots}: a dense kernel left (or joined) the "
+            f"dequantize-free matmul path")
+    if want_dots and census["convert_to_i8"] < want_dots:
+        yield AuditFinding(
+            "AUD108", "error", report.name,
+            f"only {census['convert_to_i8']} activation-quantize "
+            f"convert(s) to i8 for {want_dots} native int8 matmul(s) — "
+            f"an int8 dot is consuming unquantized activations")
 
 
 def _check_grad_sync(report: TargetReport) -> Iterable[AuditFinding]:

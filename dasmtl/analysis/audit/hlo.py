@@ -113,6 +113,34 @@ def f32_mxu_ops(stablehlo: str, limit: int = 3) -> List[str]:
     return hits
 
 
+#: int8 tensors in StableHLO text render as ``tensor<...xi8>`` (or a
+#: scalar ``tensor<i8>``); the three ops the int8 serving preset is made
+#: of are converts from i8 (weight dequantize), converts to i8 (dynamic
+#: activation quantize) and dot_generals with i8 operands.
+_CONVERT_FROM_I8_RE = re.compile(
+    r"stablehlo\.convert[^\n]*:\s*\(tensor<(?:[0-9?x]*x)?i8>\)\s*->")
+_CONVERT_TO_I8_RE = re.compile(
+    r"stablehlo\.convert[^\n]*->\s*tensor<(?:[0-9?x]*x)?i8>")
+_I8_DOT_RE = re.compile(
+    r"stablehlo\.dot_general[^\n]*:\s*\([^)]*tensor<(?:[0-9?x]*x)?i8>")
+_I8_CONV_RE = re.compile(
+    r"stablehlo\.convolution[^\n]*:\s*\([^)]*tensor<(?:[0-9?x]*x)?i8>")
+
+
+def int8_census(stablehlo: str) -> Dict[str, int]:
+    """The int8-path op inventory of a lowered program (AUD108): how many
+    weight dequantizes (``convert`` from i8), activation quantizes
+    (``convert`` to i8), and native int8 MXU ops it contains.  Pure text
+    counting over the lowered StableHLO — the dtypes the model asked
+    for, before any backend legalization."""
+    return {
+        "convert_from_i8": len(_CONVERT_FROM_I8_RE.findall(stablehlo)),
+        "convert_to_i8": len(_CONVERT_TO_I8_RE.findall(stablehlo)),
+        "i8_dot_general": len(_I8_DOT_RE.findall(stablehlo)),
+        "i8_convolution": len(_I8_CONV_RE.findall(stablehlo)),
+    }
+
+
 def input_output_alias_pairs(optimized_hlo: str) -> int:
     """Donated-parameter aliases the executable honored, parsed from the
     ``input_output_alias={ {}: (0, {}, may-alias), ... }`` HloModule header.

@@ -71,18 +71,26 @@ def _pin_cpu_backend(min_devices: int) -> None:
 
 def run_audit(configs, *, kinds: Tuple[str, ...] = ("train", "eval"),
               ) -> Tuple[List[TargetReport], List[AuditFinding]]:
-    """Lower + compile + structurally check every target of ``configs``."""
-    from dasmtl.analysis.audit.targets import lower_config
+    """Lower + compile + structurally check every target of ``configs``
+    (train/eval matrix cells AND serve-forward precision targets — the
+    latter additionally run AUD108 when they carry int8 expectations)."""
+    from dasmtl.analysis.audit.targets import (ServeAuditConfig,
+                                               lower_config,
+                                               lower_serve_config)
 
     reports: List[TargetReport] = []
     findings: List[AuditFinding] = []
     for acfg in configs:
-        for tgt in lower_config(acfg, kinds=kinds):
+        targets = (lower_serve_config(acfg)
+                   if isinstance(acfg, ServeAuditConfig)
+                   else lower_config(acfg, kinds=kinds))
+        for tgt in targets:
             report, found = audit_target(
                 tgt.name, tgt.lowered, n_devices=tgt.n_devices,
                 compute_dtype=tgt.compute_dtype, donation=tgt.donation,
                 expect_grad_sync=(tgt.kind == "train"),
-                analytic_by_dtype=tgt.analytic_by_dtype)
+                analytic_by_dtype=tgt.analytic_by_dtype,
+                expect_int8=tgt.expect_int8)
             reports.append(report)
             findings.extend(found)
     return reports, findings
@@ -205,9 +213,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     args = ap.parse_args(argv)
 
     if args.list_configs:
-        from dasmtl.analysis.audit.targets import PRESETS, full_matrix
+        from dasmtl.analysis.audit.targets import (PRESETS, full_matrix,
+                                                   serve_matrix)
 
         for c in full_matrix():
+            print(c.name)
+        for c in serve_matrix():
             print(c.name)
         for name, cfgs in sorted(PRESETS.items()):
             print(f"preset {name}: {', '.join(c.name for c in cfgs)}")

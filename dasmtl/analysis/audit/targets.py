@@ -31,6 +31,9 @@ MATRIX_MODELS = ("MTL", "single_event", "multi_classifier")
 MATRIX_DTYPES = ("float32", "bfloat16")
 MATRIX_DP = (1, 2)
 
+#: Serving precision presets audited as serve-forward targets.
+SERVE_PRECISIONS = ("f32", "bf16", "int8")
+
 
 @dataclasses.dataclass(frozen=True)
 class AuditConfig:
@@ -58,20 +61,55 @@ def full_matrix(batch_size: int = 32) -> List[AuditConfig]:
             for dp in MATRIX_DP]
 
 
-def _named(names: Tuple[str, ...]) -> List[AuditConfig]:
+@dataclasses.dataclass(frozen=True)
+class ServeAuditConfig:
+    """One serve-forward target: the compiled program `dasmtl-serve`
+    warms for one (model, precision preset) at one bucket size.  Unlike
+    the train/eval matrix this lowers the PRECISION forward
+    (:mod:`dasmtl.models.precision`) with the transformed variables as
+    abstract arguments, so the int8 op inventory (AUD108) and the
+    bf16 dtype discipline (AUD103) are checked on the program that
+    actually serves — and its FLOP/byte budgets land in the committed
+    baseline next to the training ones."""
+
+    model: str = "MTL"
+    precision: str = "f32"
+    batch_size: int = 8  # the audited serve bucket
+
+    @property
+    def name(self) -> str:
+        return f"serve-{self.model}-{self.precision}-b{self.batch_size}"
+
+    @property
+    def n_devices(self) -> int:
+        return 1
+
+
+def serve_matrix() -> List[ServeAuditConfig]:
+    """Every serving preset of the default serving family (model A)."""
+    return [ServeAuditConfig(model="MTL", precision=p)
+            for p in SERVE_PRECISIONS]
+
+
+def _named(names: Tuple[str, ...]):
     by_name = {c.name: c for c in full_matrix()}
+    by_name.update({c.name: c for c in serve_matrix()})
     return [by_name[n] for n in names]
 
 
 #: quick: the one config exercising sharding + donation + budgets at once.
-#: ci: adds the 1-device contract, the bf16 discipline check and model B.
+#: ci: adds the 1-device contract, the bf16 discipline check, model B —
+#: and the three serve-forward precision targets (cheap: eval-sized
+#: programs, fast compiles, and they pin what production actually runs).
 #: full: every cell, including the ~30 s Inception compiles — baseline
 #: regeneration and pre-release sweeps.
-PRESETS: Dict[str, List[AuditConfig]] = {
+PRESETS: Dict[str, list] = {
     "quick": _named(("MTL-f32-dp2",)),
     "ci": _named(("MTL-f32-dp1", "MTL-f32-dp2", "MTL-bf16-dp2",
-                  "single_event-f32-dp1")),
-    "full": full_matrix(),
+                  "single_event-f32-dp1",
+                  "serve-MTL-f32-b8", "serve-MTL-bf16-b8",
+                  "serve-MTL-int8-b8")),
+    "full": full_matrix() + serve_matrix(),
 }
 
 
@@ -81,13 +119,15 @@ class LoweredTarget:
     layer checks it against."""
 
     name: str
-    kind: str  # "train" | "eval"
+    kind: str  # "train" | "eval" | "serve"
     lowered: object  # jax.stages.Lowered
     n_devices: int
     compute_dtype: str
     donation: str  # "requested" | "disabled" | "none"
     # dtype -> analytic MXU FLOPs (None when the jaxpr walk failed).
     analytic_by_dtype: Optional[Dict[str, float]] = None
+    # AUD108 expectations for int8 serve targets (see checks.audit_target).
+    expect_int8: Optional[Dict[str, int]] = None
 
 
 def donation_state() -> str:
@@ -150,13 +190,61 @@ def lower_config(acfg: AuditConfig, kinds: Tuple[str, ...] = ("train",
     return out
 
 
+def lower_serve_config(scfg: ServeAuditConfig) -> List[LoweredTarget]:
+    """Lower one serve-forward precision target.
+
+    The variables tree is derived abstractly (``jax.eval_shape`` through
+    the precision transform — quantization traced, nothing initialized)
+    and passed as an ARGUMENT, so this is the serving program with its
+    parameters as inputs instead of baked constants: identical ops, same
+    dtype census, and the int8 kernels/scales show up in
+    ``argument_bytes`` — which is how the baseline pins the 4x weight
+    shrink."""
+    import jax
+
+    from dasmtl.models.precision import (abstract_precision_pack,
+                                         precision_forward,
+                                         staging_dtype_for)
+    from dasmtl.models.registry import get_model_spec
+
+    spec = get_model_spec(scfg.model)
+    pack_sds, meta = abstract_precision_pack(spec, scfg.precision)
+    fwd = precision_forward(spec, scfg.precision)
+    x_sds = jax.ShapeDtypeStruct(
+        (scfg.batch_size, INPUT_HEIGHT, INPUT_WIDTH, 1),
+        staging_dtype_for(scfg.precision))
+    analytic = None
+    try:
+        from dasmtl.analysis.audit.analytic import analytic_flops_of
+
+        analytic = analytic_flops_of(fwd, pack_sds, x_sds)
+    except Exception:  # noqa: BLE001 — analytic count is best-effort
+        pass
+    expect_int8 = None
+    if scfg.precision == "int8":
+        expect_int8 = {
+            "dequantize": meta.n_kernels_quantized - meta.n_dense_native,
+            "native_dots": meta.n_dense_native,
+        }
+    return [LoweredTarget(
+        name=scfg.name, kind="serve",
+        lowered=jax.jit(fwd).lower(pack_sds, x_sds),
+        n_devices=1,
+        compute_dtype=("float32" if scfg.precision == "f32"
+                       else "bfloat16"),
+        donation="none", analytic_by_dtype=analytic,
+        expect_int8=expect_int8)]
+
+
 def resolve_configs(preset: Optional[str] = None,
-                    names: Optional[str] = None) -> List[AuditConfig]:
+                    names: Optional[str] = None) -> list:
     """CLI selection: ``names`` (comma-separated target-cell names from
-    :func:`full_matrix`) beats ``preset``; default preset is ``ci``."""
+    :func:`full_matrix` / :func:`serve_matrix`) beats ``preset``; default
+    preset is ``ci``."""
     if names:
         wanted = [n.strip() for n in names.split(",") if n.strip()]
         by_name = {c.name: c for c in full_matrix()}
+        by_name.update({c.name: c for c in serve_matrix()})
         unknown = sorted(set(wanted) - set(by_name))
         if unknown:
             raise ValueError(

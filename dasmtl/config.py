@@ -174,6 +174,15 @@ class Config:
     serve_inflight: int = 2
     serve_devices: int = -1
     serve_shard_largest: bool = False
+    # Serving precision preset (docs/SERVING.md "Precision presets"):
+    # f32 = the reference forward; bf16 = params cast once at load,
+    # bf16 activations, f32 decode tail; int8 = post-training per-channel
+    # int8 weight quantization (f32 scales from the checkpoint),
+    # dequantize-free int8 matmuls for dense kernels, bf16 activations.
+    # Reduced presets must pass the parity gate
+    # (`dasmtl-serve --parity-check`, docs/PARITY.md) and, for exported
+    # artifacts, match the artifact header's recorded precision.
+    serve_precision: str = "f32"  # f32 | bf16 | int8
 
     # ---- misc ----
     seed: int = 1
@@ -238,6 +247,10 @@ class Config:
             raise ValueError(f"serve_devices must be a positive device "
                              f"count or -1 (all visible), got "
                              f"{self.serve_devices}")
+        if self.serve_precision not in ("f32", "bf16", "int8"):
+            raise ValueError(
+                f"unknown serve_precision {self.serve_precision!r}; "
+                f"expected f32 | bf16 | int8")
 
     @property
     def decay_at_epoch0(self) -> bool:
@@ -516,6 +529,14 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
                    default=d.serve_shard_largest,
                    help="run largest-bucket serve batches mesh-sharded "
                         "over the whole pool instead of on one device")
+    p.add_argument("--serve_precision", type=str,
+                   default=d.serve_precision,
+                   choices=["f32", "bf16", "int8"],
+                   help="serving precision preset: bf16 casts params at "
+                        "load and runs bf16 activations, int8 quantizes "
+                        "conv/dense kernels per-channel (f32 decode tail "
+                        "either way); gated by dasmtl-serve "
+                        "--parity-check (docs/SERVING.md)")
 
 
 def _resolve_compat(ns: argparse.Namespace) -> dict:

@@ -146,12 +146,16 @@ class StagingBuffers:
 
     @classmethod
     def for_buckets(cls, buckets: Sequence[int], input_hw,
-                    depth: int) -> "StagingBuffers":
-        """The serve layout: one ``(bucket, h, w, 1) float32`` array per
+                    depth: int, dtype=np.float32) -> "StagingBuffers":
+        """The serve layout: one ``(bucket, h, w, 1)`` array per
         configured bucket size (the PR 5 constructor, now a classmethod of
-        the shared home)."""
+        the shared home).  ``dtype`` is the executor's staging dtype —
+        reduced-precision serving presets stage ``bfloat16`` so the H2D
+        transfer halves and the batch dtype matches the executable's
+        input spec (dasmtl/serve/, docs/SERVING.md 'Precision
+        presets')."""
         h, w = int(input_hw[0]), int(input_hw[1])
-        return cls({int(b): ((int(b), h, w, 1), np.float32)
+        return cls({int(b): ((int(b), h, w, 1), np.dtype(dtype))
                     for b in buckets}, depth=depth)
 
     # -- slots ---------------------------------------------------------------

@@ -16,21 +16,40 @@ The artifact is lowered for ``cpu``, ``tpu`` and the ``axon`` tunnel-plugin
 platforms, so a model
 exported on a CPU dev box serves unchanged on a TPU host (and vice versa).
 
+Artifacts are **versioned**: the serialized file is a small container —
+magic, a JSON header (``artifact_version``, ``precision``, ``model``,
+``input_hw``), then the StableHLO payload.  The header is what lets the
+serving stack refuse a precision mismatch at STARTUP (an int8 artifact
+served under a config that promised f32 is an operational error, not a
+shape traceback), and ``deserialize_exported`` still reads headerless
+legacy blobs (treated as ``artifact_version`` 0, precision ``f32``).
+
 CLI::
 
     python -m dasmtl.export --model MTL --model_path <ckpt dir> \
-        --out runs/mtl_infer.stablehlo [--device cpu]
+        --out runs/mtl_infer.stablehlo [--device cpu] [--precision int8]
 
-The exported function takes one ``(b, 100, 250, 1)`` float32 array (``b``
-symbolic — any batch size at call time) and returns a dict with the decoded
-per-task integer predictions plus each head's log-probabilities.
+The exported function takes one ``(b, 100, 250, 1)`` array (``b`` symbolic
+— any batch size at call time; float32 for the f32 preset, bfloat16 for
+the reduced ones — the serve batcher stages the matching dtype) and
+returns a dict with the decoded per-task integer predictions plus each
+head's log-probabilities (f32 for every preset).
 """
 
 from __future__ import annotations
 
+import json
 import os
+import struct
 import sys
-from typing import Callable
+from typing import Callable, Tuple
+
+#: Container magic of versioned artifacts; a file not starting with this
+#: is a legacy bare ``jax.export`` blob.
+ARTIFACT_MAGIC = b"DASMTL\x00\x01"
+
+#: Current container schema.  0 is reserved for legacy headerless blobs.
+ARTIFACT_VERSION = 1
 
 # -- exported-artifact construction ------------------------------------------
 
@@ -107,13 +126,21 @@ def make_serve_infer_fn(spec, state) -> Callable:
 
 def export_infer(spec, state, *, input_hw=(100, 250),
                  platforms=("cpu", "tpu", "axon"),
-                 disable_platform_check=False):
-    """Serialize the inference function to StableHLO bytes.
+                 disable_platform_check=False, precision: str = "f32"):
+    """Serialize the inference function to versioned artifact bytes.
 
     The batch dimension is exported symbolically (``jax.export.symbolic_shape``)
     so one artifact serves any batch size — the reference's fixed-batch
     DataLoader has no analogue of this.  Parameters ride inside the artifact
     as constants: the file is the whole model.
+
+    ``precision`` selects the serving preset baked into the program
+    (:mod:`dasmtl.models.precision`): ``bf16`` casts the parameters once
+    and traces a bf16-activation forward; ``int8`` stores per-channel
+    int8 kernels + f32 scales as the constants (4x smaller artifact) with
+    the decode tail in f32 either way.  The chosen preset is recorded in
+    the container header and validated against the serving config at
+    startup.
 
     Default platforms cover cpu, tpu AND this container's ``axon``
     TPU-tunnel plugin (a PJRT plugin presents the chip under its own
@@ -124,18 +151,102 @@ def export_infer(spec, state, *, input_hw=(100, 250),
     safety net on normal hosts.
     """
     import jax
-    import jax.numpy as jnp
     from jax import export as jax_export
+
+    from dasmtl.models.precision import (make_precision_serve_fn,
+                                         staging_dtype_for)
 
     h, w = input_hw
     (b,) = jax_export.symbolic_shape("b")
-    x_spec = jax.ShapeDtypeStruct((b, h, w, 1), jnp.float32)
-    infer = make_infer_fn(spec, state)
+    x_spec = jax.ShapeDtypeStruct((b, h, w, 1), staging_dtype_for(precision))
+    if precision == "f32":
+        infer = make_infer_fn(spec, state)
+    else:
+        # The precision forward already carries the fused bad_rows mask;
+        # the f32 artifact keeps the historical make_infer_fn program (the
+        # executor jits the decode tail separately for it).
+        infer, _ = make_precision_serve_fn(spec, state, precision)
     checks = ([jax_export.DisabledSafetyCheck.platform()]
               if disable_platform_check else [])
     exported = jax_export.export(jax.jit(infer), platforms=list(platforms),
                                  disabled_checks=checks)(x_spec)
-    return exported.serialize()
+    header = {"artifact_version": ARTIFACT_VERSION,
+              "precision": precision,
+              "model": getattr(spec, "name", "?"),
+              "input_hw": [int(h), int(w)]}
+    return pack_artifact(exported.serialize(), header)
+
+
+# -- versioned container ------------------------------------------------------
+
+
+def pack_artifact(payload: bytes, header: dict) -> bytes:
+    """``magic + u32 header length + JSON header + StableHLO payload``."""
+    head = json.dumps(header, sort_keys=True).encode("utf-8")
+    return ARTIFACT_MAGIC + struct.pack("<I", len(head)) + head + payload
+
+
+def read_artifact(path: str) -> Tuple[dict, bytes]:
+    """``(header, payload)`` of an artifact file.  Legacy bare blobs (no
+    container magic) return the payload unchanged under a synthesized
+    ``{"artifact_version": 0, "precision": "f32"}`` header — every
+    pre-versioning artifact was an f32 export."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    if not blob.startswith(ARTIFACT_MAGIC):
+        return {"artifact_version": 0, "precision": "f32"}, blob
+    off = len(ARTIFACT_MAGIC)
+    (n,) = struct.unpack_from("<I", blob, off)
+    off += 4
+    try:
+        header = json.loads(blob[off:off + n].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+        raise ValueError(f"corrupt artifact header in {path}: {exc}") \
+            from None
+    _validate_header(header, path)
+    return header, blob[off + n:]
+
+
+def _validate_header(header: dict, path: str) -> None:
+    from dasmtl.models.precision import PRECISIONS
+
+    version = header.get("artifact_version")
+    if not isinstance(version, int) or version < 0:
+        raise ValueError(f"artifact {path} has a bad artifact_version "
+                         f"{version!r}")
+    if version > ARTIFACT_VERSION:
+        raise ValueError(
+            f"artifact {path} is version {version}, this dasmtl reads up "
+            f"to {ARTIFACT_VERSION} — upgrade dasmtl or re-export")
+    precision = header.get("precision", "f32")
+    if precision not in PRECISIONS:
+        raise ValueError(f"artifact {path} declares unknown precision "
+                         f"{precision!r}; known: {PRECISIONS}")
+
+
+def artifact_header(path: str) -> dict:
+    """Header only — what ``doctor --exported`` prints without having to
+    deserialize the StableHLO payload."""
+    return read_artifact(path)[0]
+
+
+def load_artifact(path: str):
+    """``(header, jax.export.Exported)`` — the full read path: container
+    parsed and validated, payload deserialized, and the header's recorded
+    ``input_hw`` cross-checked against the program's actual input spec (a
+    mismatch means a corrupt or hand-edited file)."""
+    from jax import export as jax_export
+
+    header, payload = read_artifact(path)
+    exported = jax_export.deserialize(bytearray(payload))
+    hw = header.get("input_hw")
+    if hw is not None and tuple(hw) != exported_input_hw(exported):
+        raise ValueError(
+            f"artifact {path} header says {hw[0]}x{hw[1]} windows but the "
+            f"program takes "
+            f"{'x'.join(str(v) for v in exported_input_hw(exported))} — "
+            f"the file is corrupt; re-export")
+    return header, exported
 
 
 def deserialize_exported(path: str):
@@ -143,11 +254,10 @@ def deserialize_exported(path: str):
     that need the input spec (``in_avals``) as well as ``.call``: the
     streaming sweep derives its window grid from it, and the serving
     executor (:mod:`dasmtl.serve`) validates it against the configured
-    window shape before accepting traffic."""
-    from jax import export as jax_export
-
-    with open(path, "rb") as f:
-        return jax_export.deserialize(bytearray(f.read()))
+    window shape before accepting traffic.  Reads both versioned
+    containers and legacy bare blobs; use :func:`load_artifact` when the
+    header (precision, version) matters too."""
+    return load_artifact(path)[1]
 
 
 def exported_input_hw(exported) -> tuple:
@@ -188,6 +298,13 @@ def main(argv=None) -> int:
                          "lowered for cpu/tpu/axon regardless)")
     ap.add_argument("--compute_dtype", type=str, default="float32",
                     help="activation dtype baked into the artifact")
+    ap.add_argument("--precision", type=str, default="f32",
+                    choices=("f32", "bf16", "int8"),
+                    help="serving precision preset baked into the program "
+                         "and recorded in the artifact header (bf16: cast "
+                         "params + bf16 activations; int8: per-channel "
+                         "int8 weights + f32 scales; decode tail f32 "
+                         "always — docs/SERVING.md 'Precision presets')")
     args = ap.parse_args(argv)
 
     from dasmtl.utils.platform import apply_device
@@ -205,11 +322,12 @@ def main(argv=None) -> int:
     state = restore_weights(state, args.model_path)
     print(f"restored weights from {args.model_path}", file=sys.stderr)
 
-    blob = export_infer(spec, state)
+    blob = export_infer(spec, state, precision=args.precision)
     os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
     with open(args.out, "wb") as f:
         f.write(blob)
     print(f"exported {args.model} inference ({len(blob)/1e6:.2f} MB, "
+          f"precision {args.precision}, artifact v{ARTIFACT_VERSION}, "
           f"symbolic batch, platforms cpu+tpu+axon) -> {args.out}")
     return 0
 

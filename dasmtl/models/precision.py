@@ -1,0 +1,398 @@
+"""Precision-aware serving forwards: bf16-everywhere and post-training int8.
+
+The serving decision of this repo is an argmax over each task head plus a
+finite mask (``dasmtl.export.make_serve_infer_fn``), which makes reduced
+precision *gateable*: the decoded ints must agree with the f32 reference
+at the committed threshold (``dasmtl/serve/parity.py``), the log-prob
+heads must stay within tolerance, and the lowered program must contain the
+ops the preset promises (AUD103/AUD108 in ``dasmtl/analysis/audit/``).
+This module owns the model-layer half of that contract — the precision
+presets themselves:
+
+``f32``
+    The reference serving forward, untouched.
+``bf16``
+    Parameters cast ONCE at load (conv/dense kernels and their biases;
+    BatchNorm affine + running stats stay f32 — the modules normalize in
+    f32 by construction), activations bf16 through the whole conv stack,
+    logits cast to f32 for the decode tail (log-softmax, argmax, finite
+    mask).  On an MXU this is the 2x-rate path; XLA:CPU legalizes bf16
+    math back to f32, so on CPU hosts the preset is parity-neutral and
+    throughput-neutral (measured — see BENCH_serve.json).
+``int8``
+    Post-training symmetric per-channel weight quantization: every
+    conv/dense kernel is stored as int8 with one f32 scale per output
+    channel, computed at export/load time from the checkpoint (no
+    calibration data needed for weight-only quantization).  At apply time
+    conv kernels are dequantized into the bf16 activation path (the
+    portable fallback — one ``convert``+``multiply`` per kernel, which
+    XLA constant-folds into bf16 weights when the parameters are baked
+    into the executable), while 2-D dense kernels run **dequantize-free**
+    through :func:`int8_dot`: activations dynamically quantized per row,
+    an int8 x int8 -> int32 ``dot_general`` (XLA lowers this natively on
+    cpu/tpu), and one f32 rescale.  Weight bytes shrink 4x in the
+    artifact either way.
+
+The two-layer API exists for the auditor: :func:`precision_variables`
+transforms a variables tree (and is ``jax.eval_shape``-able, so audit
+targets lower the quantized program abstractly — no params initialized),
+and :func:`precision_forward` builds ``fn(pack, x)`` with the pack as an
+*argument*.  :func:`make_precision_serve_fn` closes the computed pack over
+the forward for the executor/export path, where parameters ride as
+constants.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+#: The serving precision presets, in config order.
+PRECISIONS = ("f32", "bf16", "int8")
+
+#: Symmetric int8 range: +-127 (never -128, so negation stays exact).
+_QMAX = 127.0
+
+
+def check_precision(precision: str) -> str:
+    if precision not in PRECISIONS:
+        raise ValueError(f"unknown serve precision {precision!r}; "
+                         f"expected one of {PRECISIONS}")
+    return precision
+
+
+def compute_dtype_for(precision: str):
+    """Activation dtype of a preset's forward (jnp dtype)."""
+    import jax.numpy as jnp
+
+    return jnp.float32 if check_precision(precision) == "f32" \
+        else jnp.bfloat16
+
+
+def staging_dtype_for(precision: str):
+    """Host-side dtype of staged request batches (numpy dtype): reduced
+    presets stage bf16 so the H2D transfer halves and the executable's
+    input spec matches the compute dtype — the warmup/steady-state shape
+    contract (zero post-warmup recompiles) includes the input DTYPE."""
+    import numpy as np
+
+    if check_precision(precision) == "f32":
+        return np.dtype(np.float32)
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+# -- per-channel weight quantization ------------------------------------------
+
+
+def quantize_kernel(kernel) -> Tuple[Any, Any]:
+    """Symmetric per-output-channel int8 quantization of one kernel.
+
+    The last axis is the output-channel axis for both flax conv (HWIO) and
+    dense (IO) kernels.  Returns ``(q int8, scale f32[out])`` with
+    ``kernel ~= q * scale``; an all-zero channel gets scale 1 (its q is 0
+    — round-trips exactly, never divides by zero).
+    """
+    import jax.numpy as jnp
+
+    if kernel.ndim < 2:
+        raise ValueError(f"quantize_kernel expects a >=2-D kernel, "
+                         f"got shape {kernel.shape}")
+    axes = tuple(range(kernel.ndim - 1))
+    k32 = kernel.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(k32), axis=axes)
+    scale = jnp.where(amax > 0, amax / _QMAX, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(k32 / scale), -_QMAX, _QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kernel(q, scale, dtype):
+    """``q * scale`` in ``dtype`` — the weight-only portable path (scale
+    broadcasts over the per-output-channel last axis)."""
+    return q.astype(dtype) * scale.astype(dtype)
+
+
+def int8_dot(x, q, scale, bias=None):
+    """Dequantize-free quantized matmul: dynamic per-row activation
+    quantization, int8 x int8 -> int32 ``dot_general``, one f32 rescale.
+
+    ``x`` is ``[..., K]`` float, ``q`` an int8 ``[K, N]`` kernel from
+    :func:`quantize_kernel`, ``scale`` its f32 ``[N]`` scales.  Output is
+    f32 — dense heads are the decode tail's numerics island.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    x32 = x.astype(jnp.float32)
+    xmax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+    xscale = jnp.where(xmax > 0, xmax / _QMAX, 1.0)
+    xq = jnp.clip(jnp.round(x32 / xscale), -_QMAX, _QMAX).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, q, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    y = acc.astype(jnp.float32) * xscale * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y
+
+
+# -- variables transform ------------------------------------------------------
+
+
+def _path_key(path: Tuple[str, ...]) -> str:
+    return "/".join(path)
+
+
+def _is_kernel(name: str, leaf) -> bool:
+    return name == "kernel" and getattr(leaf, "ndim", 0) >= 2
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionMeta:
+    """Static facts about one transformed variables tree — everything the
+    audit expectations and the doctor/selftest reporting need, computed
+    from tree *structure* only (works on ShapeDtypeStructs)."""
+
+    precision: str
+    n_kernels_quantized: int = 0  # int8 kernels in the pack
+    n_dense_native: int = 0  # 2-D kernels served via int8_dot
+    n_leaves_bf16: int = 0  # leaves cast to bf16 at load
+    param_bytes: int = 0  # pack["params"] + scales, as stored
+
+    def summary(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _walk_params(params, precision: str, dense_native: bool,
+                 path: Tuple[str, ...] = ()):
+    """Recurse the nested params dict; returns (transformed, scales)."""
+    import jax.numpy as jnp
+
+    if isinstance(params, dict):
+        out: Dict[str, Any] = {}
+        scales: Dict[str, Any] = {}
+        for name, child in params.items():
+            t, s = _walk_params(child, precision, dense_native,
+                                path + (name,))
+            out[name] = t
+            scales.update(s)
+        return out, scales
+    leaf = params
+    name = path[-1] if path else ""
+    if precision == "bf16":
+        if _is_kernel(name, leaf) or name == "bias":
+            return leaf.astype(jnp.bfloat16), {}
+        return leaf, {}
+    # int8: kernels quantized; conv biases follow the bf16 activation path.
+    if _is_kernel(name, leaf):
+        q, scale = quantize_kernel(leaf)
+        return q, {_path_key(path): scale}
+    if name == "bias":
+        return leaf.astype(jnp.bfloat16), {}
+    return leaf, {}
+
+
+def precision_variables(variables: dict, precision: str,
+                        dense_native: bool = True) -> dict:
+    """Transform ``{"params": ..., "batch_stats": ...}`` into a precision
+    *pack* ``{"params", "batch_stats", "scales"}`` — a pure-array pytree
+    (jit-arg and ``jax.eval_shape`` friendly; the static facts live in
+    :func:`precision_meta`).  ``f32`` passes the variables through with an
+    empty scales map so every preset shares one forward signature."""
+    check_precision(precision)
+    params = variables.get("params", {})
+    batch_stats = variables.get("batch_stats", {})
+    if precision == "f32":
+        return {"params": params, "batch_stats": batch_stats, "scales": {}}
+    new_params, scales = _walk_params(params, precision, dense_native)
+    return {"params": new_params, "batch_stats": batch_stats,
+            "scales": scales}
+
+
+def precision_meta(variables: dict, precision: str,
+                   dense_native: bool = True) -> PrecisionMeta:
+    """The static counterpart of :func:`precision_variables`: counts and
+    stored bytes, from shapes/dtypes alone (accepts ShapeDtypeStructs)."""
+    import numpy as np
+
+    check_precision(precision)
+    n_q = n_dense = n_bf16 = 0
+    nbytes = 0
+
+    def walk(node, path=()):
+        nonlocal n_q, n_dense, n_bf16, nbytes
+        if isinstance(node, dict):
+            for name, child in node.items():
+                walk(child, path + (name,))
+            return
+        name = path[-1] if path else ""
+        size = int(np.prod(node.shape)) if node.shape else 1
+        if precision == "f32":
+            nbytes += size * np.dtype(node.dtype).itemsize
+            return
+        if _is_kernel(name, node):
+            if precision == "int8":
+                n_q += 1
+                if node.ndim == 2 and dense_native:
+                    n_dense += 1
+                nbytes += size * 1 + int(node.shape[-1]) * 4  # q + scales
+            else:
+                n_bf16 += 1
+                nbytes += size * 2
+        elif name == "bias":
+            n_bf16 += 1
+            nbytes += size * 2
+        else:
+            nbytes += size * np.dtype(node.dtype).itemsize
+
+    walk(variables.get("params", {}))
+    return PrecisionMeta(precision=precision, n_kernels_quantized=n_q,
+                         n_dense_native=n_dense, n_leaves_bf16=n_bf16,
+                         param_bytes=nbytes)
+
+
+def _dequantized_params(params, scales: Dict[str, Any], dtype,
+                        dense_native: bool, path: Tuple[str, ...] = ()):
+    """Rebuild the params tree for apply: int8 conv kernels dequantized
+    into ``dtype``; 2-D int8 kernels left in place when ``dense_native``
+    (the Dense interceptor consumes them with their scale directly)."""
+    import jax.numpy as jnp
+
+    if isinstance(params, dict):
+        return {name: _dequantized_params(child, scales, dtype,
+                                          dense_native, path + (name,))
+                for name, child in params.items()}
+    leaf = params
+    key = _path_key(path)
+    if key in scales and leaf.dtype == jnp.int8:
+        if leaf.ndim == 2 and dense_native:
+            return leaf  # int8_dot path
+        return dequantize_kernel(leaf, scales[key], dtype)
+    return leaf
+
+
+def _dense_int8_interceptor(scales: Dict[str, Any]):
+    """flax interceptor routing every ``nn.Dense`` whose kernel is int8
+    through :func:`int8_dot` — the dequantize-free matmul path."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    def interceptor(next_fun, args, kwargs, context):
+        mod = context.module
+        if type(mod) is not nn.Dense or context.method_name != "__call__":
+            return next_fun(*args, **kwargs)
+        params = mod.variables.get("params", {})
+        kernel = params.get("kernel")
+        if kernel is None or kernel.dtype != jnp.int8:
+            return next_fun(*args, **kwargs)
+        key = _path_key(tuple(mod.path) + ("kernel",))
+        scale = scales.get(key)
+        if scale is None:  # pragma: no cover — pack/scales out of sync
+            raise ValueError(f"int8 Dense kernel at {key!r} has no scale "
+                             f"in the precision pack")
+        return int8_dot(args[0], kernel, scale, params.get("bias"))
+
+    return interceptor
+
+
+# -- the precision forward ----------------------------------------------------
+
+
+def precision_forward(spec, precision: str, *,
+                      dense_native: bool = True) -> Callable:
+    """``fn(pack, x) -> outputs dict`` — the precision-aware serve forward
+    with the transformed variables as an ARGUMENT (the auditor lowers this
+    against abstract packs; :func:`make_precision_serve_fn` closes a real
+    pack over it).  Output contract matches
+    :func:`dasmtl.export.make_serve_infer_fn`: decoded per-task ints,
+    f32 ``log_probs_<i>`` per head, and the fused ``bad_rows`` mask —
+    the decode tail runs in f32 for every preset."""
+    import contextlib
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from dasmtl.config import Config
+    from dasmtl.export import nonfinite_rows
+
+    check_precision(precision)
+    cfg = Config(model=spec.name,
+                 compute_dtype="float32" if precision == "f32"
+                 else "bfloat16")
+    module = spec.build(cfg)
+    dtype = compute_dtype_for(precision)
+
+    def forward(pack, x):
+        params = _dequantized_params(pack["params"], pack["scales"], dtype,
+                                     dense_native)
+        variables = {"params": params, "batch_stats": pack["batch_stats"]}
+        ctx = (nn.intercept_methods(_dense_int8_interceptor(pack["scales"]))
+               if precision == "int8" and dense_native
+               else contextlib.nullcontext())
+        with ctx:
+            outputs = module.apply(variables, x.astype(dtype), train=False)
+        # f32 decode tail: argmax + log-softmax + finite mask never run in
+        # reduced precision, whatever the backbone did.
+        outputs = tuple(h.astype(jnp.float32) for h in outputs)
+        out = dict(spec.decode(outputs))
+        for i, head in enumerate(outputs):
+            out[f"log_probs_{i}"] = jax.nn.log_softmax(head, axis=-1)
+        out["bad_rows"] = nonfinite_rows(out)
+        return out
+
+    return forward
+
+
+def make_precision_serve_fn(spec, state, precision: str, *,
+                            dense_native: bool = True
+                            ) -> Tuple[Callable, PrecisionMeta]:
+    """The executor/export entry point: transform the trained variables
+    once at load, close the pack over :func:`precision_forward`, and
+    return ``(fn(x) -> outputs, meta)``.  ``f32`` intentionally falls back
+    to the untouched reference forward
+    (:func:`dasmtl.export.make_serve_infer_fn`) so the baseline program is
+    byte-for-byte the PR 5 one."""
+    from dasmtl.export import make_serve_infer_fn
+
+    check_precision(precision)
+    if precision == "f32":
+        return (make_serve_infer_fn(spec, state),
+                precision_meta({"params": state.params}, "f32"))
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+    pack = precision_variables(variables, precision,
+                               dense_native=dense_native)
+    meta = precision_meta(variables, precision, dense_native=dense_native)
+    fwd = precision_forward(spec, precision, dense_native=dense_native)
+
+    def serve_infer(x):
+        return fwd(pack, x)
+
+    return serve_infer, meta
+
+
+def abstract_precision_pack(spec, precision: str, *,
+                            input_hw: Optional[Tuple[int, int]] = None,
+                            dense_native: bool = True):
+    """(pack ShapeDtypeStructs, meta) for one model family — the audit
+    path: the variables tree is derived with ``jax.eval_shape`` (no
+    parameters initialized) and the quantization transform is traced
+    abstractly, so lowering a serve target costs no memory or compute."""
+    import jax
+
+    from dasmtl.config import INPUT_HEIGHT, INPUT_WIDTH, Config
+    from dasmtl.main import build_state
+
+    hw = tuple(input_hw or (INPUT_HEIGHT, INPUT_WIDTH))
+    cfg = Config(model=spec.name)
+    state_sds = jax.eval_shape(lambda: build_state(cfg, spec, input_hw=hw))
+    variables_sds = {"params": state_sds.params,
+                     "batch_stats": state_sds.batch_stats}
+    pack_sds = jax.eval_shape(
+        lambda v: precision_variables(v, precision,
+                                      dense_native=dense_native),
+        variables_sds)
+    meta = precision_meta(variables_sds, precision,
+                          dense_native=dense_native)
+    return pack_sds, meta

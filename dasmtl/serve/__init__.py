@@ -17,7 +17,13 @@ deployment layer (docs/SERVING.md):
   under a bounded in-flight window, graceful drain, stdlib HTTP front
   end;
 - :mod:`~dasmtl.serve.metrics` — latency percentiles, batch occupancy,
-  per-stage pipeline timings, shed/reject counters.
+  per-stage pipeline timings, shed/reject counters;
+- :mod:`~dasmtl.serve.parity` — the precision parity gate: a reduced
+  serving preset (``serve_precision`` bf16/int8,
+  :mod:`dasmtl.models.precision`) vs the f32 reference over a seeded
+  eval set — decoded ints at the committed threshold, log-probs within
+  tolerance, NaN rejection identical (``dasmtl-serve --parity-check``;
+  committed report in docs/PARITY.md).
 
 Entry points: ``dasmtl-serve`` / ``dasmtl serve`` /
 ``python -m dasmtl.serve``.  In-process use::

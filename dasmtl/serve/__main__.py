@@ -7,7 +7,10 @@ framework rebuild, weights ride inside the file) or a checkpoint
 (``--model_path``); fire requests at ``POST /infer``; SIGTERM drains
 gracefully (in-flight batches finish, new work gets an explicit
 ``closed``).  ``--selftest`` runs the in-process smoke instead — the CI
-serve job's entry point (docs/SERVING.md).
+serve job's entry point — and ``--parity-check`` runs the precision
+parity gate (reduced preset vs f32 reference, ints >= the committed
+threshold, log-probs within tolerance, NaN rejection identical) and can
+write the committed report into docs/PARITY.md (docs/SERVING.md).
 """
 
 from __future__ import annotations
@@ -65,8 +68,27 @@ def main(argv=None) -> int:
                    help="run largest-bucket batches mesh-sharded over the "
                         "whole pool (dp NamedSharding) instead of on one "
                         "device")
+    p.add_argument("--precision", type=str, default=d.serve_precision,
+                   choices=["f32", "bf16", "int8"],
+                   help="serving precision preset (docs/SERVING.md "
+                        "'Precision presets'): bf16 = params cast at "
+                        "load + bf16 activations, int8 = per-channel "
+                        "int8 weights; decode tail stays f32; with "
+                        "--exported the artifact's header must agree")
     p.add_argument("--device", type=str, default="auto",
                    choices=["tpu", "cpu", "auto"])
+    p.add_argument("--parity-check", action="store_true",
+                   dest="parity_check",
+                   help="run the precision parity gate instead of "
+                        "serving: the --precision preset (or both "
+                        "reduced presets when --precision f32) vs the "
+                        "f32 reference over a seeded eval set; exit "
+                        "0/1 (dasmtl/serve/parity.py)")
+    p.add_argument("--parity_windows", type=int, default=256,
+                   help="eval-set size for --parity-check")
+    p.add_argument("--parity_out", type=str, default=None, metavar="PATH",
+                   help="also write/refresh the committed parity report "
+                        "section in PATH (docs/PARITY.md)")
     p.add_argument("--selftest", action="store_true",
                    help="run the in-process serving smoke (concurrent "
                         "clients, NaN poisoning, SIGTERM drain) and exit "
@@ -89,10 +111,45 @@ def main(argv=None) -> int:
         report = run_selftest(requests=args.selftest_requests,
                               clients=args.selftest_clients,
                               devices=args.selftest_devices,
-                              inflight=args.inflight)
+                              inflight=args.inflight,
+                              precision=args.precision)
         # CI publishes warmup seconds + per-device compile counts.
         write_job_summary(report)
         return 0 if report["passed"] else 1
+
+    if args.parity_check:
+        from dasmtl.serve.parity import run_parity, write_parity_report
+
+        window = (52, 64)
+        if args.window:
+            try:
+                h, w = args.window.lower().split("x")
+                window = (int(h), int(w))
+            except ValueError:
+                p.error(f"--window must look like 100x250, "
+                        f"got {args.window!r}")
+        # --precision f32 means "gate everything": both reduced presets.
+        presets = ([args.precision] if args.precision != "f32"
+                   else ["bf16", "int8"])
+        reports = [run_parity(prec, model=args.model,
+                              model_path=args.model_path,
+                              input_hw=window,
+                              n_windows=args.parity_windows,
+                              verbose=True)
+                   for prec in presets]
+        if args.parity_out:
+            import jax
+
+            write_parity_report(
+                reports, args.parity_out,
+                context={"backend": jax.default_backend(),
+                         "window": f"{window[0]}x{window[1]}",
+                         "eval set": f"{args.parity_windows} seeded "
+                                     f"windows (seed 0, every 17th "
+                                     f"NaN-poisoned)"})
+            print(f"parity report written to {args.parity_out}",
+                  file=sys.stderr)
+        return 0 if all(r.passed for r in reports) else 1
 
     if bool(args.exported) == bool(args.model_path):
         p.error("exactly one of --exported / --model_path is required "
@@ -117,13 +174,21 @@ def main(argv=None) -> int:
     # Input-spec compatibility is a STARTUP error (the doctor-style check):
     # an artifact exported for a different window must never reach traffic.
     if args.exported:
-        executor = ExecutorPool.from_exported(
-            args.exported, buckets, expected_hw=window,
-            devices=args.devices, shard_largest=args.shard_largest)
+        try:
+            executor = ExecutorPool.from_exported(
+                args.exported, buckets, expected_hw=window,
+                devices=args.devices, shard_largest=args.shard_largest,
+                precision=args.precision)
+        except ValueError as exc:
+            # Precision/window disagreement is an OPERATIONAL error with
+            # a named fix — never a dtype/shape traceback mid-request.
+            print(f"dasmtl-serve: {exc}", file=sys.stderr)
+            return 2
     else:
         executor = ExecutorPool.from_checkpoint(
             args.model, args.model_path, buckets, input_hw=window,
-            devices=args.devices, shard_largest=args.shard_largest)
+            devices=args.devices, shard_largest=args.shard_largest,
+            precision=args.precision)
     loop = ServeLoop(executor, buckets=buckets,
                      max_wait_s=args.max_wait_ms / 1e3,
                      queue_depth=args.queue_depth,
@@ -131,7 +196,8 @@ def main(argv=None) -> int:
                      inflight=args.inflight)
     print(f"warming {len(buckets)} bucket(s) "
           f"{list(buckets)} on {executor.input_hw[0]}x"
-          f"{executor.input_hw[1]} windows across "
+          f"{executor.input_hw[1]} windows (precision "
+          f"{executor.precision}, staging {executor.input_dtype}) across "
           f"{len(executor.executors)} device(s) ...", file=sys.stderr)
     loop.start()
     httpd = make_http_server(loop, args.host, args.port)

@@ -72,7 +72,9 @@ class BatchPlan:
         zeroed — the same weight-0/zeros convention as
         :func:`pad_to_bucket`, without the per-batch ``np.stack`` +
         ``np.concatenate`` allocations the old path paid twice per
-        flush."""
+        flush.  The buffer's dtype is the executor's staging dtype
+        (bf16 under the reduced serving precisions) — the row assignment
+        below casts f32 request payloads in the same pass as the copy."""
         if buf.shape[0] != self.bucket:
             raise ValueError(f"staging buffer holds {buf.shape[0]} rows, "
                              f"plan bucket is {self.bucket}")

@@ -65,10 +65,14 @@ class InferExecutor:
     def __init__(self, infer_fn: Callable, input_hw: Tuple[int, int],
                  buckets: Sequence[int], *, jit: bool = True,
                  strict_recompile: bool = True, source: str = "fn",
-                 placement: Optional[Any] = None):
+                 placement: Optional[Any] = None, precision: str = "f32",
+                 input_dtype: Optional[Any] = None,
+                 precision_meta: Optional[dict] = None):
         import jax
 
         from dasmtl.analysis.guards import StepGuards
+        from dasmtl.models.precision import (check_precision,
+                                             staging_dtype_for)
 
         self._fn = jax.jit(infer_fn) if jit else infer_fn
         # The separately-jitted decode tail for computations whose body is
@@ -78,6 +82,15 @@ class InferExecutor:
         self.input_hw = (int(input_hw[0]), int(input_hw[1]))
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.source = source
+        self.precision = check_precision(precision)
+        # The dtype batches are staged (and warmed) in: part of the shape
+        # contract — a batch in any OTHER dtype would be a fresh jit
+        # signature, i.e. a post-warmup recompile.  Exported artifacts pin
+        # it from their input spec; checkpoint forwards from the preset.
+        self.input_dtype = np.dtype(input_dtype
+                                    if input_dtype is not None
+                                    else staging_dtype_for(precision))
+        self.precision_meta = dict(precision_meta or {})
         self.placement = placement  # jax.Device | Sharding | None (default)
         self._warm = False
         self.warmup_compiles = 0
@@ -94,37 +107,45 @@ class InferExecutor:
     @classmethod
     def from_exported(cls, path: str, buckets: Sequence[int],
                       expected_hw: Optional[Tuple[int, int]] = None,
+                      precision: Optional[str] = None,
                       **kw) -> "InferExecutor":
         """Serve a StableHLO artifact.  The artifact's ``(b, h, w, 1)``
         input spec dictates the window; ``expected_hw`` (the configured
         window shape) is validated against it BEFORE the server starts —
-        a mismatch must be a startup error, not a per-request 400."""
-        from dasmtl.export import deserialize_exported, exported_input_hw
-
-        exported = deserialize_exported(path)
-        hw = exported_input_hw(exported)
-        if expected_hw is not None and tuple(expected_hw) != hw:
-            raise ValueError(
-                f"exported artifact {path} takes {hw[0]}x{hw[1]} windows "
-                f"but the configured window is {expected_hw[0]}x"
-                f"{expected_hw[1]} — re-export or fix the window config")
+        a mismatch must be a startup error, not a per-request 400.
+        ``precision`` is likewise the CONFIGURED preset: the artifact
+        header records the preset baked in at export time, and a
+        disagreement refuses to start with an operational message instead
+        of surfacing later as a dtype traceback."""
+        header, exported, hw = _load_validated_artifact(path, expected_hw,
+                                                        precision)
         # The exported computation is already compiled per concrete batch
         # size at call time; jitting again would be a second cache layer.
         return cls(exported.call, hw, buckets, jit=False,
-                   source=f"exported:{path}", **kw)
+                   source=f"exported:{path}",
+                   precision=header.get("precision", "f32"),
+                   input_dtype=np.dtype(exported.in_avals[0].dtype),
+                   precision_meta={"artifact_version":
+                                   header.get("artifact_version", 0)},
+                   **kw)
 
     @classmethod
     def from_checkpoint(cls, model: str, model_path: Optional[str],
                         buckets: Sequence[int],
                         input_hw: Optional[Tuple[int, int]] = None,
+                        precision: str = "f32",
                         **kw) -> "InferExecutor":
         """Serve an in-framework forward: build the model, restore weights
         (``model_path=None`` keeps fresh-init weights — selftest/bench),
-        jit :func:`~dasmtl.export.make_serve_infer_fn` (decode + finite
-        mask fused into the executable)."""
-        fn, hw = _checkpoint_serve_fn(model, model_path, input_hw)
+        jit the fused serve forward (decode + finite mask in the
+        executable) under the requested precision preset
+        (:mod:`dasmtl.models.precision`: params transformed once here, at
+        load)."""
+        fn, hw, meta = _checkpoint_serve_fn(model, model_path, input_hw,
+                                            precision)
         return cls(fn, hw, buckets,
-                   source=f"checkpoint:{model_path or 'fresh-init'}", **kw)
+                   source=f"checkpoint:{model_path or 'fresh-init'}",
+                   precision=precision, precision_meta=meta, **kw)
 
     # -- execution -----------------------------------------------------------
     def warmup(self) -> float:
@@ -136,7 +157,10 @@ class InferExecutor:
         t0 = time.perf_counter()
         before = self._guards.compiles
         for b in self.buckets:
-            self.run(np.zeros((b, h, w, 1), np.float32))
+            # Warmed in the STAGING dtype: the executable's input spec
+            # includes the dtype, so warming f32 and serving bf16 batches
+            # would recompile every bucket once post-warmup.
+            self.run(np.zeros((b, h, w, 1), self.input_dtype))
         self._warm = True
         self.warmup_compiles = self._guards.compiles - before
         return time.perf_counter() - t0
@@ -153,6 +177,11 @@ class InferExecutor:
         import jax
 
         t0 = time.perf_counter()
+        if x.dtype != self.input_dtype:
+            # Steady-state batches arrive pre-staged in input_dtype (the
+            # ServeLoop sizes its staging buffers from it); this host-side
+            # cast only covers direct run()/parity callers handing f32.
+            x = x.astype(self.input_dtype)
         if self.placement is not None:
             # The declared H2D path: committed inputs route the compiled
             # call onto this executor's device (or mesh sharding).
@@ -213,6 +242,9 @@ class InferExecutor:
     def compile_summary(self) -> dict:
         return {"buckets": list(self.buckets), "warm": self._warm,
                 "source": self.source,
+                "precision": self.precision,
+                "input_dtype": str(self.input_dtype),
+                "precision_meta": dict(self.precision_meta),
                 "placement": _placement_name(self.placement),
                 "warmup_compiles": self.warmup_compiles,
                 **self._guards.summary()}
@@ -230,12 +262,16 @@ def _placement_name(placement) -> Optional[str]:
 
 
 def _checkpoint_serve_fn(model: str, model_path: Optional[str],
-                         input_hw: Optional[Tuple[int, int]]):
+                         input_hw: Optional[Tuple[int, int]],
+                         precision: str = "f32"):
     """Build the fused serve forward (decode + finite mask on device) for
-    a checkpoint, ONCE — the pool shares it across every device member."""
+    a checkpoint, ONCE — the pool shares it across every device member.
+    ``precision`` transforms the restored variables at this single load
+    point (bf16 cast / per-channel int8 quantization,
+    :mod:`dasmtl.models.precision`); returns ``(fn, hw, meta dict)``."""
     from dasmtl.config import INPUT_HEIGHT, INPUT_WIDTH, Config
-    from dasmtl.export import make_serve_infer_fn
     from dasmtl.main import build_state
+    from dasmtl.models.precision import make_precision_serve_fn
     from dasmtl.models.registry import get_model_spec
 
     hw = tuple(input_hw or (INPUT_HEIGHT, INPUT_WIDTH))
@@ -246,7 +282,38 @@ def _checkpoint_serve_fn(model: str, model_path: Optional[str],
         from dasmtl.train.checkpoint import restore_weights
 
         state = restore_weights(state, model_path)
-    return make_serve_infer_fn(spec, state), hw
+    fn, meta = make_precision_serve_fn(spec, state, precision)
+    return fn, hw, meta.summary()
+
+
+def _load_validated_artifact(path: str,
+                             expected_hw: Optional[Tuple[int, int]],
+                             precision: Optional[str]):
+    """Shared startup validation of the exported serving path: read the
+    versioned container, then check the artifact against BOTH halves of
+    the serving config — window shape and precision preset.  Every
+    failure is an operational message naming the fix, raised before any
+    traffic is accepted."""
+    from dasmtl.export import load_artifact, exported_input_hw
+
+    header, exported = load_artifact(path)
+    hw = exported_input_hw(exported)
+    if expected_hw is not None and tuple(expected_hw) != hw:
+        raise ValueError(
+            f"exported artifact {path} takes {hw[0]}x{hw[1]} windows "
+            f"but the configured window is {expected_hw[0]}x"
+            f"{expected_hw[1]} — re-export or fix the window config")
+    artifact_precision = header.get("precision", "f32")
+    if precision is not None and precision != artifact_precision:
+        legacy = (" (a headerless pre-versioning artifact is always f32)"
+                  if header.get("artifact_version", 0) == 0 else "")
+        raise ValueError(
+            f"exported artifact {path} was exported with precision "
+            f"'{artifact_precision}'{legacy} but the serving config asks "
+            f"for '{precision}' — re-export with dasmtl-export "
+            f"--precision {precision}, or start the server with "
+            f"--precision {artifact_precision}")
+    return header, exported, hw
 
 
 class ExecutorPool:
@@ -282,6 +349,9 @@ class ExecutorPool:
         self.input_hw = executors[0].input_hw
         self.buckets = executors[0].buckets
         self.source = getattr(executors[0], "source", "fn")
+        self.precision = getattr(executors[0], "precision", "f32")
+        self.input_dtype = getattr(executors[0], "input_dtype",
+                                   np.dtype(np.float32))
         self._rr = 0
 
     # -- constructors --------------------------------------------------------
@@ -323,16 +393,20 @@ class ExecutorPool:
                         buckets: Sequence[int],
                         input_hw: Optional[Tuple[int, int]] = None,
                         devices=None, shard_largest: bool = False,
+                        precision: str = "f32",
                         **kw) -> "ExecutorPool":
-        """Pool over a checkpoint forward: the model is built and the
-        weights restored ONCE; every member jits the same fused serve
-        forward onto its own device."""
-        fn, hw = _checkpoint_serve_fn(model, model_path, input_hw)
+        """Pool over a checkpoint forward: the model is built, the
+        weights restored, and the precision transform applied ONCE; every
+        member jits the same fused serve forward onto its own device —
+        one warmed executable per (bucket, device, precision)."""
+        fn, hw, meta = _checkpoint_serve_fn(model, model_path, input_hw,
+                                            precision)
         src = f"checkpoint:{model_path or 'fresh-init'}"
 
         def make(placement, buckets=tuple(buckets)):
             return InferExecutor(fn, hw, buckets, source=src,
-                                 placement=placement, **kw)
+                                 placement=placement, precision=precision,
+                                 precision_meta=meta, **kw)
 
         return cls._build(make, hw, buckets, devices, shard_largest)
 
@@ -340,25 +414,25 @@ class ExecutorPool:
     def from_exported(cls, path: str, buckets: Sequence[int],
                       expected_hw: Optional[Tuple[int, int]] = None,
                       devices=None, shard_largest: bool = False,
+                      precision: Optional[str] = None,
                       **kw) -> "ExecutorPool":
         """Pool over one deserialized StableHLO artifact: the artifact's
         compiled computation routes to each member's device via committed
-        inputs (validated against ``expected_hw`` before startup, exactly
-        like the single-executor path)."""
-        from dasmtl.export import deserialize_exported, exported_input_hw
-
-        exported = deserialize_exported(path)
-        hw = exported_input_hw(exported)
-        if expected_hw is not None and tuple(expected_hw) != hw:
-            raise ValueError(
-                f"exported artifact {path} takes {hw[0]}x{hw[1]} windows "
-                f"but the configured window is {expected_hw[0]}x"
-                f"{expected_hw[1]} — re-export or fix the window config")
+        inputs (window shape AND precision header validated against the
+        serving config before startup, exactly like the single-executor
+        path)."""
+        header, exported, hw = _load_validated_artifact(path, expected_hw,
+                                                        precision)
 
         def make(placement, buckets=tuple(buckets)):
-            return InferExecutor(exported.call, hw, buckets, jit=False,
-                                 source=f"exported:{path}",
-                                 placement=placement, **kw)
+            return InferExecutor(
+                exported.call, hw, buckets, jit=False,
+                source=f"exported:{path}", placement=placement,
+                precision=header.get("precision", "f32"),
+                input_dtype=np.dtype(exported.in_avals[0].dtype),
+                precision_meta={"artifact_version":
+                                header.get("artifact_version", 0)},
+                **kw)
 
         return cls._build(make, hw, buckets, devices, shard_largest)
 
@@ -399,6 +473,8 @@ class ExecutorPool:
     def compile_summary(self) -> dict:
         per_device = [e.compile_summary() for e in self.executors]
         out = {"buckets": list(self.buckets), "source": self.source,
+               "precision": self.precision,
+               "input_dtype": str(self.input_dtype),
                "pool_size": len(self.executors),
                "warm": all(p.get("warm", True) for p in per_device),
                "post_warmup_compiles": self.post_warmup_compiles,

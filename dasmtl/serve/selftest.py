@@ -43,23 +43,30 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
                  poison_every: int = 37, model: str = "MTL",
                  use_signal: bool = True, drain_frac: float = 0.7,
                  devices: int = 1, inflight: int = 2,
+                 precision: str = "f32",
                  verbose: bool = True) -> dict:
     """Returns a report dict: ``{"passed": bool, "failures": [...],
     "stats": <ServeLoop.stats()>, ...}``.  ``use_signal=False`` calls
     ``begin_drain`` directly (for callers not on the main thread, where
-    ``signal.signal`` is unavailable)."""
+    ``signal.signal`` is unavailable).  ``precision`` selects the serving
+    preset (docs/SERVING.md "Precision presets") — the invariants below
+    hold for every preset, including zero post-warmup recompiles (the
+    bf16 staging dtype is part of the warmed shape contract) and the
+    NaN-rejection path (bf16 carries NaN like f32 does)."""
     from dasmtl.serve.executor import ExecutorPool
     from dasmtl.serve.server import ServeLoop, install_signal_handlers
 
     executor = ExecutorPool.from_checkpoint(model, None, buckets,
                                             input_hw=input_hw,
-                                            devices=devices)
+                                            devices=devices,
+                                            precision=precision)
     loop = ServeLoop(executor, buckets=buckets,
                      max_wait_s=max_wait_ms / 1e3,
                      queue_depth=queue_depth, inflight=inflight)
     say = print if verbose else (lambda *_a, **_k: None)
     say(f"[serve-selftest] warming {len(buckets)} bucket(s) on "
-        f"{input_hw[0]}x{input_hw[1]} windows across "
+        f"{input_hw[0]}x{input_hw[1]} windows (precision {precision}, "
+        f"staging {executor.input_dtype}) across "
         f"{len(executor.executors)} device(s) ...")
     loop.start()
     say(f"[serve-selftest] warmup {loop.stats()['warmup_s']:.2f}s; firing "
@@ -191,6 +198,7 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
     report = {
         "passed": not failures,
         "failures": failures,
+        "precision": precision,
         "requests": requests,
         "ok": n_ok,
         "refused": n_refused,
@@ -225,7 +233,8 @@ def write_job_summary(report: dict, path: Optional[str] = None) -> None:
     if not path:
         return
     lines = [
-        f"### serve selftest ({report['devices']} device(s))",
+        f"### serve selftest ({report['devices']} device(s), "
+        f"precision {report.get('precision', 'f32')})",
         "",
         f"- passed: **{report['passed']}**",
         f"- warmup: **{report['warmup_s']:.2f}s**"

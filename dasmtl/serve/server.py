@@ -97,9 +97,15 @@ class ServeLoop:
         # depth = in-flight window + 1 (one extra for the batch being
         # formed) keeps acquire effectively non-blocking; slots release at
         # collect, when the computation is done with the host buffer.
+        # Buffers take the executor's staging dtype: a reduced-precision
+        # preset stages bf16, so the f32->bf16 cast happens once per row
+        # at assembly and the dispatched batch matches the warmed
+        # executable's input spec exactly (dtype is part of the
+        # zero-post-warmup-recompile contract).
         self._staging = StagingBuffers.for_buckets(
             buckets, getattr(executor, "input_hw", (1, 1)),
-            depth=self.inflight_window + 1)
+            depth=self.inflight_window + 1,
+            dtype=getattr(executor, "input_dtype", np.float32))
         self._cv = threading.Condition()
         self._stop = False
         self._slots = threading.BoundedSemaphore(self.inflight_window)

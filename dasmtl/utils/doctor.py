@@ -16,6 +16,7 @@ import importlib
 import json
 import os
 import sys
+from typing import Optional
 
 
 def collect() -> dict:
@@ -134,6 +135,7 @@ def collect() -> dict:
         "inflight": d.serve_inflight,
         "devices": d.serve_devices,
         "shard_largest": d.serve_shard_largest,
+        "precision": d.serve_precision,
     }
 
     # Tracing-discipline tooling (dasmtl.analysis): the registered lint
@@ -195,22 +197,31 @@ def _determinism_baseline_summary() -> dict:
             "generated_with": data.get("generated_with", {})}
 
 
-def check_exported_artifact(path: str, window=None) -> dict:
-    """Serve-precheck: does this StableHLO artifact's input spec match the
-    window shape the server would feed it?  The same validation
-    ``dasmtl-serve --exported`` runs at startup — here it is answerable
-    without starting anything."""
+def check_exported_artifact(path: str, window=None,
+                            precision: Optional[str] = None) -> dict:
+    """Serve-precheck: does this StableHLO artifact match what the server
+    would be configured with — window shape, and (when ``precision`` is
+    given) the serving precision preset vs the artifact header's recorded
+    one?  The same validation ``dasmtl-serve --exported`` runs at startup
+    — here it is answerable without starting anything."""
     from dasmtl.config import INPUT_HEIGHT, INPUT_WIDTH
-    from dasmtl.export import deserialize_exported, exported_input_hw
+    from dasmtl.export import exported_input_hw, load_artifact
 
     want = tuple(window or (INPUT_HEIGHT, INPUT_WIDTH))
     try:
-        got = exported_input_hw(deserialize_exported(path))
+        header, exported = load_artifact(path)
+        got = exported_input_hw(exported)
     except Exception as exc:  # noqa: BLE001 — diagnostic, not control flow
         return {"path": path, "status": f"unreadable ({exc})"}
-    return {"path": path,
-            "status": "compatible" if got == want else "MISMATCH",
-            "artifact_hw": list(got), "configured_hw": list(want)}
+    out = {"path": path,
+           "status": "compatible" if got == want else "MISMATCH",
+           "artifact_hw": list(got), "configured_hw": list(want),
+           "artifact_version": header.get("artifact_version", 0),
+           "precision": header.get("precision", "f32")}
+    if precision is not None and precision != out["precision"]:
+        out["status"] = "PRECISION-MISMATCH"
+        out["configured_precision"] = precision
+    return out
 
 
 def main(argv=None) -> int:
@@ -221,12 +232,19 @@ def main(argv=None) -> int:
                     help="also validate a StableHLO serving artifact's "
                          "input spec against the configured window shape "
                          "(what dasmtl-serve checks before accepting "
-                         "traffic)")
+                         "traffic); prints the artifact's precision/"
+                         "version header")
+    ap.add_argument("--precision", type=str, default=None,
+                    choices=["f32", "bf16", "int8"],
+                    help="with --exported: also require the artifact's "
+                         "recorded precision preset to match (the other "
+                         "half of the dasmtl-serve startup check)")
     args = ap.parse_args(argv)
     info = collect()
     rc = 0
     if args.exported:
-        info["exported_artifact"] = check_exported_artifact(args.exported)
+        info["exported_artifact"] = check_exported_artifact(
+            args.exported, precision=args.precision)
         # The one doctor check that gates an action (serving this
         # artifact): surface it in the exit code for scripted prechecks.
         rc = 0 if info["exported_artifact"]["status"] == "compatible" else 1
@@ -271,15 +289,27 @@ def main(argv=None) -> int:
         + " (dasmtl-serve; docs/SERVING.md)")
     ea = info.get("exported_artifact")
     if ea:
+        head = (f"precision {ea['precision']}, artifact "
+                f"v{ea['artifact_version']}"
+                if "precision" in ea else "no header")
         if ea["status"] == "compatible":
             print(f"  exported artifact: {ea['path']} compatible — "
-                  f"{ea['artifact_hw'][0]}x{ea['artifact_hw'][1]} windows")
+                  f"{ea['artifact_hw'][0]}x{ea['artifact_hw'][1]} windows "
+                  f"({head})")
         elif ea["status"] == "MISMATCH":
             print(f"  exported artifact: {ea['path']} MISMATCH — artifact "
                   f"takes {ea['artifact_hw'][0]}x{ea['artifact_hw'][1]}, "
                   f"config expects {ea['configured_hw'][0]}x"
-                  f"{ea['configured_hw'][1]}; dasmtl-serve would refuse "
-                  f"to start")
+                  f"{ea['configured_hw'][1]} ({head}); dasmtl-serve would "
+                  f"refuse to start")
+        elif ea["status"] == "PRECISION-MISMATCH":
+            print(f"  exported artifact: {ea['path']} PRECISION-MISMATCH "
+                  f"— artifact recorded '{ea['precision']}' "
+                  f"(v{ea['artifact_version']}), config asks "
+                  f"'{ea['configured_precision']}'; re-export with "
+                  f"dasmtl-export --precision "
+                  f"{ea['configured_precision']} or serve with "
+                  f"--precision {ea['precision']}")
         else:
             print(f"  exported artifact: {ea['path']} {ea['status']}")
     ana = info.get("analysis", {})

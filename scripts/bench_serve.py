@@ -20,6 +20,17 @@ compute + D2H / resolve) plus the max observed in-flight depth.  Reports
 land in ``BENCH_serve.json`` alongside the repo's other ``BENCH_*.json``
 snapshots, one JSON line per mode on stdout.
 
+The sweep carries a **precision dimension** (``--precisions
+f32,bf16,int8``): one closed-loop + offered-load leg per serving preset,
+each through its own freshly warmed loop, with the per-stage breakdown
+and shed rate recorded side by side and the closed-loop speedup vs the
+f32 leg computed at equal (zero) shed rate.  NB on plain-CPU hosts the
+reduced presets measure ~1.0x by construction — XLA:CPU legalizes bf16
+to f32 and the weight-only int8 path dequantizes into bf16 — the
+arithmetic win is an MXU property (bf16 2x, int8 4x peak rate); what
+this bench pins on CPU is that the presets cost nothing and the audit
+(AUD103/AUD108) pins that the shipped program really is the cheap one.
+
 Run:  python scripts/bench_serve.py [--requests 2000] [--sweep 0.5,1,1.5]
       python scripts/bench_serve.py --smoke     # CI: small + invariants
 """
@@ -38,7 +49,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _build_loop(args):
+def _build_loop(args, precision="f32"):
     from dasmtl.serve.executor import ExecutorPool
     from dasmtl.serve.server import ServeLoop
 
@@ -46,26 +57,29 @@ def _build_loop(args):
     buckets = tuple(int(b) for b in args.buckets.split(","))
     executor = ExecutorPool.from_checkpoint(
         args.model, args.model_path, buckets, input_hw=(h, w),
-        devices=args.devices, shard_largest=args.shard_largest)
+        devices=args.devices, shard_largest=args.shard_largest,
+        precision=precision)
     loop = ServeLoop(executor, buckets=buckets,
                      max_wait_s=args.max_wait_ms / 1e3,
                      queue_depth=args.queue_depth,
                      inflight=args.inflight)
     t0 = time.perf_counter()
     loop.start()
-    print(f"warmup ({len(buckets)} buckets, {h}x{w}, "
+    print(f"warmup ({len(buckets)} buckets, {h}x{w}, precision "
+          f"{precision}, staging {executor.input_dtype}, "
           f"{len(executor.executors)} device(s)): "
           f"{time.perf_counter() - t0:.2f}s", file=sys.stderr)
     return loop, (h, w)
 
 
-def _report(mode, loop, outcomes, wall_s, n_requests):
+def _report(mode, loop, outcomes, wall_s, n_requests, precision="f32"):
     stats = loop.stats()
     ok = sum(1 for o in outcomes if o == "ok")
     shed = sum(1 for o in outcomes if o == "shed")
     per_device = stats["executor"].get("per_device", [])
     rec = {
         "metric": f"serve_{mode}_throughput",
+        "precision": precision,
         "value": round(ok / wall_s, 1),
         "unit": "req/s",
         "requests": n_requests,
@@ -167,6 +181,11 @@ def main() -> int:
     ap.add_argument("--sweep", type=str, default="0.5,1.0,1.5",
                     help="offered-load sweep: comma-separated multipliers "
                          "of the measured closed-loop throughput")
+    ap.add_argument("--precisions", type=str, default="f32,bf16,int8",
+                    help="serving precision presets to bench, one "
+                         "closed-loop + offered-load set each (the f32 "
+                         "leg is the speedup baseline and must be "
+                         "included first)")
     ap.add_argument("--out", type=str, default="BENCH_serve.json")
     ap.add_argument("--smoke", action="store_true",
                     help="CI mode: tiny model, few hundred requests, exit "
@@ -182,38 +201,70 @@ def main() -> int:
         args.clients = 16
         args.sweep = "1.0,1.5"
 
-    loop, hw = _build_loop(args)
+    precisions = [p.strip() for p in args.precisions.split(",")
+                  if p.strip()]
     rng = np.random.default_rng(0)
+    legs = {}
+    for prec in precisions:
+        loop, hw = _build_loop(args, precision=prec)
+        outcomes, wall = closed_loop(loop, hw, args.requests,
+                                     args.clients, rng)
+        closed = _report("closed_loop", loop, outcomes, wall,
+                         args.requests, precision=prec)
 
-    outcomes, wall = closed_loop(loop, hw, args.requests, args.clients, rng)
-    closed = _report("closed_loop", loop, outcomes, wall, args.requests)
+        # Offered-load sweep: Poisson arrivals at multipliers of the
+        # measured capacity, so the recorded curve brackets the shedding
+        # knee — per preset, off the preset's OWN closed-loop capacity.
+        if args.rps is not None:
+            multipliers = [args.rps / max(1.0, closed["value"])]
+        else:
+            multipliers = [float(m) for m in args.sweep.split(",")
+                           if m.strip()]
+        sweep = []
+        for m in multipliers:
+            rps = max(10.0, m * closed["value"])
+            _reset_metrics(loop)
+            outcomes, wall = open_loop(loop, hw, args.requests, rps, rng)
+            rec = _report(f"open_loop_x{m:g}", loop, outcomes, wall,
+                          args.requests, precision=prec)
+            rec["offered_rps"] = round(rps, 1)
+            rec["offered_multiplier"] = m
+            sweep.append(rec)
 
-    # Offered-load sweep: Poisson arrivals at multipliers of the measured
-    # capacity, so the recorded curve brackets the shedding knee.
-    if args.rps is not None:
-        multipliers = [args.rps / max(1.0, closed["value"])]
-    else:
-        multipliers = [float(m) for m in args.sweep.split(",") if m.strip()]
-    sweep = []
-    for m in multipliers:
-        rps = max(10.0, m * closed["value"])
-        _reset_metrics(loop)
-        outcomes, wall = open_loop(loop, hw, args.requests, rps, rng)
-        rec = _report(f"open_loop_x{m:g}", loop, outcomes, wall,
-                      args.requests)
-        rec["offered_rps"] = round(rps, 1)
-        rec["offered_multiplier"] = m
-        sweep.append(rec)
-    open_ = sweep[-1]  # highest offered rate: the legacy "open_loop" slot
+        loop.drain(timeout=30.0)
+        loop.close()
+        legs[prec] = {"closed_loop": closed, "open_loop": sweep[-1],
+                      "open_loop_sweep": sweep}
 
-    loop.drain(timeout=30.0)
-    loop.close()
+    base = legs.get("f32") or legs[precisions[0]]
+    for prec, leg in legs.items():
+        # Closed loop runs at zero shed on both sides (the smoke asserts
+        # it), so this IS req/s at equal shed rate.
+        leg["closed_speedup_vs_f32"] = round(
+            leg["closed_loop"]["value"]
+            / max(1e-9, base["closed_loop"]["value"]), 3)
 
     out = {"backend": "cpu", "hw": args.hw, "buckets": args.buckets,
            "max_wait_ms": args.max_wait_ms, "smoke": args.smoke,
-           "inflight": args.inflight, "devices": closed["devices"],
-           "closed_loop": closed, "open_loop": open_,
-           "open_loop_sweep": sweep}
+           "inflight": args.inflight,
+           "devices": base["closed_loop"]["devices"],
+           "notes": ("closed_speedup_vs_f32 is req/s at equal (zero) "
+                     "shed rate.  On CPU backends the reduced presets "
+                     "measure ~1.0x by construction: XLA:CPU legalizes "
+                     "bf16 compute to f32 and weight-only int8 "
+                     "dequantizes into the bf16 path, so the forward's "
+                     "FLOPs are unchanged (this host runs the f32 conv "
+                     "path at machine speed, ~33 GFLOP/s single-core).  "
+                     "The arithmetic win is an MXU-rate property (bf16 "
+                     "2x, int8-weight artifacts 4x smaller); "
+                     "artifacts/audit_baseline.json serve-MTL-* targets "
+                     "pin that the shipped program IS the reduced one, "
+                     "and docs/PARITY.md pins its accuracy."),
+           "precisions": legs,
+           # Legacy top-level slots: the f32 (reference) leg.
+           "closed_loop": base["closed_loop"],
+           "open_loop": base["open_loop"],
+           "open_loop_sweep": base["open_loop_sweep"]}
     try:
         import jax
 
@@ -226,8 +277,12 @@ def main() -> int:
 
     if args.smoke:
         failures = []
-        for mode, rec in [("closed", closed)] + [
-                (r["metric"], r) for r in sweep]:
+        checks = []
+        for prec, leg in legs.items():
+            checks.append((f"{prec}:closed", leg["closed_loop"]))
+            checks += [(f"{prec}:{r['metric']}", r)
+                       for r in leg["open_loop_sweep"]]
+        for mode, rec in checks:
             if rec["post_warmup_recompiles"]:
                 failures.append(f"{mode}: post-warmup recompiles "
                                 f"{rec['post_warmup_recompiles']}")
@@ -246,9 +301,14 @@ def main() -> int:
                     f"{rec['inflight_window']})")
             if not rec["stages"]:
                 failures.append(f"{mode}: no stage breakdown recorded")
-        if closed["batches"] and closed["mean_batch_occupancy"] < 0.5:
-            failures.append(f"closed: occupancy "
-                            f"{closed['mean_batch_occupancy']} < 0.5")
+        for prec, leg in legs.items():
+            closed = leg["closed_loop"]
+            if closed["batches"] and closed["mean_batch_occupancy"] < 0.5:
+                failures.append(f"{prec}:closed: occupancy "
+                                f"{closed['mean_batch_occupancy']} < 0.5")
+            if closed["shed_rate"] > 0:
+                failures.append(f"{prec}:closed: shed at closed loop "
+                                f"(speedups not at equal shed rate)")
         for f_ in failures:
             print(f"SMOKE FAIL: {f_}", file=sys.stderr)
         return 1 if failures else 0

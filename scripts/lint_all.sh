@@ -50,6 +50,19 @@ else
     echo "== dasmtl serve selftest skipped (DASMTL_LINT_SKIP_SERVE set)"
 fi
 
+# Precision parity gate: both reduced serving presets vs the f32
+# reference on the tiny seeded model (ints on decisive windows,
+# log-prob tolerance, NaN-mask identity — dasmtl/serve/parity.py).
+# CI's serve job runs the same gate; a few model compiles, so
+# skippable alongside the serve smoke for doc-only edits.
+if [ "${DASMTL_LINT_SKIP_PARITY:-}" = "" ]; then
+    echo "== dasmtl serve --parity-check (bf16 + int8)"
+    python -m dasmtl.serve --parity-check --window 52x64 \
+        --parity_windows 128 || rc=1
+else
+    echo "== serve parity check skipped (DASMTL_LINT_SKIP_PARITY set)"
+fi
+
 # Training-loader smoke: staged-pipeline invariants (worker-determinism,
 # staging bounds, guarded short train run) on a small synthetic tree.
 # CI's loader job runs the same leg after building the native extension.

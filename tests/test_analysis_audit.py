@@ -326,7 +326,20 @@ def test_committed_baseline_covers_ci_preset():
     baseline = load_baseline(path)
     assert baseline is not None, "artifacts/audit_baseline.json missing"
     targets = baseline["targets"]
+    from dasmtl.analysis.audit.targets import ServeAuditConfig
+
     for acfg in resolve_configs("full"):
+        if isinstance(acfg, ServeAuditConfig):
+            # Serve-forward precision targets: one entry under the
+            # config's own name; never donate, never communicate.
+            assert acfg.name in targets, acfg.name
+            entry = targets[acfg.name]
+            assert entry["metrics"]["flops"] > 0
+            assert entry["donation"] == "none"
+            assert entry["collectives"] == {}
+            if acfg.precision == "int8":
+                assert entry["metrics"]["int8_dequant_converts"] > 0
+            continue
         for kind in ("train", "eval"):
             name = f"{acfg.name}-{kind}"
             assert name in targets, name

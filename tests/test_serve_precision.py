@@ -1,0 +1,479 @@
+"""Precision-preset serving: quantization numerics, parity gating,
+artifact versioning, staging dtype, pool parity, AUD108.
+
+The contract under test (docs/SERVING.md "Precision presets"):
+
+- per-channel symmetric int8 weight quantization round-trips within its
+  analytic error bound, and the dequantize-free int8 matmul matches f32;
+- each reduced preset's decoded ints agree with f32 at the committed
+  threshold over a seeded batch, and a CORRUPTED quantization scale
+  makes the parity gate actually fail (a gate that cannot fail gates
+  nothing);
+- the versioned artifact header carries the preset and the serving
+  stack refuses a mismatch at startup with an operational message;
+- reduced presets stage bf16 without any post-warmup recompile (the
+  input dtype is part of the warmed shape contract);
+- a 2-virtual-device pool answers identically to a 1-device pool under
+  every preset (the PR 5 parity convention, per preset).
+"""
+
+import numpy as np
+import pytest
+
+from dasmtl.config import Config
+from dasmtl.main import build_state
+from dasmtl.models import precision as P
+from dasmtl.models.registry import get_model_spec
+
+HW = (52, 64)
+
+
+@pytest.fixture(scope="module")
+def mtl_state():
+    cfg = Config(model="MTL")
+    spec = get_model_spec(cfg.model)
+    return spec, build_state(cfg, spec, input_hw=HW)
+
+
+# -- quantization numerics ----------------------------------------------------
+
+
+def test_quantize_roundtrip_within_analytic_bound():
+    """Symmetric per-channel round-trip error is <= scale/2 per element
+    (half a quantization step), channel by channel — including an
+    all-zero channel, which must round-trip exactly (scale 1, q 0)."""
+    rng = np.random.default_rng(0)
+    k = rng.normal(size=(3, 3, 8, 16)).astype(np.float32)
+    k[..., 3] *= 50.0  # one hot channel: per-channel scales must adapt
+    k[..., 7] = 0.0  # all-zero channel: no divide-by-zero, exact
+    q, scale = P.quantize_kernel(k)
+    q, scale = np.asarray(q), np.asarray(scale)
+    assert q.dtype == np.int8 and scale.dtype == np.float32
+    assert scale.shape == (16,)
+    back = np.asarray(P.dequantize_kernel(q, scale, np.float32))
+    err = np.abs(back - k)
+    assert np.all(err <= scale[None, None, None, :] / 2 + 1e-7)
+    assert np.array_equal(back[..., 7], np.zeros_like(k[..., 7]))
+    # The hot channel's scale is ~50x the others' — really per-channel.
+    assert scale[3] > 10 * np.median(scale)
+
+
+def test_quantize_rejects_vectors():
+    with pytest.raises(ValueError, match=">=2-D"):
+        P.quantize_kernel(np.ones(4, np.float32))
+
+
+def test_int8_dot_matches_f32_within_tolerance():
+    """The dequantize-free path: dynamic activation quantization + int8
+    dot + rescale tracks the f32 matmul within the combined quantization
+    noise, and adds the bias in f32."""
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(5, 64)).astype(np.float32)
+    w = rng.normal(size=(64, 8)).astype(np.float32)
+    b = rng.normal(size=(8,)).astype(np.float32)
+    q, scale = P.quantize_kernel(w)
+    got = np.asarray(P.int8_dot(x, q, scale, b))
+    want = x @ w + b
+    # Error budget: x rounds at |x|_max/254 per element, w at scale/2 —
+    # accumulated over K=64; 2% of the output scale is ample.
+    assert np.max(np.abs(got - want)) < 0.02 * np.max(np.abs(want))
+    assert got.dtype == np.float32
+
+
+def test_precision_pack_counts_and_dtypes(mtl_state):
+    """The int8 pack quantizes exactly the conv/dense kernels (MTL: 42,
+    counted from the tree, no dense), stores f32 scales keyed by param
+    path, and shrinks stored parameter bytes ~4x."""
+    spec, state = mtl_state
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+
+    def count_kernels(node, path=()):
+        if isinstance(node, dict):
+            return sum(count_kernels(v, path + (k,))
+                       for k, v in node.items())
+        return int(path[-1] == "kernel" and node.ndim >= 2)
+
+    n_kernels = count_kernels(variables["params"])
+    assert n_kernels == 42  # backbone 20 + 2 tasks x 11
+
+    pack = P.precision_variables(variables, "int8")
+    meta = P.precision_meta(variables, "int8")
+    assert meta.n_kernels_quantized == n_kernels
+    assert meta.n_dense_native == 0
+    assert len(pack["scales"]) == n_kernels
+    import jax.numpy as jnp
+
+    for key, scale in pack["scales"].items():
+        assert key.endswith("/kernel")
+        assert scale.dtype == jnp.float32
+    f32_bytes = P.precision_meta(variables, "f32").param_bytes
+    assert meta.param_bytes < 0.3 * f32_bytes  # ~4x smaller
+
+    bf16 = P.precision_meta(variables, "bf16")
+    assert bf16.n_kernels_quantized == 0
+    assert 0.45 * f32_bytes < bf16.param_bytes < 0.6 * f32_bytes
+
+
+# -- parity gating ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("precision", ["bf16", "int8"])
+def test_parity_gate_passes_for_preset(precision):
+    from dasmtl.serve.parity import run_parity
+
+    report = run_parity(precision, model="MTL", input_hw=HW,
+                        n_windows=64, batch=8)
+    assert report.passed, report.failures
+    assert report.int_agreement_min >= report.threshold
+    assert report.nan_mask_identical
+    assert report.n_poisoned > 0
+    assert report.log_prob_max_abs_diff <= report.log_prob_tolerance
+
+
+def test_parity_fails_on_corrupted_scale(mtl_state):
+    """Inject a real quantization defect — one conv kernel's scale
+    multiplied 8x — and the gate must fail: decisive windows flip and/or
+    the log-prob heads leave tolerance.  This is the test that the gate
+    can refuse."""
+    import jax
+
+    from dasmtl.serve.parity import compare_runs, seeded_windows
+
+    spec, state = mtl_state
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+    pack = P.precision_variables(variables, "int8")
+    key = next(k for k in sorted(pack["scales"])
+               if "resblock1" in k)  # early kernel: damage propagates
+    pack["scales"][key] = pack["scales"][key] * 8.0
+    fwd = P.precision_forward(spec, "int8")
+    ref_fn = jax.jit(P.precision_forward(spec, "f32"))
+    bad_fn = jax.jit(fwd)
+    ref_pack = P.precision_variables(variables, "f32")
+
+    windows, poisoned = seeded_windows(32, HW, poison_every=0)
+
+    def run(fn, p):
+        out = jax.device_get(fn(p, windows[..., None]))
+        bad = out.pop("bad_rows")
+        lp = {k: out.pop(k) for k in list(out)
+              if k.startswith("log_probs_")}
+        return out, np.asarray(bad, bool), lp
+
+    verdict = compare_runs(run(ref_fn, ref_pack), run(bad_fn, pack),
+                           poisoned, precision="int8")
+    assert verdict["failures"], "corrupted scale passed the parity gate"
+
+
+def test_parity_refuses_f32():
+    from dasmtl.serve.parity import run_parity
+
+    with pytest.raises(ValueError, match="REDUCED"):
+        run_parity("f32")
+
+
+# -- artifact versioning ------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def bf16_artifact(tmp_path_factory):
+    from dasmtl import export as dexport
+
+    cfg = Config(model="single_event")
+    spec = get_model_spec(cfg.model)
+    state = build_state(cfg, spec, input_hw=HW)
+    path = tmp_path_factory.mktemp("prec") / "se_bf16.stablehlo"
+    path.write_bytes(dexport.export_infer(spec, state, input_hw=HW,
+                                          precision="bf16"))
+    return str(path)
+
+
+def test_artifact_header_roundtrip(bf16_artifact):
+    from dasmtl import export as dexport
+
+    header = dexport.artifact_header(bf16_artifact)
+    assert header["precision"] == "bf16"
+    assert header["artifact_version"] == dexport.ARTIFACT_VERSION
+    assert header["model"] == "single_event"
+    assert header["input_hw"] == list(HW)
+    hdr2, exported = dexport.load_artifact(bf16_artifact)
+    assert hdr2 == header
+    assert dexport.exported_input_hw(exported) == HW
+    # The traced input spec carries the preset's staging dtype.
+    assert np.dtype(exported.in_avals[0].dtype) == \
+        P.staging_dtype_for("bf16")
+
+
+def test_artifact_precision_mismatch_is_startup_error(bf16_artifact):
+    from dasmtl.serve import ExecutorPool, InferExecutor
+
+    with pytest.raises(ValueError, match="precision 'bf16'"):
+        InferExecutor.from_exported(bf16_artifact, buckets=(1,),
+                                    expected_hw=HW, precision="f32")
+    with pytest.raises(ValueError, match="--precision bf16"):
+        ExecutorPool.from_exported(bf16_artifact, buckets=(1,),
+                                   expected_hw=HW, precision="int8")
+    # Matching (or unstated) precision starts normally.
+    ex = InferExecutor.from_exported(bf16_artifact, buckets=(1,),
+                                     expected_hw=HW, precision="bf16")
+    assert ex.precision == "bf16"
+    assert ex.input_dtype == P.staging_dtype_for("bf16")
+    ex.close()
+
+
+def test_legacy_headerless_artifact_still_loads(tmp_path):
+    """A pre-versioning artifact (bare jax.export blob) reads as v0/f32;
+    asking it to serve a reduced preset errors with the legacy hint."""
+    import jax
+    from jax import export as jax_export
+
+    from dasmtl import export as dexport
+    from dasmtl.serve import InferExecutor
+
+    cfg = Config(model="single_event")
+    spec = get_model_spec(cfg.model)
+    state = build_state(cfg, spec, input_hw=HW)
+    (b,) = jax_export.symbolic_shape("b")
+    x_spec = jax.ShapeDtypeStruct((b, *HW, 1), jax.numpy.float32)
+    infer = dexport.make_infer_fn(spec, state)
+    blob = jax_export.export(jax.jit(infer),
+                             platforms=["cpu"])(x_spec).serialize()
+    path = tmp_path / "legacy.stablehlo"
+    path.write_bytes(blob)
+
+    header = dexport.artifact_header(str(path))
+    assert header == {"artifact_version": 0, "precision": "f32"}
+    with pytest.raises(ValueError, match="headerless"):
+        InferExecutor.from_exported(str(path), buckets=(1,),
+                                    precision="bf16")
+    ex = InferExecutor.from_exported(str(path), buckets=(1,),
+                                     expected_hw=HW)
+    assert ex.precision == "f32"
+    ex.close()
+
+
+def test_corrupt_artifact_header_is_an_error(tmp_path):
+    from dasmtl import export as dexport
+
+    path = tmp_path / "bad.stablehlo"
+    path.write_bytes(dexport.pack_artifact(b"payload",
+                                           {"artifact_version": 1,
+                                            "precision": "f32"})[:-30]
+                     [:len(dexport.ARTIFACT_MAGIC) + 4] + b"{nope")
+    with pytest.raises(ValueError, match="corrupt artifact header"):
+        dexport.read_artifact(str(path))
+    path.write_bytes(dexport.pack_artifact(
+        b"p", {"artifact_version": 1, "precision": "fp4"}))
+    with pytest.raises(ValueError, match="unknown precision"):
+        dexport.read_artifact(str(path))
+    path.write_bytes(dexport.pack_artifact(
+        b"p", {"artifact_version": dexport.ARTIFACT_VERSION + 1,
+               "precision": "f32"}))
+    with pytest.raises(ValueError, match="upgrade dasmtl"):
+        dexport.read_artifact(str(path))
+
+
+def test_doctor_reports_artifact_precision(bf16_artifact):
+    from dasmtl.utils.doctor import check_exported_artifact
+
+    info = check_exported_artifact(bf16_artifact, window=HW)
+    assert info["status"] == "compatible"
+    assert info["precision"] == "bf16"
+    mism = check_exported_artifact(bf16_artifact, window=HW,
+                                   precision="int8")
+    assert mism["status"] == "PRECISION-MISMATCH"
+    assert mism["configured_precision"] == "int8"
+
+
+# -- staging dtype / recompile contract ---------------------------------------
+
+
+@pytest.mark.parametrize("precision", ["bf16", "int8"])
+def test_reduced_preset_stages_bf16_without_recompiles(precision):
+    """End to end through the ServeLoop: bf16 staging buffers, f32 client
+    windows cast at assembly, NaN rejection intact, and ZERO post-warmup
+    recompiles — the staging dtype is part of the warmed contract."""
+    from dasmtl.serve import ExecutorPool, ServeLoop
+
+    pool = ExecutorPool.from_checkpoint("MTL", None, (1, 2, 4),
+                                        input_hw=HW, devices=1,
+                                        precision=precision)
+    assert pool.input_dtype == P.staging_dtype_for(precision)
+    loop = ServeLoop(pool, max_wait_s=0.002, queue_depth=16,
+                     inflight=2).start()
+    try:
+        rng = np.random.default_rng(3)
+        results = [loop.submit(rng.normal(size=HW).astype(np.float32),
+                               timeout=60.0) for _ in range(7)]
+        poisoned = np.full(HW, 0.5, np.float32)
+        poisoned[0, 0] = np.nan
+        bad = loop.submit(poisoned, timeout=60.0)
+    finally:
+        stats = loop.stats()
+        loop.close()
+    assert all(r.ok for r in results)
+    assert not bad.ok and bad.error == "nonfinite"
+    assert stats["executor"]["post_warmup_compiles"] == 0
+    assert stats["executor"]["precision"] == precision
+    assert stats["executor"]["input_dtype"] == "bfloat16"
+
+
+def test_staging_buffers_take_dtype():
+    import ml_dtypes
+
+    from dasmtl.data.staging import StagingBuffers
+
+    st = StagingBuffers.for_buckets((2, 4), (3, 5), depth=1,
+                                    dtype=ml_dtypes.bfloat16)
+    buf = st.acquire(2)
+    assert buf.dtype == ml_dtypes.bfloat16 and buf.shape == (2, 3, 5, 1)
+    buf[0, ..., 0] = np.ones((3, 5), np.float32) * 0.1  # casts in place
+    assert buf.dtype == ml_dtypes.bfloat16
+    st.release(buf)
+
+
+# -- pool parity per preset ---------------------------------------------------
+
+
+@pytest.mark.parametrize("precision", ["bf16", "int8"])
+def test_pool_two_devices_matches_single_device_per_preset(precision):
+    """PR 5's pool parity convention, per reduced preset: the same
+    requests through a 1-member and a 2-member pool decode identically
+    (ints exact) with log-probs within 1e-6 — same program, either
+    device."""
+    import jax
+
+    from dasmtl.serve import ExecutorPool, ServeLoop
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    rng = np.random.default_rng(11)
+    windows = [rng.normal(size=HW).astype(np.float32) for _ in range(5)]
+
+    def run_pool(n_devices):
+        pool = ExecutorPool.from_checkpoint("MTL", None, (1, 2),
+                                            input_hw=HW,
+                                            devices=n_devices,
+                                            precision=precision)
+        loop = ServeLoop(pool, max_wait_s=0.002, queue_depth=16,
+                         inflight=2).start()
+        try:
+            return [loop.submit(w, timeout=60.0, want_log_probs=True)
+                    for w in windows]
+        finally:
+            stats = loop.stats()
+            loop.close()
+            for p in stats["executor"]["per_device"]:
+                assert p["post_warmup_compiles"] == 0, p
+                assert p["precision"] == precision
+
+    single = run_pool(1)
+    pooled = run_pool(2)
+    assert all(r.ok for r in single + pooled)
+    for s, p in zip(single, pooled):
+        assert s.predictions == p.predictions  # ints: exactly equal
+        for head in s.log_probs:
+            np.testing.assert_allclose(s.log_probs[head],
+                                       p.log_probs[head], atol=1e-6)
+
+
+# -- audit: int8 census + AUD108 ---------------------------------------------
+
+
+def test_int8_census_counts_literal_snippets():
+    from dasmtl.analysis.audit.hlo import int8_census
+
+    text = """
+    %0 = stablehlo.convert %arg0 : (tensor<3x3x1x16xi8>) -> tensor<3x3x1x16xbf16>
+    %1 = stablehlo.convert %arg1 : (tensor<i8>) -> tensor<f32>
+    %2 = stablehlo.convert %3 : (tensor<8x64xf32>) -> tensor<8x64xi8>
+    %4 = stablehlo.dot_general %2, %arg2 : (tensor<8x64xi8>, tensor<64x2xi8>) -> tensor<8x2xi32>
+    %5 = stablehlo.dot_general %a, %b : (tensor<8x64xf32>, tensor<64x2xf32>) -> tensor<8x2xf32>
+    %6 = stablehlo.convolution(%x, %w) : (tensor<1x4x4x1xbf16>, tensor<3x3x1x8xbf16>) -> tensor<1x4x4x8xbf16>
+    """
+    census = int8_census(text)
+    assert census == {"convert_from_i8": 2, "convert_to_i8": 1,
+                      "i8_dot_general": 1, "i8_convolution": 0}
+
+
+def test_aud108_fires_on_dropped_quantization():
+    """A 'quantized' program with no int8 anywhere must raise AUD108 —
+    and a correct tiny quantized fn must pass with exact counts."""
+    import jax
+    import jax.numpy as jnp
+
+    from dasmtl.analysis.audit.checks import audit_target
+
+    w = np.random.default_rng(0).normal(size=(3, 3, 2, 4)) \
+        .astype(np.float32)
+    q, scale = P.quantize_kernel(w)
+
+    def quantized(x):
+        k = P.dequantize_kernel(q, scale, jnp.bfloat16)
+        return jax.lax.conv_general_dilated(
+            x, k, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def plain(x):
+        return jax.lax.conv_general_dilated(
+            x, jnp.asarray(w), (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    x = jax.ShapeDtypeStruct((1, 8, 8, 2), jnp.bfloat16)
+    ok_report, ok_found = audit_target(
+        "tiny-int8", jax.jit(quantized).lower(x),
+        compute_dtype="bfloat16",
+        expect_int8={"dequantize": 1, "native_dots": 0})
+    assert not [f for f in ok_found if f.rule == "AUD108"], ok_found
+    assert ok_report.metrics["int8_dequant_converts"] == 1.0
+
+    x32 = jax.ShapeDtypeStruct((1, 8, 8, 2), jnp.float32)
+    _, bad_found = audit_target(
+        "tiny-dropped", jax.jit(plain).lower(x32),
+        expect_int8={"dequantize": 1, "native_dots": 0})
+    assert any(f.rule == "AUD108" and "dropped" in f.message
+               for f in bad_found), bad_found
+
+
+@pytest.mark.slow
+def test_serve_audit_targets_lower_clean():
+    """The three serve-forward audit targets compile and pass every
+    structural rule (incl. AUD103 bf16 discipline and AUD108 int8
+    inventory) — the same cells CI's audit job gates via the baseline."""
+    from dasmtl.analysis.audit.runner import run_audit
+    from dasmtl.analysis.audit.targets import serve_matrix
+
+    reports, findings = run_audit(serve_matrix())
+    assert [f.render() for f in findings] == []
+    by_name = {r.name: r for r in reports}
+    assert by_name["serve-MTL-int8-b8"].metrics[
+        "int8_dequant_converts"] == 42.0
+
+
+# -- config / CLI surface -----------------------------------------------------
+
+
+def test_config_serve_precision_validation():
+    assert Config().serve_precision == "f32"
+    assert Config(serve_precision="int8").serve_precision == "int8"
+    with pytest.raises(ValueError, match="serve_precision"):
+        Config(serve_precision="fp8")
+
+
+def test_cli_serve_precision_flag():
+    from dasmtl.config import parse_train_args
+
+    cfg = parse_train_args(["--serve_precision", "bf16"])
+    assert cfg.serve_precision == "bf16"
+
+
+def test_selftest_carries_precision_smoke():
+    """A tiny bf16 selftest leg: the full loop invariants hold under a
+    reduced preset (CI runs the full-size twin)."""
+    from dasmtl.serve.selftest import run_selftest
+
+    report = run_selftest(requests=48, clients=4, input_hw=HW,
+                          buckets=(1, 2, 4), use_signal=False,
+                          precision="bf16", verbose=False)
+    assert report["passed"], report["failures"]
+    assert report["precision"] == "bf16"
+    assert report["post_warmup_compiles"] == 0
