@@ -29,6 +29,12 @@ Usage (what ``Trainer.fit`` does when ``Config.tracing_guards`` is set)::
 ``jax.monitoring`` has no listener-removal API, so one module-level
 listener is registered lazily and fans out to whatever guards are active;
 an exited guard costs nothing.
+
+The counters also publish to the unified telemetry layer
+(:mod:`dasmtl.obs.registry`): every observed XLA compilation increments
+the process-wide ``dasmtl_xla_compiles_total``, and post-warmup
+violations increment ``dasmtl_xla_post_warmup_compiles_total`` — both
+ride along in any ``GET /metrics`` scrape (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -39,11 +45,22 @@ from typing import Any, Dict, List
 
 import jax
 
+from dasmtl.obs.registry import default_registry
+
 _COMPILE_EVENT_PREFIX = "/jax/core/compile/backend_compile"
 
 _lock = threading.Lock()
 _listener_registered = False
 _active: List["StepGuards"] = []
+
+#: Process-wide registry mirror of the compile event stream.
+_compiles_total = default_registry().counter(
+    "dasmtl_xla_compiles_total",
+    "XLA backend compilations observed process-wide (jax.monitoring)")
+_post_warmup_total = default_registry().counter(
+    "dasmtl_xla_post_warmup_compiles_total",
+    "XLA compilations that landed inside a post-warmup guarded step "
+    "(every one is a recompile bug)")
 
 
 def _on_event_duration(name: str, duration: float, **_kw: Any) -> None:
@@ -51,6 +68,7 @@ def _on_event_duration(name: str, duration: float, **_kw: Any) -> None:
         with _lock:
             for guard in _active:
                 guard._compiles += 1
+        _compiles_total.inc()
 
 
 def _ensure_listener() -> None:
@@ -154,6 +172,7 @@ class StepGuards:
             delta = self._compiles - before
             if delta:
                 self._post_warmup_compiles += delta
+                _post_warmup_total.inc(delta)
                 if self.recompile_check:
                     raise RecompileError(
                         f"step {first_step}: {delta} XLA compilation(s) "

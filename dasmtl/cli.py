@@ -86,6 +86,18 @@ def sanitize_main(argv=None) -> int:
     return main(argv)
 
 
+def obs_main(argv=None) -> int:
+    """``dasmtl-obs`` — the unified telemetry layer's CLI
+    (dasmtl/obs/; docs/OBSERVABILITY.md): ``dump`` span records or
+    /metrics text from a live server, ``capture``/``analyze`` jax
+    profiler traces (the old scripts/capture_trace.py and
+    scripts/analyze_trace.py, importable)."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from dasmtl.obs.__main__ import main
+
+    return main(argv)
+
+
 def doctor_main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     from dasmtl.utils.doctor import main
@@ -115,6 +127,8 @@ _SUBCOMMANDS = {
     "audit": (audit_main, "compile-time HLO/cost auditor (dasmtl-audit)"),
     "sanitize": (sanitize_main,
                  "runtime SPMD sanitizer suite (dasmtl-sanitize)"),
+    "obs": (obs_main, "telemetry: trace dump / profiler capture+analyze "
+                      "(dasmtl-obs)"),
 }
 
 

@@ -184,6 +184,27 @@ class Config:
     # artifacts, match the artifact header's recorded precision.
     serve_precision: str = "f32"  # f32 | bf16 | int8
 
+    # ---- observability (dasmtl/obs/, docs/OBSERVABILITY.md) ----
+    # Train heartbeat cadence in seconds (0 = off): periodic structured
+    # lines + JSONL with samples/s EWMA, step wall time, loader stalls,
+    # H2D time, post-warmup recompiles, and an MFU estimate from the
+    # audit cost model's analytic FLOPs.
+    obs_heartbeat_s: float = 0.0
+    # Serve request-latency histogram bucket upper bounds (ms, ascending)
+    # — the /metrics family Prometheus computes p50/p95/p99 from.
+    obs_latency_buckets_ms: tuple = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                                     100.0, 250.0, 500.0, 1000.0, 2500.0)
+    # Request-trace span ring capacity behind GET /trace (0 disables
+    # tracing entirely).
+    obs_trace_ring: int = 4096
+    # Serve p99 SLO (ms): a breach auto-captures one rate-limited
+    # jax.profiler trace (0 disables the auto-trigger; POST /profile and
+    # SIGUSR2 stay armed).
+    obs_slo_p99_ms: float = 0.0
+    obs_profile_dir: str = "artifacts/obs_profiles"
+    obs_profile_cooldown_s: float = 300.0  # min seconds between captures
+    obs_profile_duration_s: float = 2.0  # seconds each capture records
+
     # ---- misc ----
     seed: int = 1
     log_every_steps: int = 100  # metric-line cadence (reference utils.py:376)
@@ -251,6 +272,25 @@ class Config:
             raise ValueError(
                 f"unknown serve_precision {self.serve_precision!r}; "
                 f"expected f32 | bf16 | int8")
+        if self.obs_heartbeat_s < 0:
+            raise ValueError("obs_heartbeat_s must be >= 0 (0 = off)")
+        lat = tuple(float(b) for b in self.obs_latency_buckets_ms)
+        if not lat or lat[0] <= 0 or any(
+                b2 <= b1 for b1, b2 in zip(lat, lat[1:])):
+            raise ValueError(
+                f"obs_latency_buckets_ms must be positive and strictly "
+                f"ascending, got {self.obs_latency_buckets_ms!r}")
+        self.obs_latency_buckets_ms = lat
+        if self.obs_trace_ring < 0:
+            raise ValueError("obs_trace_ring must be >= 0 (0 disables "
+                             "tracing)")
+        if self.obs_slo_p99_ms < 0:
+            raise ValueError("obs_slo_p99_ms must be >= 0 (0 disables "
+                             "the SLO trigger)")
+        if self.obs_profile_cooldown_s < 0:
+            raise ValueError("obs_profile_cooldown_s must be >= 0")
+        if self.obs_profile_duration_s <= 0:
+            raise ValueError("obs_profile_duration_s must be > 0")
 
     @property
     def decay_at_epoch0(self) -> bool:
@@ -360,6 +400,15 @@ def _parse_bucket_list(raw: str) -> tuple:
     except ValueError:
         raise argparse.ArgumentTypeError(
             f"expected comma-separated batch sizes, got {raw!r}") from None
+
+
+def _parse_float_list(raw: str) -> tuple:
+    """``"1,2.5,5"`` -> ``(1.0, 2.5, 5.0)`` (Config validates ordering)."""
+    try:
+        return tuple(float(b) for b in str(raw).split(",") if b.strip())
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected comma-separated numbers, got {raw!r}") from None
 
 
 def _add_shared_args(p: argparse.ArgumentParser) -> None:
@@ -537,6 +586,35 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
                         "conv/dense kernels per-channel (f32 decode tail "
                         "either way); gated by dasmtl-serve "
                         "--parity-check (docs/SERVING.md)")
+    # Observability block (dasmtl/obs/, docs/OBSERVABILITY.md) — the
+    # serve CLI carries first-class --trace_ring/--slo_p99_ms flags;
+    # these keep the config.json/CLI-parity invariant for training runs.
+    p.add_argument("--obs_heartbeat_s", type=float,
+                   default=d.obs_heartbeat_s,
+                   help="train heartbeat cadence in seconds (0 = off): "
+                        "structured progress lines + heartbeat.jsonl "
+                        "with samples/s, stalls, recompiles, and MFU "
+                        "from the audit cost model")
+    p.add_argument("--obs_latency_buckets_ms", type=_parse_float_list,
+                   default=d.obs_latency_buckets_ms, metavar="MS1,MS2,...",
+                   help="serve latency histogram bucket bounds (ms, "
+                        "ascending) exported at GET /metrics")
+    p.add_argument("--obs_trace_ring", type=int, default=d.obs_trace_ring,
+                   help="serve request-span ring capacity behind "
+                        "GET /trace (0 disables tracing)")
+    p.add_argument("--obs_slo_p99_ms", type=float,
+                   default=d.obs_slo_p99_ms,
+                   help="serve p99 SLO (ms): a breach captures one "
+                        "rate-limited jax.profiler trace (0 = off)")
+    p.add_argument("--obs_profile_dir", type=str,
+                   default=d.obs_profile_dir,
+                   help="where SLO/on-demand profiler captures land")
+    p.add_argument("--obs_profile_cooldown_s", type=float,
+                   default=d.obs_profile_cooldown_s,
+                   help="minimum seconds between profiler captures")
+    p.add_argument("--obs_profile_duration_s", type=float,
+                   default=d.obs_profile_duration_s,
+                   help="seconds each profiler capture records")
 
 
 def _resolve_compat(ns: argparse.Namespace) -> dict:

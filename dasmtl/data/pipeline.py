@@ -282,17 +282,24 @@ class BatchAssembler:
         n = idx.shape[0]
         bucket = self.batch_size
         if not self.staging.has_slot(self._slot):
-            batch = pad_to_bucket(
-                {"x": self.source.gather(idx, rng=rng),
-                 "distance": self.source.distance[idx],
-                 "event": self.source.event[idx],
-                 "weight": np.ones((n,), np.float32)}, bucket)
+            # Exactly ONE worker takes the allocating shape-learning path:
+            # the lock spans decode + slot registration, so a second
+            # worker arriving during the first decode waits and then
+            # falls through to the staged path instead of allocating a
+            # duplicate unstaged batch (a one-batch startup
+            # serialization; the race was visible as a flaky staging
+            # acquire count under CPU contention).
             with self._lock:
                 if not self.staging.has_slot(self._slot):
+                    batch = pad_to_bucket(
+                        {"x": self.source.gather(idx, rng=rng),
+                         "distance": self.source.distance[idx],
+                         "event": self.source.event[idx],
+                         "weight": np.ones((n,), np.float32)}, bucket)
                     self.staging.add_slot(
                         self._slot,
                         {k: (v.shape, v.dtype) for k, v in batch.items()})
-            return StagedBatch(batch, None)
+                    return StagedBatch(batch, None)
         buf = self.staging.acquire(self._slot)
         self.source.gather_into(idx, buf["x"], rng=rng)
         np.take(self.source.distance, idx, axis=0, out=buf["distance"][:n])

@@ -262,6 +262,32 @@ class StagingBuffers:
                 "replaced_aliased": self._replaced,
             }
 
+    def publish_metrics(self, registry, prefix: str = "dasmtl_staging"
+                        ) -> None:
+        """Mirror :meth:`stats` onto a metrics registry
+        (:mod:`dasmtl.obs.registry`) at scrape time: the monotone fields
+        (acquires / blocked_acquires / replaced_aliased) as counters —
+        ``blocked_acquires`` is THE loader-stall signal the heartbeat and
+        the serve scrape both read — the instantaneous ones as gauges."""
+        s = self.stats()
+        registry.counter(f"{prefix}_acquires_total",
+                         "Staging-buffer leases handed out"
+                         ).set_total(s["acquires"])
+        registry.counter(f"{prefix}_blocked_acquires_total",
+                         "Acquires that had to wait for a free buffer "
+                         "(consumer-bound stall signal)"
+                         ).set_total(s["blocked_acquires"])
+        registry.counter(f"{prefix}_replaced_aliased_total",
+                         "Buffers retired because device_put zero-copy "
+                         "aliased them").set_total(s["replaced_aliased"])
+        registry.gauge(f"{prefix}_outstanding",
+                       "Buffers currently leased").set(s["outstanding"])
+        registry.gauge(f"{prefix}_peak_outstanding",
+                       "Deepest simultaneous lease count observed"
+                       ).set(s["peak_outstanding"])
+        registry.gauge(f"{prefix}_depth",
+                       "Freelist depth per slot").set(s["depth"])
+
 
 def stack_leaf(parts, out: Optional[np.ndarray] = None) -> np.ndarray:
     """``np.stack`` without the temporaries: one ``[F, ...]`` output

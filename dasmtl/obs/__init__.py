@@ -1,0 +1,41 @@
+"""dasmtl.obs — the unified telemetry layer.
+
+One substrate for every signal the system emits, so scaling work (streaming
+ingestion, multi-process serving) is debugged against continuous telemetry
+instead of one-shot bench numbers:
+
+- :mod:`dasmtl.obs.registry` — thread-safe metrics registry (counters,
+  gauges, histograms with explicit buckets) rendered in Prometheus text
+  exposition format; ``GET /metrics`` on the serve front end is a view of
+  it, and ``/stats`` stays the JSON view of the same numbers.
+- :mod:`dasmtl.obs.trace` — request tracing: a trace ID minted at submit
+  and threaded through batch formation -> dispatch -> collect -> resolve,
+  span records in a bounded ring dumped as JSONL (``GET /trace``,
+  ``dasmtl obs dump``).
+- :mod:`dasmtl.obs.heartbeat` — the train heartbeat: periodic structured
+  lines + JSONL with samples/s EWMA, step wall time, loader stall,
+  H2D placement time, post-warmup recompiles, and an MFU estimate from the
+  audit cost model's analytic FLOPs (:mod:`dasmtl.analysis.audit`).
+- :mod:`dasmtl.obs.profiler` — on-demand and SLO-triggered
+  ``jax.profiler`` capture (HTTP ``POST /profile``, SIGUSR2, or a serve
+  p99 breach), rate-limited so an incident produces one trace, not a
+  disk full of them; plus the capture/analyze CLIs the old
+  ``scripts/capture_trace.py`` / ``scripts/analyze_trace.py`` now shim.
+
+Catalog of every exported metric family, the span model and the heartbeat
+schema: docs/OBSERVABILITY.md.
+"""
+
+from dasmtl.obs.registry import (MetricsRegistry, default_registry,
+                                 parse_exposition, render_prometheus)
+from dasmtl.obs.trace import SPAN_STAGES, TraceRing, mint_trace_id
+
+__all__ = [
+    "MetricsRegistry",
+    "default_registry",
+    "parse_exposition",
+    "render_prometheus",
+    "TraceRing",
+    "SPAN_STAGES",
+    "mint_trace_id",
+]

@@ -1,0 +1,85 @@
+"""``python -m dasmtl.obs`` / ``dasmtl obs`` — telemetry CLI.
+
+Subcommands:
+
+- ``dump``    — fetch span records from a live server's ``GET /trace``
+  (or its ``/metrics`` text with ``--metrics``) and print them; the
+  operator's "what is this server doing right now" one-liner.
+- ``capture`` — capture a jax.profiler trace of the jitted MTL train
+  step (the old ``scripts/capture_trace.py``, same flags).
+- ``analyze`` — summarize a captured trace (the old
+  ``scripts/analyze_trace.py``, same flags; exit 2 with a message when
+  this jax build ships no xplane reader).
+
+docs/OBSERVABILITY.md documents the span model and metric catalog.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _dump_main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="dump span records (JSONL) or metrics from a live "
+                    "dasmtl-serve front end")
+    ap.add_argument("--url", type=str, default="http://127.0.0.1:8321",
+                    help="server base URL (dasmtl-serve --host/--port)")
+    ap.add_argument("--n", type=int, default=None,
+                    help="only the most recent N spans")
+    ap.add_argument("--metrics", action="store_true",
+                    help="fetch the Prometheus /metrics text instead of "
+                         "/trace spans")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+
+    import urllib.error
+    import urllib.request
+
+    path = "/metrics" if args.metrics else "/trace"
+    url = args.url.rstrip("/") + path
+    if not args.metrics and args.n is not None:
+        url += f"?n={args.n}"
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+            sys.stdout.write(resp.read().decode("utf-8"))
+    except (urllib.error.URLError, OSError) as exc:
+        print(f"dasmtl obs dump: cannot reach {url}: {exc}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    commands = {
+        "dump": (_dump_main, "dump /trace spans (or --metrics) from a "
+                             "live server"),
+        "capture": (None, "capture a jax.profiler trace of the train "
+                          "step"),
+        "analyze": (None, "summarize a captured trace"),
+    }
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: dasmtl obs <command> [args...]\n\ncommands:")
+        for name, (_, help_text) in commands.items():
+            print(f"  {name:<8} {help_text}")
+        return 0 if argv else 2
+    cmd = argv.pop(0)
+    if cmd == "dump":
+        return _dump_main(argv)
+    if cmd == "capture":
+        from dasmtl.obs.profiler import capture_main
+
+        return capture_main(argv)
+    if cmd == "analyze":
+        from dasmtl.obs.profiler import analyze_main
+
+        return analyze_main(argv)
+    print(f"dasmtl obs: unknown command {cmd!r} "
+          f"(choose from {', '.join(commands)})", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

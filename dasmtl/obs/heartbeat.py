@@ -1,0 +1,256 @@
+"""The train heartbeat: periodic structured progress lines + JSONL.
+
+Training perf regressions stayed invisible for five PRs because the only
+signal was a quarterly bench run (BENCH_r02–r05: samples/s flat since
+seed).  The heartbeat makes the training loop continuously observable:
+every ``obs_heartbeat_s`` seconds (measured at metric-window flushes, so
+it never adds a device sync of its own) the trainer emits one
+``[heartbeat]`` line and appends one JSON record to
+``<run>/metrics/heartbeat.jsonl``:
+
+    {"kind": "heartbeat", "epoch", "step", "interval_s",
+     "samples_per_s", "samples_per_s_ewma", "step_wall_ms",
+     "h2d_ms",                   # H2D placement (dispatch) time in window
+     "loader_blocked_acquires",  # staging-freelist stalls in window
+     "post_warmup_recompiles",   # cumulative, from StepGuards
+     "flops_per_step", "peak_flops", "peak_source",
+     "mfu", "mfu_raw"}
+
+**MFU** comes from the committed audit cost model: the analytic MXU FLOP
+count of the *production* train step
+(:func:`dasmtl.analysis.audit.analytic.analytic_flops_of` — a jaxpr
+trace, no new lowering, no execution) divided by the device's peak rate.
+On TPUs the peak is the spec-sheet bf16 rate
+(:data:`~dasmtl.analysis.audit.analytic.PEAK_BF16_FLOPS`); on hosts with
+no published peak (CPU CI) it falls back to a measured dense-matmul rate
+(:func:`measured_peak_flops`), so MFU stays meaningful as "fraction of
+this host's achievable matmul throughput".  ``mfu`` is clamped into
+``(0, 1]``; ``mfu_raw`` keeps the unclamped ratio so a peak
+underestimate is visible rather than hidden.
+
+Reading heartbeats operationally (loader-stall vs step-bound runs):
+docs/OBSERVABILITY.md and the OPERATIONS.md troubleshooting table.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Optional, Tuple
+
+#: Required keys and the types a well-formed heartbeat record carries.
+#: ``mfu``/``mfu_raw``/``flops_per_step``/``peak_flops`` may be null when
+#: the FLOP model is unavailable — consumers must handle both.
+HEARTBEAT_SCHEMA = {
+    "kind": str,
+    "epoch": int,
+    "step": int,
+    "interval_s": float,
+    "samples_per_s": float,
+    "samples_per_s_ewma": float,
+    "step_wall_ms": float,
+    "h2d_ms": float,
+    "loader_blocked_acquires": int,
+    "post_warmup_recompiles": int,
+    "flops_per_step": (float, type(None)),
+    "peak_flops": (float, type(None)),
+    "peak_source": str,
+    "mfu": (float, type(None)),
+    "mfu_raw": (float, type(None)),
+}
+
+#: EWMA smoothing for samples/s across heartbeat intervals.
+_EWMA_ALPHA = 0.5
+
+
+def parse_heartbeat(line: str) -> dict:
+    """Parse + validate one heartbeat JSONL line against
+    :data:`HEARTBEAT_SCHEMA`; raises ``ValueError`` naming the violation.
+    The obs smoke and the schema round-trip test both go through here."""
+    rec = json.loads(line)
+    if not isinstance(rec, dict):
+        raise ValueError(f"heartbeat line is not an object: {line!r}")
+    if rec.get("kind") != "heartbeat":
+        raise ValueError(f"kind={rec.get('kind')!r}, expected 'heartbeat'")
+    for key, types in HEARTBEAT_SCHEMA.items():
+        if key not in rec:
+            raise ValueError(f"heartbeat record missing {key!r}")
+        want = types if isinstance(types, tuple) else (types,)
+        # ints satisfy float-typed fields (json round-trips 2.0 -> 2).
+        if float in want:
+            want = want + (int,)
+        if not isinstance(rec[key], want):
+            raise ValueError(f"heartbeat {key}={rec[key]!r} has type "
+                             f"{type(rec[key]).__name__}, expected "
+                             f"{'/'.join(t.__name__ for t in want)}")
+    return rec
+
+
+def measured_peak_flops(n: int = 384, repeats: int = 3) -> float:
+    """This host's achievable dense-matmul FLOP/s: one jitted ``n x n``
+    f32 matmul, best of ``repeats`` timed runs.  A deliberate
+    *achievable* (not theoretical) peak — a model step running conv
+    kernels will sit below it, so the fallback MFU stays < 1 on healthy
+    runs.  Costs ~tens of ms, paid once per heartbeat arm."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda a, b: a @ b)
+    a = jnp.ones((n, n), jnp.float32)
+    jax.block_until_ready(f(a, a))  # compile outside the timing
+    best = float("inf")
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(a, a))
+        best = min(best, time.perf_counter() - t0)
+    return 2.0 * n ** 3 / max(best, 1e-9)
+
+
+def resolve_peak_flops() -> Tuple[float, str]:
+    """``(peak FLOP/s, source)`` for MFU: the spec-sheet TPU rate when
+    the device kind is known, else the measured matmul rate."""
+    import jax
+
+    from dasmtl.analysis.audit.analytic import peak_flops_for_device
+
+    kind = jax.devices()[0].device_kind
+    peak = peak_flops_for_device(kind)
+    n_dev = jax.device_count()
+    if peak is not None:
+        return peak * n_dev, f"spec:{kind}x{n_dev}"
+    return measured_peak_flops() * n_dev, f"measured-matmul:{kind}x{n_dev}"
+
+
+class Heartbeat:
+    """Cadenced emitter fed by the trainer's metric-window flushes.
+
+    ``observe`` accumulates (samples, elapsed) per window and emits one
+    record when ``every_s`` has passed since the last emission;
+    ``finish`` flushes whatever is pending so even a run shorter than the
+    cadence leaves at least one line.  All the expensive context is
+    pulled lazily through callables:
+
+    - ``flops_fn`` -> analytic FLOPs of ONE full-batch train step
+      (resolved once, at first emission — by then the trainer has seen a
+      real batch and knows its exact shapes);
+    - ``stall_fn`` -> cumulative staging ``blocked_acquires``;
+    - ``h2d_fn`` -> cumulative seconds spent in device placement;
+    - ``recompile_fn`` -> cumulative post-warmup compile count.
+
+    The emitter reports per-window *deltas* for stalls/H2D and the
+    cumulative recompile count (a recompile is an incident, not a rate).
+    """
+
+    def __init__(self, *, every_s: float, out_path: Optional[str],
+                 batch_size: int,
+                 flops_fn: Optional[Callable[[], float]] = None,
+                 peak_flops: Optional[float] = None,
+                 peak_source: str = "unknown",
+                 stall_fn: Optional[Callable[[], int]] = None,
+                 h2d_fn: Optional[Callable[[], float]] = None,
+                 recompile_fn: Optional[Callable[[], int]] = None,
+                 clock=time.monotonic, printer=print):
+        if every_s <= 0:
+            raise ValueError("Heartbeat every_s must be > 0 (0 disables "
+                             "the heartbeat at the config layer)")
+        self.every_s = float(every_s)
+        self.out_path = out_path
+        self.batch_size = max(1, int(batch_size))
+        self.clock = clock
+        self.printer = printer
+        self._flops_fn = flops_fn
+        self._flops: Optional[float] = None
+        self._flops_failed: Optional[str] = None
+        self.peak_flops = peak_flops
+        self.peak_source = peak_source
+        self._stall_fn = stall_fn or (lambda: 0)
+        self._h2d_fn = h2d_fn or (lambda: 0.0)
+        self._recompile_fn = recompile_fn or (lambda: 0)
+        self._acc_samples = 0.0
+        self._acc_elapsed = 0.0
+        self._last_emit: Optional[float] = None
+        self._prev_stall = 0
+        self._prev_h2d = 0.0
+        self._ewma: Optional[float] = None
+        self.emitted = 0
+
+    # -- context resolution --------------------------------------------------
+    def _step_flops(self) -> Optional[float]:
+        if self._flops is None and self._flops_fn is not None \
+                and self._flops_failed is None:
+            try:
+                self._flops = float(self._flops_fn())
+            except Exception as exc:  # noqa: BLE001 — must not kill training
+                self._flops_failed = f"{type(exc).__name__}: {exc}"
+                self.printer(f"[heartbeat] MFU disabled: analytic FLOP "
+                             f"count failed ({self._flops_failed})")
+        return self._flops
+
+    # -- feeding -------------------------------------------------------------
+    def observe(self, *, epoch: int, step: int, samples: float,
+                elapsed_s: float) -> Optional[dict]:
+        """One metric window's worth of progress; emits and returns a
+        record when the cadence has elapsed, else None."""
+        now = self.clock()
+        if self._last_emit is None:
+            self._last_emit = now
+        self._acc_samples += float(samples)
+        self._acc_elapsed += float(elapsed_s)
+        if now - self._last_emit < self.every_s or self._acc_samples <= 0:
+            return None
+        return self._emit(epoch, step, now)
+
+    def finish(self, *, epoch: int, step: int) -> Optional[dict]:
+        """Flush pending accumulation (end of fit) — guarantees a short
+        run still leaves at least one heartbeat line."""
+        if self._acc_samples <= 0:
+            return None
+        return self._emit(epoch, step, self.clock())
+
+    # -- emission ------------------------------------------------------------
+    def _emit(self, epoch: int, step: int, now: float) -> dict:
+        elapsed = max(self._acc_elapsed, 1e-9)
+        sps = self._acc_samples / elapsed
+        self._ewma = sps if self._ewma is None else (
+            _EWMA_ALPHA * sps + (1 - _EWMA_ALPHA) * self._ewma)
+        steps = self._acc_samples / self.batch_size
+        stall = int(self._stall_fn())
+        h2d = float(self._h2d_fn())
+        flops = self._step_flops()
+        mfu = mfu_raw = None
+        if flops and self.peak_flops:
+            mfu_raw = flops * steps / elapsed / self.peak_flops
+            mfu = min(1.0, max(mfu_raw, 1e-12))
+        rec = {
+            "kind": "heartbeat",
+            "epoch": int(epoch),
+            "step": int(step),
+            "interval_s": round(now - (self._last_emit or now), 3),
+            "samples_per_s": round(sps, 2),
+            "samples_per_s_ewma": round(self._ewma, 2),
+            "step_wall_ms": round(elapsed / max(steps, 1e-9) * 1e3, 3),
+            "h2d_ms": round((h2d - self._prev_h2d) * 1e3, 3),
+            "loader_blocked_acquires": stall - self._prev_stall,
+            "post_warmup_recompiles": int(self._recompile_fn()),
+            "flops_per_step": flops,
+            "peak_flops": self.peak_flops,
+            "peak_source": self.peak_source,
+            "mfu": round(mfu, 6) if mfu is not None else None,
+            "mfu_raw": round(mfu_raw, 6) if mfu_raw is not None else None,
+        }
+        self._prev_stall, self._prev_h2d = stall, h2d
+        self._acc_samples = self._acc_elapsed = 0.0
+        self._last_emit = now
+        self.emitted += 1
+        if self.out_path:
+            with open(self.out_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        mfu_s = f"{mfu:.4f}" if mfu is not None else "n/a"
+        self.printer(
+            f"[heartbeat] epoch {epoch} step {step}: "
+            f"{rec['samples_per_s']:.1f} samples/s "
+            f"(ewma {rec['samples_per_s_ewma']:.1f}), "
+            f"step {rec['step_wall_ms']:.1f}ms, h2d {rec['h2d_ms']:.1f}ms, "
+            f"stalls {rec['loader_blocked_acquires']}, "
+            f"recompiles {rec['post_warmup_recompiles']}, MFU {mfu_s}")
+        return rec

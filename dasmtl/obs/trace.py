@@ -1,0 +1,110 @@
+"""Request tracing: trace IDs + a bounded span ring buffer.
+
+Every admitted serve request gets a **trace ID** minted at submit
+(:func:`mint_trace_id`, threaded through
+``dasmtl/serve/queue.py::Request.trace_id``); each pipeline stage the
+request crosses appends one **span record** to a :class:`TraceRing`:
+
+    {"trace_id", "request_id", "stage", "start_s", "duration_s",
+     "bucket", "device", "outcome"}
+
+``stage`` is one of :data:`SPAN_STAGES` (``submit`` = admission decision,
+``queue`` = waiting for peers, ``form`` = staging-buffer assembly,
+``dispatch`` = H2D + async enqueue, ``collect`` = the one host sync,
+``resolve`` = future resolution — ``outcome`` set here, and on refused
+``submit`` spans).  Timestamps are the serve loop's monotonic clock, so
+durations and ordering are exact but wall-clock alignment is the
+caller's job.
+
+The ring is bounded (``capacity`` spans, oldest evicted) and appended in
+per-batch chunks under one short lock, so tracing stays inside the
+telemetry overhead budget (docs/OBSERVABILITY.md).  Dump it as JSONL via
+``GET /trace`` on the serve front end or ``dasmtl obs dump``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+from collections import deque
+from typing import Iterable, List, Optional
+
+#: The canonical span chain of one served request, in pipeline order.
+SPAN_STAGES = ("submit", "queue", "form", "dispatch", "collect", "resolve")
+
+#: Per-process prefix so IDs from different replicas never collide when
+#: trace dumps are merged (pid is enough — IDs only need uniqueness, not
+#: secrecy).
+_PREFIX = f"{os.getpid():x}"
+_COUNTER = itertools.count()
+
+
+def mint_trace_id() -> str:
+    """Cheap process-unique ID, e.g. ``"1a2b-00000007"``."""
+    return f"{_PREFIX}-{next(_COUNTER):08x}"
+
+
+def make_span(trace_id: str, request_id: int, stage: str, start_s: float,
+              duration_s: float, bucket: Optional[int] = None,
+              device: Optional[str] = None,
+              outcome: Optional[str] = None) -> dict:
+    if stage not in SPAN_STAGES:
+        raise ValueError(f"unknown span stage {stage!r} "
+                         f"(expected one of {SPAN_STAGES})")
+    return {"trace_id": trace_id, "request_id": int(request_id),
+            "stage": stage, "start_s": round(float(start_s), 6),
+            "duration_s": round(float(duration_s), 6),
+            "bucket": bucket, "device": device, "outcome": outcome}
+
+
+class TraceRing:
+    """Bounded ring of span dicts; thread-safe; oldest spans evicted."""
+
+    def __init__(self, capacity: int = 4096):
+        if capacity < 1:
+            raise ValueError("TraceRing capacity must be >= 1")
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=self.capacity)
+        self._recorded = 0
+
+    def add(self, spans: Iterable[dict]) -> None:
+        """Append a batch of spans under ONE lock acquisition — the serve
+        loop records per batch, not per span."""
+        spans = list(spans)
+        with self._lock:
+            self._spans.extend(spans)
+            self._recorded += len(spans)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    @property
+    def recorded(self) -> int:
+        """Total spans ever recorded (evicted ones included)."""
+        with self._lock:
+            return self._recorded
+
+    def snapshot(self, n: Optional[int] = None) -> List[dict]:
+        """The most recent ``n`` spans (all, when ``n`` is None), oldest
+        first."""
+        with self._lock:
+            spans = list(self._spans)
+        return spans if n is None else spans[-int(n):]
+
+    def to_jsonl(self, n: Optional[int] = None) -> str:
+        return "".join(json.dumps(s) + "\n" for s in self.snapshot(n))
+
+    def chains(self) -> dict:
+        """``{trace_id: [spans sorted by pipeline stage order]}`` — the
+        view the propagation tests assert on."""
+        order = {s: i for i, s in enumerate(SPAN_STAGES)}
+        out: dict = {}
+        for span in self.snapshot():
+            out.setdefault(span["trace_id"], []).append(span)
+        for spans in out.values():
+            spans.sort(key=lambda s: (order[s["stage"]], s["start_s"]))
+        return out

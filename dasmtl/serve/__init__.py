@@ -17,7 +17,10 @@ deployment layer (docs/SERVING.md):
   under a bounded in-flight window, graceful drain, stdlib HTTP front
   end;
 - :mod:`~dasmtl.serve.metrics` — latency percentiles, batch occupancy,
-  per-stage pipeline timings, shed/reject counters;
+  per-stage pipeline timings, shed/reject counters — mirrored onto the
+  unified telemetry registry (:mod:`dasmtl.obs`) behind ``GET /metrics``,
+  with per-request span tracing at ``GET /trace`` and SLO-triggered
+  profiler capture (docs/OBSERVABILITY.md);
 - :mod:`~dasmtl.serve.parity` — the precision parity gate: a reduced
   serving preset (``serve_precision`` bf16/int8,
   :mod:`dasmtl.models.precision`) vs the f32 reference over a seeded

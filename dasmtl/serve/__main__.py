@@ -33,6 +33,10 @@ def main(argv=None) -> int:
                           "match --window")
     src.add_argument("--model_path", type=str, default=None,
                      help="checkpoint directory to restore weights from")
+    src.add_argument("--fresh_init", action="store_true",
+                     help="serve seed-deterministic fresh-init weights "
+                          "(identical compute to a checkpoint; the "
+                          "bench/CI path when no trained weights exist)")
     p.add_argument("--model", type=str, default="MTL",
                    help="model family (CSV columns / decode; must match "
                         "the artifact's family when --exported)")
@@ -77,6 +81,29 @@ def main(argv=None) -> int:
                         "--exported the artifact's header must agree")
     p.add_argument("--device", type=str, default="auto",
                    choices=["tpu", "cpu", "auto"])
+    obs = p.add_argument_group("observability (dasmtl/obs/, "
+                               "docs/OBSERVABILITY.md)")
+    obs.add_argument("--trace_ring", type=int, default=d.obs_trace_ring,
+                     help="request-span ring capacity behind GET /trace "
+                          "(0 disables tracing)")
+    obs.add_argument("--latency_buckets_ms", type=str,
+                     default=",".join(str(b)
+                                      for b in d.obs_latency_buckets_ms),
+                     help="latency histogram bucket bounds (ms, "
+                          "ascending) exported at GET /metrics")
+    obs.add_argument("--slo_p99_ms", type=float, default=d.obs_slo_p99_ms,
+                     help="p99 latency SLO (ms): a breach auto-captures "
+                          "ONE rate-limited jax.profiler trace "
+                          "(0 disables)")
+    obs.add_argument("--profile_dir", type=str, default=d.obs_profile_dir,
+                     help="where profiler captures land (POST /profile, "
+                          "SIGUSR2, or an SLO breach)")
+    obs.add_argument("--profile_cooldown_s", type=float,
+                     default=d.obs_profile_cooldown_s,
+                     help="minimum seconds between profiler captures")
+    obs.add_argument("--profile_duration_s", type=float,
+                     default=d.obs_profile_duration_s,
+                     help="seconds each capture records")
     p.add_argument("--parity-check", action="store_true",
                    dest="parity_check",
                    help="run the precision parity gate instead of "
@@ -151,9 +178,11 @@ def main(argv=None) -> int:
                   file=sys.stderr)
         return 0 if all(r.passed for r in reports) else 1
 
-    if bool(args.exported) == bool(args.model_path):
-        p.error("exactly one of --exported / --model_path is required "
-                "(or --selftest)")
+    n_sources = sum(1 for v in (args.exported, args.model_path,
+                                args.fresh_init) if v)
+    if n_sources != 1:
+        p.error("exactly one of --exported / --model_path / --fresh_init "
+                "is required (or --selftest)")
     try:
         buckets = tuple(int(b) for b in args.buckets.split(",") if b)
     except ValueError:
@@ -189,11 +218,31 @@ def main(argv=None) -> int:
             args.model, args.model_path, buckets, input_hw=window,
             devices=args.devices, shard_largest=args.shard_largest,
             precision=args.precision)
+
+    from dasmtl.obs.profiler import ProfilerHook
+
+    profiler = ProfilerHook(args.profile_dir,
+                            cooldown_s=args.profile_cooldown_s,
+                            duration_s=args.profile_duration_s)
+    # SIGUSR2 = "profile this server NOW" (still rate-limited); HTTP
+    # POST /profile and the SLO breach path share the same hook.
+    profiler.arm_signal()
+    try:
+        latency_buckets_s = tuple(
+            float(b) / 1e3 for b in args.latency_buckets_ms.split(",")
+            if b.strip())
+    except ValueError:
+        p.error(f"--latency_buckets_ms must be comma-separated numbers, "
+                f"got {args.latency_buckets_ms!r}")
     loop = ServeLoop(executor, buckets=buckets,
                      max_wait_s=args.max_wait_ms / 1e3,
                      queue_depth=args.queue_depth,
                      watermark=args.watermark,
-                     inflight=args.inflight)
+                     inflight=args.inflight,
+                     trace_ring=args.trace_ring,
+                     latency_buckets_s=latency_buckets_s,
+                     slo_p99_ms=args.slo_p99_ms,
+                     profiler=profiler)
     print(f"warming {len(buckets)} bucket(s) "
           f"{list(buckets)} on {executor.input_hw[0]}x"
           f"{executor.input_hw[1]} windows (precision "
@@ -203,9 +252,11 @@ def main(argv=None) -> int:
     httpd = make_http_server(loop, args.host, args.port)
     host, port = httpd.server_address[:2]
     print(f"serving {executor.source} on http://{host}:{port} "
-          f"(POST /infer, GET /healthz, GET /stats); warmup "
+          f"(POST /infer, GET /healthz, GET /stats, GET /metrics, "
+          f"GET /trace, POST /profile); warmup "
           f"{loop.stats()['warmup_s']:.2f}s; in-flight window "
-          f"{loop.inflight_window}; SIGTERM drains", file=sys.stderr)
+          f"{loop.inflight_window}; SIGTERM drains; SIGUSR2 profiles",
+          file=sys.stderr)
 
     # SIGTERM/SIGINT: refuse new work, let the dispatcher finish what is
     # queued, then stop accepting connections.  shutdown() must not run in
@@ -221,6 +272,10 @@ def main(argv=None) -> int:
     httpd.shutdown()
     t.join(timeout=10.0)
     loop.close()
+    # An in-flight profiler capture must finish (stop_trace) before the
+    # interpreter exits — tearing the process down mid-capture crashes
+    # inside the profiler's C++ teardown instead of exiting cleanly.
+    profiler.wait(timeout=args.profile_duration_s + 30.0)
     stats = loop.stats()
     print(f"drained={'clean' if drained else 'TIMEOUT'} "
           f"answered={stats['requests']['answered']} "

@@ -36,6 +36,7 @@ from dasmtl.data.pipeline import pad_to_bucket
 #: Re-export: the per-bucket staging freelist started here (PR 5) and now
 #: lives in the shared home both training and serving assemble through.
 from dasmtl.data.staging import StagingBuffers  # noqa: F401
+from dasmtl.obs.trace import TraceRing, make_span, mint_trace_id
 from dasmtl.serve.metrics import ServeMetrics
 from dasmtl.serve.queue import QueueClosed, Request, RequestQueue, ServeResult
 
@@ -106,13 +107,15 @@ class MicroBatcher:
     def __init__(self, buckets: Sequence[int], max_wait_s: float,
                  queue_depth: int, watermark: int,
                  clock=time.monotonic,
-                 metrics: Optional[ServeMetrics] = None):
+                 metrics: Optional[ServeMetrics] = None,
+                 tracer: Optional[TraceRing] = None):
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         if not self.buckets or self.buckets[0] < 1:
             raise ValueError(f"bad bucket set {buckets!r}")
         self.max_wait_s = float(max_wait_s)
         self.clock = clock
         self.metrics = metrics or ServeMetrics()
+        self.tracer = tracer
         self._queue = RequestQueue(queue_depth, watermark)
         self._lock = threading.Lock()
         self._next_id = 0
@@ -128,9 +131,10 @@ class MicroBatcher:
         now = self.clock() if now is None else now
         wait = self.max_wait_s if max_wait_s is None else float(max_wait_s)
         self.metrics.observe_submit()
+        trace_id = mint_trace_id() if self.tracer is not None else ""
         with self._lock:
             req = Request(id=self._next_id, x=x, enqueue_t=now,
-                          deadline_t=now + wait,
+                          deadline_t=now + wait, trace_id=trace_id,
                           want_log_probs=want_log_probs)
             self._next_id += 1
             try:
@@ -151,12 +155,21 @@ class MicroBatcher:
             req.wake_dispatcher = (
                 len(self._queue) >= self.buckets[-1]
                 or self._queue.peek_deadline() >= req.deadline_t)
+        if self.tracer is not None:
+            self.tracer.add([make_span(trace_id, req.id, "submit",
+                                       now, 0.0, outcome="queued")])
         return req
 
     def _refuse(self, req: Request, error: str, detail: str) -> None:
         req.resolve(ServeResult(ok=False, request_id=req.id, error=error,
-                                detail=detail))
+                                detail=detail, trace_id=req.trace_id
+                                or None))
         self.metrics.observe_result(error, 0.0)
+        if self.tracer is not None:
+            # Refusals end their chain at admission: one submit span
+            # carrying the refusal outcome (shed/closed).
+            self.tracer.add([make_span(req.trace_id, req.id, "submit",
+                                       req.enqueue_t, 0.0, outcome=error)])
 
     # -- flush policy --------------------------------------------------------
     def take_batch(self, now: Optional[float] = None) -> Optional[BatchPlan]:

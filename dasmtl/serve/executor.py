@@ -236,6 +236,12 @@ class InferExecutor:
 
     # -- reporting / lifecycle -----------------------------------------------
     @property
+    def device_name(self) -> str:
+        """Stable label for this executor's placement — the ``device``
+        field of span records and per-device metric labels."""
+        return _placement_name(self.placement) or "default"
+
+    @property
     def post_warmup_compiles(self) -> int:
         return self._guards.post_warmup_compiles
 

@@ -63,6 +63,10 @@ class ServeResult:
     # the request asked (``want_log_probs``) — the steady-state D2H
     # contract stays int predictions + a bool mask.
     log_probs: Optional[Dict[str, list]] = None
+    # The request's trace ID (dasmtl/obs/trace.py), minted at submit and
+    # echoed in the answer so a caller can join its response to the
+    # server's span records (``GET /trace``).
+    trace_id: Optional[str] = None
 
     @property
     def outcome(self) -> str:
@@ -79,6 +83,10 @@ class Request:
     x: np.ndarray
     enqueue_t: float
     deadline_t: float
+    # Trace ID minted at submit (dasmtl/obs/trace.py): threaded through
+    # batch formation -> dispatch -> collect -> resolve, labeling every
+    # span record this request produces.
+    trace_id: str = ""
     # Ask for this request's per-head log-probabilities in the answer
     # (forces the batch's collect to pull the full heads across D2H).
     want_log_probs: bool = False

@@ -19,7 +19,14 @@ invariants the subsystem exists to provide:
    including batches in flight through the pipelined data plane —
    submissions after it all resolved ``closed``; nothing was dropped;
 5. the bounded in-flight window was honored (max observed depth never
-   exceeded the configured window).
+   exceeded the configured window);
+6. observability (dasmtl/obs/): ``GET /metrics`` scraped twice MID-LOAD
+   over a real HTTP front end parses as Prometheus text exposition,
+   carries every required metric family, and its counters never
+   decrease between scrapes; and a seeded SLO breach (threshold set
+   below any real latency) triggers EXACTLY ONE rate-limited profiler
+   capture (or one clean skip with a message where jax.profiler capture
+   is unavailable).
 
 ``devices`` sizes the executor pool (run under
 ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` to get N virtual
@@ -30,11 +37,34 @@ CPU devices — the CI serve job runs both 1 and 2).  Run via
 from __future__ import annotations
 
 import os
+import shutil
 import signal
+import tempfile
 import threading
+import urllib.request
 from typing import Optional
 
 import numpy as np
+
+#: Metric families a healthy serve scrape must carry (the acceptance
+#: catalog: latency histogram, per-bucket occupancy, shed/reject
+#: counters, inflight depth, staging stats, recompile counts) —
+#: docs/OBSERVABILITY.md.
+REQUIRED_METRIC_FAMILIES = (
+    "dasmtl_serve_request_latency_seconds",
+    "dasmtl_serve_requests_total",
+    "dasmtl_serve_submitted_total",
+    "dasmtl_serve_batches_total",
+    "dasmtl_serve_batch_rows_total",
+    "dasmtl_serve_batch_occupancy",
+    "dasmtl_serve_stage_seconds",
+    "dasmtl_serve_inflight",
+    "dasmtl_serve_inflight_peak",
+    "dasmtl_serve_queue_depth",
+    "dasmtl_serve_staging_acquires_total",
+    "dasmtl_serve_staging_blocked_acquires_total",
+    "dasmtl_serve_post_warmup_recompiles_total",
+)
 
 
 def run_selftest(*, requests: int = 512, clients: int = 8,
@@ -43,7 +73,7 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
                  poison_every: int = 37, model: str = "MTL",
                  use_signal: bool = True, drain_frac: float = 0.7,
                  devices: int = 1, inflight: int = 2,
-                 precision: str = "f32",
+                 precision: str = "f32", obs_check: bool = True,
                  verbose: bool = True) -> dict:
     """Returns a report dict: ``{"passed": bool, "failures": [...],
     "stats": <ServeLoop.stats()>, ...}``.  ``use_signal=False`` calls
@@ -52,17 +82,32 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
     preset (docs/SERVING.md "Precision presets") — the invariants below
     hold for every preset, including zero post-warmup recompiles (the
     bf16 staging dtype is part of the warmed shape contract) and the
-    NaN-rejection path (bf16 carries NaN like f32 does)."""
+    NaN-rejection path (bf16 carries NaN like f32 does).  ``obs_check``
+    adds the telemetry leg: mid-load /metrics scrapes over a real HTTP
+    front end and a seeded SLO breach through the profiler hook."""
+    from dasmtl.obs.profiler import ProfilerHook
     from dasmtl.serve.executor import ExecutorPool
-    from dasmtl.serve.server import ServeLoop, install_signal_handlers
+    from dasmtl.serve.server import (ServeLoop, install_signal_handlers,
+                                     make_http_server)
 
     executor = ExecutorPool.from_checkpoint(model, None, buckets,
                                             input_hw=input_hw,
                                             devices=devices,
                                             precision=precision)
+    profiler = None
+    profile_dir = None
+    if obs_check:
+        # Seeded SLO breach: any real latency beats a 0.001 ms p99
+        # threshold, and a huge cooldown means the breach can fire the
+        # capture exactly once.
+        profile_dir = tempfile.mkdtemp(prefix="dasmtl-obs-selftest-")
+        profiler = ProfilerHook(profile_dir, cooldown_s=1e9,
+                                duration_s=0.2)
     loop = ServeLoop(executor, buckets=buckets,
                      max_wait_s=max_wait_ms / 1e3,
-                     queue_depth=queue_depth, inflight=inflight)
+                     queue_depth=queue_depth, inflight=inflight,
+                     slo_p99_ms=0.001 if obs_check else 0.0,
+                     profiler=profiler)
     say = print if verbose else (lambda *_a, **_k: None)
     say(f"[serve-selftest] warming {len(buckets)} bucket(s) on "
         f"{input_hw[0]}x{input_hw[1]} windows (precision {precision}, "
@@ -107,6 +152,26 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
     threads = [threading.Thread(target=client, args=(c,), daemon=True)
                for c in range(clients)]
     prev_handlers: Optional[dict] = None
+    scrapes: list = []
+    httpd = http_thread = None
+    if obs_check:
+        # A REAL front end on an ephemeral port: the scrape travels the
+        # same HTTP path production Prometheus would.
+        httpd = make_http_server(loop, "127.0.0.1", 0)
+        http_thread = threading.Thread(target=httpd.serve_forever,
+                                       daemon=True)
+        http_thread.start()
+
+    def scrape() -> None:
+        host, port = httpd.server_address[:2]
+        try:
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics", timeout=10.0) as resp:
+                scrapes.append(resp.read().decode("utf-8"))
+        except Exception as exc:  # noqa: BLE001 — a failed scrape IS a finding
+            failures.append(f"/metrics scrape failed: "
+                            f"{type(exc).__name__}: {exc}")
+
     if use_signal:
         prev_handlers = install_signal_handlers(
             loop, signals=(signal.SIGTERM,),
@@ -114,12 +179,18 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
     try:
         for t in threads:
             t.start()
-        # Let most of the load through, then deliver a real SIGTERM while
-        # clients are still firing — the drain must finish accepted work
-        # (including dispatched-but-uncollected batches) and refuse the
-        # rest.
-        for _ in range(drain_after):
+        # Let most of the load through — scraping /metrics twice in the
+        # middle of it — then deliver a real SIGTERM while clients are
+        # still firing: the drain must finish accepted work (including
+        # dispatched-but-uncollected batches) and refuse the rest.
+        for _ in range(drain_after // 2):
             submitted.acquire()
+        if obs_check:
+            scrape()
+        for _ in range(drain_after - drain_after // 2):
+            submitted.acquire()
+        if obs_check:
+            scrape()
         if use_signal:
             os.kill(os.getpid(), signal.SIGTERM)
         else:
@@ -134,6 +205,9 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
         if prev_handlers is not None:
             for s, h_prev in prev_handlers.items():
                 signal.signal(s, h_prev)
+        if httpd is not None:
+            httpd.shutdown()
+            http_thread.join(timeout=10.0)
     stats = loop.stats()
     loop.close()
 
@@ -195,6 +269,46 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
     if answered != requests:
         failures.append(f"metrics answered={answered} != {requests}")
 
+    # -- observability leg (dasmtl/obs/): scrape validity + SLO capture ------
+    scrape_report = profile_report = None
+    if obs_check:
+        from dasmtl.obs.registry import monotone_regressions, parse_exposition
+
+        parsed = []
+        for i, text in enumerate(scrapes):
+            try:
+                parsed.append(parse_exposition(text))
+            except ValueError as exc:
+                failures.append(f"/metrics scrape {i} not well-formed "
+                                f"exposition text: {exc}")
+        if len(parsed) == 2:
+            for fam in REQUIRED_METRIC_FAMILIES:
+                if fam not in parsed[1]:
+                    failures.append(f"/metrics missing required family "
+                                    f"{fam}")
+            regressions = monotone_regressions(parsed[0], parsed[1])
+            for r in regressions:
+                failures.append(f"counter decreased between scrapes: {r}")
+            scrape_report = {"scrapes": len(scrapes),
+                             "families": len(parsed[1]),
+                             "monotone_ok": not regressions}
+        profiler.wait(timeout=30.0)
+        profile_report = profiler.summary()
+        effective = profile_report["captures"] + len(
+            profile_report["skips"])
+        if profile_report["triggers"] < 1:
+            failures.append("seeded SLO breach never triggered the "
+                            "profiler hook")
+        elif effective != 1:
+            failures.append(
+                f"SLO breach produced {profile_report['captures']} "
+                f"capture(s) + {len(profile_report['skips'])} skip(s); "
+                f"the rate limit requires exactly one")
+        for msg in profile_report["skips"]:
+            say(f"[serve-selftest] profiler: {msg}")
+        if profile_dir is not None:
+            shutil.rmtree(profile_dir, ignore_errors=True)
+
     report = {
         "passed": not failures,
         "failures": failures,
@@ -211,6 +325,8 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
         "inflight_window": loop.inflight_window,
         "p50_ms": stats["latency_ms"]["p50"],
         "p99_ms": stats["latency_ms"]["p99"],
+        "metrics_scrape": scrape_report,
+        "slo_profile": profile_report,
         "stats": stats,
     }
     say(f"[serve-selftest] {n_ok} ok / {n_refused} refused over "

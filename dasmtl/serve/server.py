@@ -42,7 +42,10 @@ the blocking wait stays in the main loop.
 The HTTP front end is deliberately stdlib-only (``http.server``): a
 thread-per-connection ``ThreadingHTTPServer`` whose POST handler blocks on
 ``loop.submit`` — concurrency and batching live in the loop, not the
-transport.  POST /infer, GET /healthz, GET /stats (docs/SERVING.md).
+transport.  POST /infer, GET /healthz, GET /stats, GET /metrics
+(Prometheus text exposition), GET /trace (span JSONL), POST /profile
+(on-demand rate-limited jax.profiler capture) — docs/SERVING.md and
+docs/OBSERVABILITY.md.
 """
 
 from __future__ import annotations
@@ -55,9 +58,12 @@ import time
 from concurrent.futures import TimeoutError as FuturesTimeoutError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
+from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
+from dasmtl.obs.registry import default_registry, render_prometheus
+from dasmtl.obs.trace import TraceRing, make_span
 from dasmtl.serve.batcher import BatchPlan, MicroBatcher, StagingBuffers
 from dasmtl.serve.metrics import ServeMetrics
 from dasmtl.serve.queue import ServeResult
@@ -82,17 +88,33 @@ class ServeLoop:
                  max_wait_s: float = 0.005, queue_depth: int = 256,
                  watermark: Optional[int] = None, inflight: int = 2,
                  clock=time.monotonic,
-                 metrics: Optional[ServeMetrics] = None):
+                 metrics: Optional[ServeMetrics] = None,
+                 trace_ring: int = 4096,
+                 latency_buckets_s: Optional[Sequence[float]] = None,
+                 slo_p99_ms: float = 0.0, profiler=None):
         buckets = tuple(buckets or getattr(executor, "buckets", (1,)))
         if watermark is None:
             watermark = max(max(buckets), int(queue_depth * 0.9))
         self.executor = executor
-        self.metrics = metrics or ServeMetrics()
+        self.metrics = metrics or ServeMetrics(
+            latency_buckets_s=latency_buckets_s)
         self.clock = clock
         self.inflight_window = max(1, int(inflight))
+        # Request tracing (dasmtl/obs/trace.py): span records per pipeline
+        # stage in a bounded ring, dumped via GET /trace.  trace_ring=0
+        # disables tracing entirely (the bench --obs off leg).
+        self._trace_ring_size = int(trace_ring)
+        self.tracer = TraceRing(trace_ring) if trace_ring else None
+        # SLO-triggered profiling: when p99 (checked at most once per
+        # second, on the resolve path) crosses slo_p99_ms, the profiler
+        # hook captures one rate-limited trace (dasmtl/obs/profiler.py).
+        self.slo_p99_ms = float(slo_p99_ms)
+        self.profiler = profiler
+        self._slo_checked = float("-inf")
         self.batcher = MicroBatcher(buckets, max_wait_s, queue_depth,
                                     watermark, clock=clock,
-                                    metrics=self.metrics)
+                                    metrics=self.metrics,
+                                    tracer=self.tracer)
         # Per-bucket staging freelist (shared home: dasmtl/data/staging.py).
         # depth = in-flight window + 1 (one extra for the batch being
         # formed) keeps acquire effectively non-blocking; slots release at
@@ -226,6 +248,21 @@ class ServeLoop:
             return
         self.metrics.observe_stage("form", t_formed - t_form)
         self.metrics.observe_stage("dispatch", handle.dispatch_s)
+        if self.tracer is not None:
+            device = getattr(handle.executor, "device_name", "default")
+            spans = []
+            for req in plan.requests:
+                spans.append(make_span(req.trace_id, req.id, "queue",
+                                       req.enqueue_t,
+                                       max(0.0, t_taken - req.enqueue_t),
+                                       bucket=plan.bucket))
+                spans.append(make_span(req.trace_id, req.id, "form",
+                                       t_form, t_formed - t_form,
+                                       bucket=plan.bucket))
+                spans.append(make_span(req.trace_id, req.id, "dispatch",
+                                       t_formed, handle.dispatch_s,
+                                       bucket=plan.bucket, device=device))
+            self.tracer.add(spans)
         with self._cv:
             self._inflight += 1
             self.metrics.observe_inflight(self._inflight)
@@ -251,12 +288,20 @@ class ServeLoop:
                 with self._cv:
                     self._inflight -= 1
                     self._cv.notify_all()
-            self.metrics.observe_stage("collect", self.clock() - t0)
+            t1 = self.clock()
+            self.metrics.observe_stage("collect", t1 - t0)
+            if self.tracer is not None:
+                device = getattr(handle.executor, "device_name", "default")
+                self.tracer.add([
+                    make_span(r.trace_id, r.id, "collect", t0, t1 - t0,
+                              bucket=plan.bucket, device=device)
+                    for r in plan.requests])
             self._resolve_plan(plan, preds, bad, log_probs)
 
     def _resolve_plan(self, plan: BatchPlan, preds, bad, log_probs) -> None:
         done = self.clock()
         observed = []
+        spans = [] if self.tracer is not None else None
         for j, req in enumerate(plan.requests):
             latency = done - req.enqueue_t
             if bad[j]:
@@ -265,7 +310,8 @@ class ServeLoop:
                     detail="model outputs for this window hold NaN/Inf — "
                            "poisoned input or weights (SAN202, "
                            "docs/STATIC_ANALYSIS.md)",
-                    latency_s=latency, bucket=plan.bucket)
+                    latency_s=latency, bucket=plan.bucket,
+                    trace_id=req.trace_id or None)
             else:
                 out = {k: int(v[j]) for k, v in preds.items()}
                 if "event" in out:
@@ -276,24 +322,61 @@ class ServeLoop:
                           for k, v in log_probs.items()}
                 result = ServeResult(
                     ok=True, request_id=req.id, predictions=out,
-                    latency_s=latency, bucket=plan.bucket, log_probs=lp)
+                    latency_s=latency, bucket=plan.bucket, log_probs=lp,
+                    trace_id=req.trace_id or None)
             req.resolve(result)
             observed.append((result.outcome, latency))
+            if spans is not None:
+                spans.append(make_span(req.trace_id, req.id, "resolve",
+                                       done, latency, bucket=plan.bucket,
+                                       outcome=result.outcome))
         self.metrics.observe_results(observed)
+        if spans is not None:
+            self.tracer.add(spans)
         self.metrics.observe_stage("resolve", self.clock() - done)
+        self._maybe_slo_check(done)
+
+    def _maybe_slo_check(self, now: float) -> None:
+        """At most once per second on the resolve path: trigger ONE
+        rate-limited profiler capture when p99 crosses the SLO."""
+        if (self.slo_p99_ms <= 0 or self.profiler is None
+                or now - self._slo_checked < 1.0):
+            return
+        self._slo_checked = now
+        p99 = self.metrics.latency_p99_ms()
+        if p99 > self.slo_p99_ms:
+            self.profiler.maybe_trigger(
+                f"serve p99 {p99:.1f}ms > SLO {self.slo_p99_ms:g}ms")
 
     def _fail_plan(self, plan: BatchPlan, exc: Exception) -> None:
         detail = f"{type(exc).__name__}: {exc}"
+        now = self.clock()
         for req in plan.requests:
             self._finish(req, ServeResult(
                 ok=False, request_id=req.id, error="error",
-                detail=detail, bucket=plan.bucket))
+                detail=detail, bucket=plan.bucket,
+                trace_id=req.trace_id or None))
+        if self.tracer is not None:
+            self.tracer.add([make_span(r.trace_id, r.id, "resolve", now,
+                                       0.0, bucket=plan.bucket,
+                                       outcome="error")
+                             for r in plan.requests])
 
     def _finish(self, req, result: ServeResult) -> None:
         req.resolve(result)
         self.metrics.observe_result(result.outcome, result.latency_s)
 
     # -- observability -------------------------------------------------------
+    def set_obs(self, enabled: bool) -> None:
+        """Swap full telemetry on/off consistently (metrics registry
+        mirroring + span tracing) with FRESH counters either way — the
+        ``bench_serve.py --obs`` A/B legs measure the overhead on the
+        same warmed loop."""
+        self.metrics = self.batcher.metrics = ServeMetrics(
+            observe_registry=enabled)
+        self.tracer = self.batcher.tracer = (
+            TraceRing(self._trace_ring_size or 4096) if enabled else None)
+
     def stats(self) -> dict:
         snap = self.metrics.snapshot()
         snap["queue"] = {"depth": self.batcher.depth,
@@ -302,7 +385,65 @@ class ServeLoop:
                          "inflight_window": self.inflight_window}
         snap["executor"] = self.executor.compile_summary()
         snap["warmup_s"] = self._warmup_s
+        snap["staging"] = self._staging.stats()
+        if self.tracer is not None:
+            snap["trace"] = {"capacity": self.tracer.capacity,
+                             "spans_held": len(self.tracer),
+                             "spans_recorded": self.tracer.recorded}
+        if self.profiler is not None:
+            snap["profiler"] = self.profiler.summary()
         return snap
+
+    def metrics_text(self) -> str:
+        """The Prometheus exposition behind ``GET /metrics``: this loop's
+        registry (request/batch/stage families, live-state gauges
+        refreshed here at scrape time) plus the process-wide default
+        registry (XLA compile counters from dasmtl/analysis/guards.py).
+        Metric catalog: docs/OBSERVABILITY.md."""
+        reg = self.metrics.registry
+        reg.gauge("dasmtl_serve_queue_depth",
+                  "Requests currently queued").set(self.batcher.depth)
+        reg.gauge("dasmtl_serve_inflight",
+                  "Batches dispatched but not yet collected"
+                  ).set(self.inflight_depth)
+        reg.gauge("dasmtl_serve_inflight_window",
+                  "Configured in-flight window").set(self.inflight_window)
+        reg.gauge("dasmtl_serve_draining",
+                  "1 while the server refuses new work (drain)"
+                  ).set(1.0 if self.batcher.draining else 0.0)
+        if self._warmup_s is not None:
+            reg.gauge("dasmtl_serve_warmup_seconds",
+                      "Wall seconds warmup compilation took"
+                      ).set(self._warmup_s)
+        self._staging.publish_metrics(reg, prefix="dasmtl_serve_staging")
+        summary = self.executor.compile_summary()
+        recompiles = reg.counter(
+            "dasmtl_serve_post_warmup_recompiles_total",
+            "Post-warmup XLA compilations per pool device (any nonzero "
+            "value is a bucket-ladder bug)", labelnames=("device",))
+        warmups = reg.counter(
+            "dasmtl_serve_warmup_compiles_total",
+            "Warmup XLA compilations per pool device",
+            labelnames=("device",))
+        per_device = summary.get("per_device") or [summary]
+        for member in per_device:
+            device = str(member.get("placement") or "default")
+            recompiles.set_total(member.get("post_warmup_compiles", 0),
+                                 (device,))
+            warmups.set_total(member.get("warmup_compiles", 0), (device,))
+        if self.tracer is not None:
+            reg.counter("dasmtl_serve_trace_spans_total",
+                        "Span records ever written to the trace ring"
+                        ).set_total(self.tracer.recorded)
+        if self.profiler is not None:
+            prof = self.profiler.summary()
+            reg.counter("dasmtl_obs_profile_captures_total",
+                        "Completed profiler captures"
+                        ).set_total(prof["captures"])
+            reg.counter("dasmtl_obs_profile_rate_limited_total",
+                        "Profiler triggers refused by the cooldown"
+                        ).set_total(prof["rate_limited"])
+        return render_prometheus(default_registry(), reg)
 
     def healthz(self) -> dict:
         return {
@@ -347,22 +488,52 @@ def _make_handler(loop: ServeLoop, request_timeout_s: float):
 
         def _reply(self, code: int, payload: dict) -> None:
             body = json.dumps(payload).encode()
+            self._reply_raw(code, body, "application/json")
+
+        def _reply_raw(self, code: int, body: bytes,
+                       content_type: str) -> None:
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
 
         def do_GET(self) -> None:  # noqa: N802 — http.server API shape
-            if self.path == "/healthz":
+            url = urlsplit(self.path)
+            if url.path == "/healthz":
                 h = loop.healthz()
                 self._reply(503 if h["status"] == "draining" else 200, h)
-            elif self.path == "/stats":
+            elif url.path == "/stats":
                 self._reply(200, loop.stats())
+            elif url.path == "/metrics":
+                # Prometheus text exposition (docs/OBSERVABILITY.md);
+                # /stats stays the JSON view of the same registry.
+                self._reply_raw(200, loop.metrics_text().encode(),
+                                "text/plain; version=0.0.4; charset=utf-8")
+            elif url.path == "/trace":
+                if loop.tracer is None:
+                    self._reply(404, {"error": "tracing disabled "
+                                               "(trace_ring=0)"})
+                    return
+                n = parse_qs(url.query).get("n", [None])[0]
+                body = loop.tracer.to_jsonl(int(n) if n else None)
+                self._reply_raw(200, body.encode(),
+                                "application/x-ndjson")
             else:
-                self._reply(404, {"error": f"unknown path {self.path}"})
+                self._reply(404, {"error": f"unknown path {url.path}"})
 
         def do_POST(self) -> None:  # noqa: N802 — http.server API shape
+            if self.path == "/profile":
+                if loop.profiler is None:
+                    self._reply(503, {"triggered": False,
+                                      "reason": "no profiler hook "
+                                                "configured"})
+                    return
+                path = loop.profiler.maybe_trigger("POST /profile")
+                self._reply(200, {"triggered": path is not None,
+                                  "capture_dir": path,
+                                  "profiler": loop.profiler.summary()})
+                return
             if self.path != "/infer":
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
@@ -400,7 +571,7 @@ def _make_handler(loop: ServeLoop, request_timeout_s: float):
                 "predictions": res.predictions, "error": res.error,
                 "detail": res.detail,
                 "latency_ms": round(res.latency_s * 1e3, 3),
-                "bucket": res.bucket}
+                "bucket": res.bucket, "trace_id": res.trace_id}
             if res.log_probs is not None:
                 payload["log_probs"] = res.log_probs
             self._reply(code, payload)

@@ -53,6 +53,7 @@ from dasmtl.data.device import DeviceDataset, resident_bytes, unwrap_source
 from dasmtl.data.pipeline import (BatchAssembler, BatchIterator, eval_batches,
                                   prefetch)
 from dasmtl.models.registry import ModelSpec
+from dasmtl.obs.heartbeat import Heartbeat, resolve_peak_flops
 from dasmtl.parallel.mesh import MeshPlan, shard_batch
 from dasmtl.train import metrics as host_metrics
 from dasmtl.train.checkpoint import CheckpointManager
@@ -207,6 +208,12 @@ class Trainer:
         # Runtime tracing-discipline guards (dasmtl/analysis/guards.py),
         # armed by fit() when cfg.tracing_guards is set.
         self.guards: Optional[StepGuards] = None
+        # Train heartbeat (dasmtl/obs/heartbeat.py), armed by fit() when
+        # cfg.obs_heartbeat_s > 0: fed at metric-window flushes (already
+        # host-synced there — the heartbeat never adds a device sync).
+        self._heartbeat: Optional[Heartbeat] = None
+        self._hb_h2d_s = 0.0  # cumulative seconds spent in _place
+        self._batch_sds = None  # first real batch's ShapeDtypeStructs
 
     def request_preempt(self) -> None:
         """Ask the running ``fit`` to stop at the next safe point and write a
@@ -222,10 +229,14 @@ class Trainer:
         """Host batch -> device arrays (sharded under a mesh).  Called from
         the prefetch worker thread, so the H2D copy of batch ``i+1`` overlaps
         step ``i``'s compute (the reference's per-step ``.cuda()`` copy sits
-        on the critical path, utils.py:350-353)."""
-        if self.mesh_plan is not None:
-            return shard_batch(self.mesh_plan, batch)
-        return jax.device_put(batch)
+        on the critical path, utils.py:350-353).  Timed (dispatch-side —
+        device_put is async, so this is enqueue cost, not transfer wall)
+        for the heartbeat's ``h2d_ms``."""
+        t0 = time.perf_counter()
+        placed = (shard_batch(self.mesh_plan, batch)
+                  if self.mesh_plan is not None else jax.device_put(batch))
+        self._hb_h2d_s += time.perf_counter() - t0
+        return placed
 
     def _log_jsonl(self, record: Dict[str, Any]) -> None:
         with open(self.jsonl_path, "a") as f:
@@ -435,6 +446,47 @@ class Trainer:
         return self.guards.step(n) if self.guards is not None \
             else nullcontext()
 
+    # -- heartbeat (dasmtl/obs/heartbeat.py) ---------------------------------
+    def _stash_batch_sds(self, batch) -> None:
+        """Remember the first real batch's shapes/dtypes — what the
+        analytic FLOP count traces the train step against (exactly the
+        executable a real step dispatches)."""
+        if self._batch_sds is None:
+            self._batch_sds = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                               for k, v in batch.items()}
+
+    def _analytic_step_flops(self) -> float:
+        """MXU FLOPs of ONE full-batch train step from the audit cost
+        model's analytic counter (a jaxpr trace of the PRODUCTION step —
+        no lowering, no execution; dasmtl/analysis/audit/analytic.py)."""
+        from dasmtl.analysis.audit.analytic import analytic_flops_of
+
+        if self._batch_sds is None:
+            raise RuntimeError("no batch seen yet — the heartbeat "
+                               "resolves FLOPs at first emission")
+        lr_sds = jax.ShapeDtypeStruct((), np.float32)
+        by_dtype = analytic_flops_of(self.train_step, self.state,
+                                     self._batch_sds, lr_sds)
+        return float(sum(by_dtype.values()))
+
+    def _arm_heartbeat(self) -> None:
+        peak, peak_source = resolve_peak_flops()
+        self._heartbeat = Heartbeat(
+            every_s=self.cfg.obs_heartbeat_s,
+            out_path=os.path.join(self.metrics_dir, "heartbeat.jsonl"),
+            batch_size=self.train_iter.batch_size,
+            flops_fn=self._analytic_step_flops,
+            peak_flops=peak, peak_source=peak_source,
+            stall_fn=lambda: (self._assembler.staging.stats()
+                              ["blocked_acquires"]
+                              if self._assembler is not None else 0),
+            h2d_fn=lambda: self._hb_h2d_s,
+            recompile_fn=lambda: (self.guards.post_warmup_compiles
+                                  if self.guards is not None else 0))
+        print(f"[heartbeat] armed: every {self.cfg.obs_heartbeat_s:g}s -> "
+              f"{self._heartbeat.out_path} (MFU vs peak {peak:.3g} "
+              f"FLOP/s, {peak_source}; docs/OBSERVABILITY.md)")
+
     def _train_epoch_device(self, epoch: int, lr: float) -> None:
         """One epoch on the device-resident path: the training set lives in
         HBM and each dispatch scans ``steps_per_dispatch`` fused train steps
@@ -450,6 +502,19 @@ class Trainer:
                   f"n={self._device_data.n}, "
                   f"{self._device_data.nbytes / 2**20:.1f} MiB, "
                   f"{self._dispatch_k()} steps/dispatch")
+        if self._heartbeat is not None and self._batch_sds is None:
+            # Scan-fused path: no host batch ever materializes — derive
+            # the per-step shapes from the resident data (the per-step
+            # math is identical to the per-step train_step's).
+            b = self.train_iter.batch_size
+            x = self._device_data.data["x"]
+            self._batch_sds = {
+                "x": jax.ShapeDtypeStruct((b,) + tuple(x.shape[1:]),
+                                          x.dtype),
+                "distance": jax.ShapeDtypeStruct((b,), np.int32),
+                "event": jax.ShapeDtypeStruct((b,), np.int32),
+                "weight": jax.ShapeDtypeStruct((b,), np.float32),
+            }
         idx, weight = self.train_iter.epoch_index_plan(epoch)
         steps = idx.shape[0]
         dispatch_k = self._dispatch_k()
@@ -530,6 +595,8 @@ class Trainer:
         cur = placed = None
         try:
             cur = next(stream, None)
+            if cur is not None and self._heartbeat is not None:
+                self._stash_batch_sds(cur.data)
             placed = self._place(cur.data) if cur is not None else None
             while cur is not None:
                 i += 1
@@ -609,6 +676,11 @@ class Trainer:
         msg += f" ({rec['examples_per_s']:.1f} ex/s)"
         print(msg)
         self._log_jsonl(rec)
+        if self._heartbeat is not None:
+            # Fed here because the window was just host-synced above —
+            # the heartbeat adds zero device syncs of its own.
+            self._heartbeat.observe(epoch=epoch, step=step_in_epoch,
+                                    samples=n, elapsed_s=elapsed)
 
     def fit(self) -> List[ValidationResult]:
         """Full training run: epochs 0..epoch_num-1 with periodic validation,
@@ -631,6 +703,8 @@ class Trainer:
             print(f"[guards] armed: warmup={warmup} steps, "
                   f"transfer={cfg.guard_transfer}, "
                   f"nan_check={cfg.guard_nan_check}")
+        if cfg.obs_heartbeat_s > 0 and self._heartbeat is None:
+            self._arm_heartbeat()
         if self._sanitizer is not None:
             div = self._divergence.summary()
             print("[sanitize] armed: per-step non-finite probe + checkify "
@@ -679,6 +753,12 @@ class Trainer:
                       f"{self._sanitizer.summary()} | divergence "
                       f"{self._divergence.summary()}")
         finally:
+            if self._heartbeat is not None:
+                # Flush pending accumulation: even a run shorter than the
+                # cadence leaves at least one heartbeat line.
+                self._heartbeat.finish(
+                    epoch=int(jax.device_get(self.state.epoch)),
+                    step=-1)
             if handler_installed:
                 # A C-installed prior handler reads back as None and can't be
                 # re-installed from Python; fall back to the default action so

@@ -138,6 +138,19 @@ def collect() -> dict:
         "precision": d.serve_precision,
     }
 
+    # Unified telemetry layer (dasmtl/obs/, docs/OBSERVABILITY.md): the
+    # resolved obs config — heartbeat cadence, latency buckets, trace
+    # ring, SLO/profiler knobs.
+    info["obs"] = {
+        "heartbeat_s": d.obs_heartbeat_s,
+        "latency_buckets_ms": list(d.obs_latency_buckets_ms),
+        "trace_ring": d.obs_trace_ring,
+        "slo_p99_ms": d.obs_slo_p99_ms,
+        "profile_dir": d.obs_profile_dir,
+        "profile_cooldown_s": d.obs_profile_cooldown_s,
+        "profile_duration_s": d.obs_profile_duration_s,
+    }
+
     # Tracing-discipline tooling (dasmtl.analysis): the registered lint
     # rules and the runtime-guard flag defaults, so "is the linter seeing
     # rule X" / "are guards on by default" is answerable from one page.
@@ -287,6 +300,15 @@ def main(argv=None) -> int:
     print("  serve defaults: " + ", ".join(
         f"{k}={v}" for k, v in info["serve_defaults"].items())
         + " (dasmtl-serve; docs/SERVING.md)")
+    ob = info["obs"]
+    print(f"  obs: heartbeat_s={ob['heartbeat_s']} "
+          f"trace_ring={ob['trace_ring']} "
+          f"slo_p99_ms={ob['slo_p99_ms']} "
+          f"profile_dir={ob['profile_dir']} "
+          f"(cooldown {ob['profile_cooldown_s']}s, "
+          f"duration {ob['profile_duration_s']}s; "
+          f"latency buckets {len(ob['latency_buckets_ms'])} x ms) "
+          "(dasmtl obs; docs/OBSERVABILITY.md)")
     ea = info.get("exported_artifact")
     if ea:
         head = (f"precision {ea['precision']}, artifact "

@@ -1,16 +1,11 @@
 """Summarize a captured jax.profiler trace: device-side step time, busy
 fraction, and the op-level time breakdown.
 
-This is the reconciliation step behind BASELINE.md's MFU figure (round-2
-verdict: MFU computed from ``cost_analysis`` FLOPs needs a device trace to
-corroborate it).  Reads the ``*.xplane.pb`` a ``scripts/capture_trace.py``
-run wrote, via :class:`jax.profiler.ProfileData` (no TensorBoard needed),
-and reports per device plane:
-
-- wall span of the traced region and total op busy time on the device,
-- steady-state step time (busy time / --steps),
-- the top ops by accumulated duration (convolutions vs everything else —
-  the conv share is the MXU-relevant fraction).
+Shim over :func:`dasmtl.obs.profiler.analyze_main` (same flags, same
+exit codes — incl. exit 2 with a message when this jax build ships no
+``jax.profiler.ProfileData`` xplane reader) — the logic moved into the
+package so it is importable and tested; ``dasmtl obs analyze`` is the
+first-class surface.
 
 Run:  python scripts/analyze_trace.py artifacts/trace_r03 [--steps 10]
 Emits one JSON line on stdout.
@@ -18,119 +13,20 @@ Emits one JSON line on stdout.
 
 from __future__ import annotations
 
-import argparse
-import glob
-import json
 import os
 import sys
-from collections import defaultdict
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def find_xplane(trace_dir: str) -> str:
-    hits = sorted(glob.glob(os.path.join(
-        trace_dir, "**", "*.xplane.pb"), recursive=True),
-        key=os.path.getmtime)
-    if not hits:
-        raise FileNotFoundError(f"no *.xplane.pb under {trace_dir}")
-    return hits[-1]
-
-
-def device_planes(profile):
-    """Planes of on-device activity (TPU/GPU/accelerator op streams)."""
-    out = []
-    for plane in profile.planes:
-        name = plane.name
-        if ("/device:" in name and "CPU" not in name) or "TPU" in name:
-            out.append(plane)
-    return out
-
-
-def _op_lines(plane):
-    """The event lines to sum.  Device planes nest hierarchy lines whose
-    events ENCLOSE the op events ("XLA Modules" spans its child "XLA Ops"),
-    so summing every line double-counts busy time by an integer factor —
-    prefer the op-level lines when the plane has them; host planes (one
-    line per thread, non-overlapping) sum everything."""
-    lines = list(plane.lines)
-    ops = [ln for ln in lines if "ops" in (ln.name or "").lower()]
-    return ops or lines
-
-
-def summarize_plane(plane, steps: int, top: int):
-    per_op = defaultdict(float)
-    span_start, span_end = None, 0.0
-    busy_ns = 0.0
-    used_lines = _op_lines(plane)
-    for line in used_lines:
-        for ev in line.events:
-            dur = float(ev.duration_ns)
-            busy_ns += dur
-            per_op[ev.name] += dur
-            start = float(ev.start_ns)
-            span_start = start if span_start is None else min(span_start,
-                                                             start)
-            span_end = max(span_end, start + dur)
-    if span_start is None:
-        return None
-    wall_ns = span_end - span_start
-    conv_ns = sum(v for k, v in per_op.items()
-                  if "conv" in k.lower() or "dot" in k.lower())
-    ranked = sorted(per_op.items(), key=lambda kv: -kv[1])[:top]
-    return {
-        "plane": plane.name,
-        "lines_summed": [ln.name for ln in used_lines],
-        "wall_ms": round(wall_ns / 1e6, 3),
-        "busy_ms": round(busy_ns / 1e6, 3),
-        "busy_fraction_of_wall": round(busy_ns / max(wall_ns, 1.0), 4),
-        "step_time_ms_busy": round(busy_ns / 1e6 / steps, 3),
-        "step_time_ms_wall": round(wall_ns / 1e6 / steps, 3),
-        "conv_dot_fraction_of_busy": round(conv_ns / max(busy_ns, 1.0), 4),
-        "top_ops_ms": {k: round(v / 1e6, 3) for k, v in ranked},
-    }
+# Re-exported so existing imports of the script module keep working
+# (tests/test_trace_tools.py exercises the plane-summing logic directly).
+from dasmtl.obs.profiler import (_op_lines, analyze_main,  # noqa: E402,F401
+                                 device_planes, find_xplane,
+                                 summarize_plane)
 
 
 def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("trace_dir", help="directory capture_trace.py wrote")
-    ap.add_argument("--steps", type=int, default=10,
-                    help="steps the trace covered (capture_trace --steps)")
-    ap.add_argument("--top", type=int, default=12)
-    ap.add_argument("--all_planes", action="store_true",
-                    help="summarize every plane (host threads included) — "
-                         "for smoke-testing on CPU-only traces")
-    args = ap.parse_args()
-
-    try:
-        from jax.profiler import ProfileData
-    except ImportError:
-        # Older jax builds (this container's 0.4.x) ship no xplane reader;
-        # say so explicitly instead of tracebacking — the capture itself is
-        # still valid and can be analyzed on a host with a newer jax.
-        print("analyze_trace: jax.profiler.ProfileData unavailable in this "
-              "jax build; re-run analysis with jax >= 0.5", file=sys.stderr)
-        return 2
-
-    path = find_xplane(args.trace_dir)
-    profile = ProfileData.from_file(path)
-    planes = (list(profile.planes) if args.all_planes
-              else device_planes(profile))
-    result = {
-        "metric": "trace_summary",
-        "xplane": os.path.relpath(path, args.trace_dir),
-        "n_device_planes": len(planes),
-        "devices": [],
-    }
-    for plane in planes:
-        summary = summarize_plane(plane, args.steps, args.top)
-        if summary:
-            result["devices"].append(summary)
-    if not result["devices"]:
-        print(f"no device-plane events found in {path} "
-              f"(planes: {[p.name for p in profile.planes]})",
-              file=sys.stderr)
-        return 1
-    print(json.dumps(result))
-    return 0
+    return analyze_main()
 
 
 if __name__ == "__main__":

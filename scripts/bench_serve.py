@@ -31,6 +31,14 @@ arithmetic win is an MXU property (bf16 2x, int8 4x peak rate); what
 this bench pins on CPU is that the presets cost nothing and the audit
 (AUD103/AUD108) pins that the shipped program really is the cheap one.
 
+``--obs both`` (the default) additionally measures the **telemetry
+overhead**: closed-loop req/s with full telemetry (metrics-registry
+mirroring + request-span tracing, dasmtl/obs/) vs telemetry off, as
+alternating pairs on the same warmed loop (median of 3 each) so
+shared-host drift cancels.  The ratio lands in BENCH_serve.json under
+``telemetry_overhead`` and the smoke asserts it stays >= 0.97 (the
+"full telemetry within 3%" budget of docs/OBSERVABILITY.md).
+
 Run:  python scripts/bench_serve.py [--requests 2000] [--sweep 0.5,1,1.5]
       python scripts/bench_serve.py --smoke     # CI: small + invariants
 """
@@ -186,6 +194,14 @@ def main() -> int:
                          "closed-loop + offered-load set each (the f32 "
                          "leg is the speedup baseline and must be "
                          "included first)")
+    ap.add_argument("--obs", type=str, default="both",
+                    choices=["both", "on", "off"],
+                    help="telemetry A/B: 'both' measures closed-loop "
+                         "req/s with full telemetry (registry mirror + "
+                         "span tracing) vs off on the SAME warmed loop "
+                         "(median of 3 alternating pairs) and records "
+                         "the overhead; 'on'/'off' just pin the mode "
+                         "for every leg")
     ap.add_argument("--out", type=str, default="BENCH_serve.json")
     ap.add_argument("--smoke", action="store_true",
                     help="CI mode: tiny model, few hundred requests, exit "
@@ -205,8 +221,47 @@ def main() -> int:
                   if p.strip()]
     rng = np.random.default_rng(0)
     legs = {}
+    telemetry = None
     for prec in precisions:
         loop, hw = _build_loop(args, precision=prec)
+        if args.obs == "both" and telemetry is None:
+            # Telemetry-overhead A/B on the FIRST leg's warmed loop.
+            # Shared-host throughput is noisy in BURSTS (second-scale
+            # CPU theft dwarfs any real overhead), so the estimator is
+            # noise-paired: each pair runs on and off back to back
+            # (drift hits both sides), pair order alternates (ordering
+            # bias cancels), and the reported ratio is the MEDIAN of
+            # per-pair ratios.  "on" = full telemetry (registry mirror
+            # + span tracing); "off" = the pre-obs bookkeeping only.
+            ab = {"on": [], "off": []}
+            pair_ratios = []
+            for rep in range(5):
+                order = ("on", "off") if rep % 2 == 0 else ("off", "on")
+                pair = {}
+                for mode in order:
+                    loop.set_obs(mode == "on")
+                    outcomes, wall = closed_loop(loop, hw, args.requests,
+                                                 args.clients, rng)
+                    ok = sum(1 for o in outcomes if o == "ok")
+                    pair[mode] = ok / wall
+                ab["on"].append(round(pair["on"], 1))
+                ab["off"].append(round(pair["off"], 1))
+                pair_ratios.append(round(pair["on"] / pair["off"], 4))
+            telemetry = {
+                "metric": "serve_telemetry_overhead",
+                "on_req_s": float(np.median(ab["on"])),
+                "off_req_s": float(np.median(ab["off"])),
+                "on_over_off": float(np.median(pair_ratios)),
+                "pair_ratios": pair_ratios,
+                "runs": ab,
+                "budget": "closed-loop req/s with full telemetry must "
+                          "stay within 3% of telemetry-off "
+                          "(median of paired on/off ratios)",
+            }
+            print(json.dumps(telemetry))
+            loop.set_obs(True)
+        elif args.obs == "off":
+            loop.set_obs(False)
         outcomes, wall = closed_loop(loop, hw, args.requests,
                                      args.clients, rng)
         closed = _report("closed_loop", loop, outcomes, wall,
@@ -248,6 +303,7 @@ def main() -> int:
            "max_wait_ms": args.max_wait_ms, "smoke": args.smoke,
            "inflight": args.inflight,
            "devices": base["closed_loop"]["devices"],
+           "telemetry_overhead": telemetry,
            "notes": ("closed_speedup_vs_f32 is req/s at equal (zero) "
                      "shed rate.  On CPU backends the reduced presets "
                      "measure ~1.0x by construction: XLA:CPU legalizes "
@@ -309,6 +365,11 @@ def main() -> int:
             if closed["shed_rate"] > 0:
                 failures.append(f"{prec}:closed: shed at closed loop "
                                 f"(speedups not at equal shed rate)")
+        if telemetry is not None and telemetry["on_over_off"] < 0.97:
+            failures.append(
+                f"telemetry overhead over budget: closed-loop req/s "
+                f"with obs on is {telemetry['on_over_off']:.3f}x of off "
+                f"(must be >= 0.97; runs {telemetry['runs']})")
         for f_ in failures:
             print(f"SMOKE FAIL: {f_}", file=sys.stderr)
         return 1 if failures else 0

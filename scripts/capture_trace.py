@@ -1,81 +1,24 @@
 """Capture a jax.profiler trace of the jitted MTL train step.
 
-Produces the trace artifact the round verdicts ask for: a real
-device-level profile of the flagship training step (the reference's whole
-inner loop, utils.py:346-374, as one XLA computation).  Output goes to
-``artifacts/trace_<round>/`` (TensorBoard-loadable; summarize it with
-``scripts/analyze_trace.py``).
+Shim over :func:`dasmtl.obs.profiler.capture_main` (same flags, same
+behavior) — the logic moved into the package so it is importable and
+tested; ``dasmtl obs capture`` is the first-class surface.
 
 Run:  python scripts/capture_trace.py [--batch 256] [--dtype bfloat16]
 """
 
 from __future__ import annotations
 
-import argparse
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=256)
-    ap.add_argument("--dtype", type=str, default="bfloat16")
-    ap.add_argument("--steps", type=int, default=10)
-    ap.add_argument("--out", type=str, default=None,
-                    help="trace output dir; defaults to "
-                         "artifacts/trace_<round> via the shared round "
-                         "resolver (scripts/roundinfo.py)")
-    args = ap.parse_args()
-    if args.out is None:
-        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-        from roundinfo import resolve_round
+    from dasmtl.obs.profiler import capture_main
 
-        args.out = f"artifacts/trace_{resolve_round()}"
-
-    import jax
-    import numpy as np
-
-    from dasmtl.config import Config
-    from dasmtl.main import build_state
-    from dasmtl.models.registry import get_model_spec
-    from dasmtl.train.steps import make_train_step
-
-    print(f"backend={jax.default_backend()} "
-          f"device={jax.devices()[0].device_kind}", file=sys.stderr)
-
-    cfg = Config(model="MTL", batch_size=args.batch, compute_dtype=args.dtype)
-    spec = get_model_spec(cfg.model)
-    state = build_state(cfg, spec)
-    train_step = make_train_step(spec)
-
-    rng = np.random.default_rng(0)
-    batch = jax.device_put({
-        "x": rng.normal(size=(args.batch, 100, 250, 1)).astype(np.float32),
-        "distance": rng.integers(0, 16, size=(args.batch,)).astype(np.int32),
-        "event": rng.integers(0, 2, size=(args.batch,)).astype(np.int32),
-        "weight": np.ones((args.batch,), np.float32),
-    })
-    lr = np.float32(1e-3)
-
-    # Warm up (compile) outside the trace so the trace holds steady-state steps.
-    for _ in range(3):
-        state, _ = train_step(state, batch, lr)
-    jax.block_until_ready(state.params)
-
-    os.makedirs(args.out, exist_ok=True)
-    jax.profiler.start_trace(args.out)
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        state, _ = train_step(state, batch, lr)
-    jax.block_until_ready(state.params)
-    elapsed = time.perf_counter() - t0
-    jax.profiler.stop_trace()
-    print(f"traced {args.steps} steps in {elapsed*1e3:.1f} ms "
-          f"({args.batch*args.steps/elapsed:.0f} samples/s) -> {args.out}")
-    return 0
+    return capture_main()
 
 
 if __name__ == "__main__":

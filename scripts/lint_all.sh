@@ -73,4 +73,15 @@ else
     echo "== bench_loader smoke skipped (DASMTL_LINT_SKIP_LOADER set)"
 fi
 
+# Observability smoke: guarded 2-epoch train with the heartbeat enabled —
+# every heartbeat line must parse against the committed schema and carry
+# a finite MFU in (0, 1] from the audit cost model (dasmtl/obs/,
+# docs/OBSERVABILITY.md).  CI's obs job runs the same leg.
+if [ "${DASMTL_LINT_SKIP_OBS:-}" = "" ]; then
+    echo "== obs_smoke (guarded train + heartbeat)"
+    python scripts/obs_smoke.py || rc=1
+else
+    echo "== obs smoke skipped (DASMTL_LINT_SKIP_OBS set)"
+fi
+
 exit $rc

@@ -1,0 +1,147 @@
+"""Observability smoke: a REAL guarded 2-epoch train run with the
+heartbeat enabled, then assert the telemetry holds its contract:
+
+- every heartbeat.jsonl line parses against the committed schema
+  (:data:`dasmtl.obs.heartbeat.HEARTBEAT_SCHEMA`);
+- at least one heartbeat was emitted (``finish`` guarantees this even
+  for runs shorter than the cadence);
+- the MFU estimate is present, finite, and in (0, 1] — derived from the
+  audit cost model's analytic FLOPs, never a placeholder;
+- samples/s and step wall time are positive and finite;
+- zero post-warmup recompiles (the run is guarded, so a violation would
+  have raised — the heartbeat must REPORT the same zero).
+
+CI runs this as the obs job; scripts/lint_all.sh runs it behind
+``DASMTL_LINT_SKIP_OBS=1``.  docs/OBSERVABILITY.md documents the schema.
+
+Run:  python scripts/obs_smoke.py [--epochs 2] [--hw 52x64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_smoke(epochs: int, hw, tmp: str, heartbeat_s: float) -> dict:
+    from dasmtl.config import Config
+    from dasmtl.data.pipeline import BatchIterator
+    from dasmtl.data.sources import ArraySource
+    from dasmtl.main import build_state
+    from dasmtl.models.registry import get_model_spec
+    from dasmtl.obs.heartbeat import parse_heartbeat
+    from dasmtl.train.loop import Trainer
+
+    rng = np.random.default_rng(0)
+    n = 48
+    x = rng.normal(size=(n,) + hw + (1,)).astype(np.float32)
+    src = ArraySource(x, rng.integers(0, 16, n), rng.integers(0, 2, n))
+    cfg = Config(model="MTL", batch_size=16, epoch_num=epochs,
+                 val_every=10, ckpt_every_epochs=0, log_every_steps=1,
+                 tracing_guards=True, guard_transfer="disallow",
+                 obs_heartbeat_s=heartbeat_s, output_savedir=tmp)
+    spec = get_model_spec(cfg.model)
+    state = build_state(cfg, spec, input_hw=hw)
+    run_dir = os.path.join(tmp, "obs_run")
+    os.makedirs(run_dir, exist_ok=True)
+    tr = Trainer(cfg, spec, state, BatchIterator(src, cfg.batch_size,
+                                                 seed=0), src, run_dir)
+    tr.fit()
+
+    failures = []
+    hb_path = os.path.join(run_dir, "metrics", "heartbeat.jsonl")
+    records = []
+    if not os.path.exists(hb_path):
+        failures.append(f"no heartbeat JSONL at {hb_path}")
+    else:
+        for i, line in enumerate(open(hb_path)):
+            try:
+                records.append(parse_heartbeat(line))
+            except ValueError as exc:
+                failures.append(f"heartbeat line {i} invalid: {exc}")
+    if not records:
+        failures.append("zero heartbeat records emitted over a "
+                        f"{epochs}-epoch run")
+    for i, rec in enumerate(records):
+        mfu = rec["mfu"]
+        if mfu is None or not math.isfinite(mfu) or not 0 < mfu <= 1:
+            failures.append(f"heartbeat {i}: MFU {mfu!r} not finite in "
+                            f"(0, 1]")
+        for key in ("samples_per_s", "samples_per_s_ewma",
+                    "step_wall_ms"):
+            v = rec[key]
+            if not (math.isfinite(v) and v > 0):
+                failures.append(f"heartbeat {i}: {key}={v!r} not "
+                                f"positive finite")
+        if rec["post_warmup_recompiles"] != 0:
+            failures.append(f"heartbeat {i}: reports "
+                            f"{rec['post_warmup_recompiles']} post-warmup"
+                            f" recompile(s) on a guarded clean run")
+        if rec["flops_per_step"] is None or rec["flops_per_step"] <= 0:
+            failures.append(f"heartbeat {i}: flops_per_step="
+                            f"{rec['flops_per_step']!r} — the analytic "
+                            f"cost model did not resolve")
+    guards = tr.guards.summary() if tr.guards else {}
+    return {"passed": not failures, "failures": failures,
+            "heartbeats": len(records), "records": records,
+            "train_guards": guards}
+
+
+def write_job_summary(report: dict, path=None) -> None:
+    path = path or os.environ.get("GITHUB_STEP_SUMMARY")
+    if not path or not report.get("records"):
+        return
+    last = report["records"][-1]
+    lines = [
+        "### obs smoke (guarded train + heartbeat)",
+        "",
+        f"- passed: **{report['passed']}**",
+        f"- heartbeats: {report['heartbeats']}",
+        f"- samples/s (last): {last['samples_per_s']} "
+        f"(ewma {last['samples_per_s_ewma']})",
+        f"- MFU (last): **{last['mfu']}** vs peak {last['peak_flops']:.3g}"
+        f" FLOP/s ({last['peak_source']})",
+        f"- step wall: {last['step_wall_ms']} ms; h2d {last['h2d_ms']} ms;"
+        f" stalls {last['loader_blocked_acquires']}; recompiles "
+        f"{last['post_warmup_recompiles']}",
+    ]
+    with open(path, "a") as f:
+        f.write("\n".join(lines) + "\n\n")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--hw", type=str, default="52x64")
+    ap.add_argument("--heartbeat_s", type=float, default=0.3)
+    ap.add_argument("--out", type=str, default=None,
+                    help="also write the report JSON here")
+    args = ap.parse_args()
+    hw = tuple(int(v) for v in args.hw.lower().split("x"))
+
+    with tempfile.TemporaryDirectory(prefix="dasmtl-obs-smoke-") as tmp:
+        report = run_smoke(args.epochs, hw, tmp, args.heartbeat_s)
+    for f in report["failures"]:
+        print(f"OBS SMOKE FAIL: {f}", file=sys.stderr)
+    last = report["records"][-1] if report["records"] else {}
+    print(json.dumps({"metric": "obs_smoke", "passed": report["passed"],
+                      "heartbeats": report["heartbeats"],
+                      "last": last,
+                      "train_guards": report["train_guards"]}))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+    write_job_summary(report)
+    return 0 if report["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

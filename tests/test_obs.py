@@ -1,0 +1,367 @@
+"""The unified telemetry layer (dasmtl/obs/): registry exactness and
+exposition format, trace-ID propagation through a fake-clock ServeLoop,
+heartbeat schema round-trip, and profiler-hook rate limiting."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from dasmtl.obs.heartbeat import Heartbeat, parse_heartbeat
+from dasmtl.obs.profiler import ProfilerHook
+from dasmtl.obs.registry import (MetricsRegistry, monotone_regressions,
+                                 parse_exposition)
+from dasmtl.obs.trace import SPAN_STAGES, TraceRing, make_span
+from dasmtl.serve.executor import InflightBatch
+from dasmtl.serve.selftest import REQUIRED_METRIC_FAMILIES
+from dasmtl.serve.server import ServeLoop, make_http_server
+
+HW = (4, 6)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeExecutor:
+    """Minimal executor-protocol stand-in (see tests/test_serve.py)."""
+
+    buckets = (1, 2, 4)
+    input_hw = HW
+    post_warmup_compiles = 0
+    device_name = "fake:0"
+
+    def warmup(self):
+        return 0.0
+
+    def dispatch(self, x):
+        flat = x.reshape(x.shape[0], -1)
+        bad = ~np.isfinite(flat).all(axis=1)
+        preds = {"event": (np.nan_to_num(flat).sum(axis=1) > 0)
+                 .astype(np.int64)}
+        return InflightBatch(outputs={"preds": preds, "bad": bad},
+                             bucket=int(x.shape[0]), executor=self)
+
+    def collect(self, handle, want_log_probs=False):
+        return handle.outputs["preds"], handle.outputs["bad"], None
+
+    def compile_summary(self):
+        return {"compiles": 3, "post_warmup_compiles": 0,
+                "placement": "fake:0", "warmup_compiles": 3}
+
+    def close(self):
+        pass
+
+
+def win(seed=0):
+    return np.random.default_rng(seed).normal(size=HW).astype(np.float32)
+
+
+# -- registry ------------------------------------------------------------------
+
+
+def test_counter_concurrent_increments_sum_exactly():
+    reg = MetricsRegistry()
+    c = reg.counter("hits_total", "h", labelnames=("who",))
+    n_threads, per_thread = 8, 5000
+
+    def worker(i):
+        for _ in range(per_thread):
+            c.inc(1, ("shared",))
+            c.inc(1, (f"t{i}",))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value(("shared",)) == n_threads * per_thread
+    for i in range(n_threads):
+        assert c.value((f"t{i}",)) == per_thread
+
+
+def test_histogram_bucket_boundaries_closed_upper():
+    """``le`` bounds are inclusive upper / exclusive lower: a value equal
+    to a bound counts in that bucket, epsilon above falls through."""
+    reg = MetricsRegistry()
+    h = reg.histogram("x_seconds", "x", buckets=(0.1, 0.5, 1.0))
+    h.observe(0.1)       # == bound: in le=0.1
+    h.observe(0.100001)  # just above: first lands in le=0.5
+    h.observe(0.5)
+    h.observe(1.0)
+    h.observe(5.0)       # +Inf only
+    s = parse_exposition(reg.render())["x_seconds"]["samples"]
+
+    def bucket(le):
+        return s[("x_seconds_bucket", (("le", le),))]
+
+    assert bucket("0.1") == 1
+    assert bucket("0.5") == 3   # cumulative: 0.1, 0.100001, 0.5
+    assert bucket("1") == 4
+    assert bucket("+Inf") == 5
+    assert s[("x_seconds_count", ())] == 5
+    assert s[("x_seconds_sum", ())] == pytest.approx(6.700001)
+
+
+def test_label_escaping_round_trips_through_exposition():
+    reg = MetricsRegistry()
+    ugly = 'a"b\\c\nd'
+    reg.counter("esc_total", "e", labelnames=("v",)).inc(2, (ugly,))
+    text = reg.render()
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+    fams = parse_exposition(text)
+    assert fams["esc_total"]["samples"][("esc_total", (("v", ugly),))] == 2
+
+
+def test_registry_get_or_create_and_conflicts():
+    reg = MetricsRegistry()
+    a = reg.counter("same_total", "x")
+    assert reg.counter("same_total", "x") is a
+    with pytest.raises(ValueError):
+        reg.gauge("same_total", "x")
+    with pytest.raises(ValueError):
+        reg.counter("same_total", "x", labelnames=("l",))
+    with pytest.raises(ValueError):
+        a.inc(-1)  # counters only go up
+    h = reg.histogram("hh", "x", buckets=(1, 2))
+    with pytest.raises(ValueError):
+        reg.histogram("hh", "x", buckets=(1, 2, 3))
+    assert reg.histogram("hh", "x", buckets=(1, 2)) is h
+
+
+def test_monotone_regression_detection():
+    reg = MetricsRegistry()
+    c = reg.counter("m_total", "m")
+    c.inc(5)
+    before = parse_exposition(reg.render())
+    c.inc(1)
+    after = parse_exposition(reg.render())
+    assert monotone_regressions(before, after) == []
+    # Reversed order = a decrease: must be reported.
+    assert monotone_regressions(after, before)
+
+
+# -- trace ring + propagation --------------------------------------------------
+
+
+def test_trace_ring_bounded_and_ordered():
+    ring = TraceRing(capacity=4)
+    for i in range(10):
+        ring.add([make_span(f"t{i}", i, "submit", float(i), 0.0)])
+    assert len(ring) == 4
+    assert ring.recorded == 10
+    ids = [s["trace_id"] for s in ring.snapshot()]
+    assert ids == ["t6", "t7", "t8", "t9"]
+    lines = ring.to_jsonl(2).strip().splitlines()
+    assert [json.loads(ln)["trace_id"] for ln in lines] == ["t8", "t9"]
+    with pytest.raises(ValueError):
+        make_span("t", 0, "warp", 0.0, 0.0)  # unknown stage
+
+
+def test_trace_id_propagates_end_to_end_fake_clock():
+    """One request through a fake-clock ServeLoop -> one complete span
+    chain (submit/queue/form/dispatch/collect/resolve), all carrying the
+    SAME trace id, resolve carrying the outcome, and the id echoed on
+    the caller's ServeResult."""
+    clock = FakeClock()
+    loop = ServeLoop(FakeExecutor(), max_wait_s=0.0, queue_depth=16,
+                     clock=clock).start()
+    try:
+        res = loop.submit(win() + 1.0, timeout=10.0)
+    finally:
+        loop.close()
+    assert res.ok and res.trace_id
+    chains = loop.tracer.chains()
+    assert list(chains) == [res.trace_id]
+    spans = chains[res.trace_id]
+    assert [s["stage"] for s in spans] == list(SPAN_STAGES)
+    assert all(s["trace_id"] == res.trace_id for s in spans)
+    assert spans[-1]["outcome"] == "ok"
+    assert all(s["bucket"] == 1 for s in spans[1:])
+    assert spans[3]["device"] == "fake:0"  # dispatch knows its placement
+
+
+def test_refused_request_chain_is_one_submit_span():
+    loop = ServeLoop(FakeExecutor(), max_wait_s=0.0, queue_depth=16).start()
+    loop.drain(timeout=10.0)
+    res = loop.submit(win(), timeout=5.0)
+    assert not res.ok and res.error == "closed" and res.trace_id
+    spans = loop.tracer.chains()[res.trace_id]
+    assert [s["stage"] for s in spans] == ["submit"]
+    assert spans[0]["outcome"] == "closed"
+    loop.close()
+
+
+def test_trace_ring_disabled():
+    loop = ServeLoop(FakeExecutor(), max_wait_s=0.0, queue_depth=16,
+                     trace_ring=0).start()
+    try:
+        res = loop.submit(win() + 1.0, timeout=10.0)
+    finally:
+        loop.close()
+    assert res.ok and res.trace_id is None
+    assert loop.tracer is None
+
+
+# -- /metrics over the loop and the HTTP front end -----------------------------
+
+
+def test_metrics_text_has_required_families_and_stays_monotone():
+    loop = ServeLoop(FakeExecutor(), max_wait_s=0.0, queue_depth=16).start()
+    try:
+        loop.submit(win() + 1.0, timeout=10.0)
+        first = parse_exposition(loop.metrics_text())
+        loop.submit(win(1) + 1.0, timeout=10.0)
+        second = parse_exposition(loop.metrics_text())
+    finally:
+        loop.close()
+    for fam in REQUIRED_METRIC_FAMILIES:
+        assert fam in second, f"missing family {fam}"
+    assert second["dasmtl_serve_request_latency_seconds"]["type"] \
+        == "histogram"
+    assert monotone_regressions(first, second) == []
+    key = ("dasmtl_serve_requests_total", (("outcome", "ok"),))
+    assert second["dasmtl_serve_requests_total"]["samples"][key] == 2
+    # Per-device recompile counter carries the executor's placement label.
+    rk = ("dasmtl_serve_post_warmup_recompiles_total",
+          (("device", "fake:0"),))
+    fam = second["dasmtl_serve_post_warmup_recompiles_total"]
+    assert fam["samples"][rk] == 0
+
+
+def test_http_metrics_trace_profile_endpoints():
+    import urllib.request
+
+    loop = ServeLoop(FakeExecutor(), max_wait_s=0.0, queue_depth=16).start()
+    httpd = make_http_server(loop, port=0)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    host, port = httpd.server_address[:2]
+    base = f"http://{host}:{port}"
+    try:
+        res = loop.submit(win() + 1.0, timeout=10.0)
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            fams = parse_exposition(r.read().decode())
+        assert "dasmtl_serve_requests_total" in fams
+        with urllib.request.urlopen(f"{base}/trace?n=3", timeout=10) as r:
+            assert r.headers["Content-Type"] == "application/x-ndjson"
+            spans = [json.loads(ln) for ln in r.read().decode().strip()
+                     .splitlines()]
+        assert len(spans) == 3
+        assert all(s["trace_id"] == res.trace_id for s in spans)
+        # POST /profile without a configured hook: a structured 503.
+        req = urllib.request.Request(f"{base}/profile", data=b"",
+                                     method="POST")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=10)
+        assert err.value.code == 503
+    finally:
+        httpd.shutdown()
+        t.join(timeout=10)
+        loop.close()
+
+
+# -- heartbeat -----------------------------------------------------------------
+
+
+def test_heartbeat_schema_round_trip(tmp_path):
+    out = tmp_path / "hb.jsonl"
+    clock = FakeClock()
+    hb = Heartbeat(every_s=1.0, out_path=str(out), batch_size=16,
+                   flops_fn=lambda: 1e9, peak_flops=1e11,
+                   peak_source="test", stall_fn=lambda: 3,
+                   h2d_fn=lambda: 0.25, recompile_fn=lambda: 0,
+                   clock=clock, printer=lambda *_: None)
+    assert hb.observe(epoch=0, step=0, samples=32, elapsed_s=0.4) is None
+    clock.advance(1.5)
+    rec = hb.observe(epoch=0, step=1, samples=32, elapsed_s=0.4)
+    assert rec is not None
+    # 64 samples / 0.8 s accumulated; steps = 4; rate = 4 GFLOP steps
+    # over 0.8 s against a 100 GFLOP/s peak.
+    assert rec["samples_per_s"] == pytest.approx(80.0)
+    assert rec["mfu"] == pytest.approx(0.05)
+    assert rec["loader_blocked_acquires"] == 3
+    assert rec["h2d_ms"] == pytest.approx(250.0)
+    line = out.read_text().strip()
+    assert parse_heartbeat(line) == json.loads(line)
+    # Schema violations are named, not silently accepted.
+    broken = dict(rec)
+    del broken["mfu"]
+    with pytest.raises(ValueError, match="mfu"):
+        parse_heartbeat(json.dumps(broken))
+    broken = dict(rec, samples_per_s="fast")
+    with pytest.raises(ValueError, match="samples_per_s"):
+        parse_heartbeat(json.dumps(broken))
+    with pytest.raises(ValueError, match="kind"):
+        parse_heartbeat(json.dumps(dict(rec, kind="train")))
+
+
+def test_heartbeat_finish_flushes_and_clamps(tmp_path):
+    clock = FakeClock()
+    # flops rate far above "peak": mfu clamps to 1.0, mfu_raw keeps the
+    # honest ratio.
+    hb = Heartbeat(every_s=100.0, out_path=str(tmp_path / "h.jsonl"),
+                   batch_size=8, flops_fn=lambda: 1e12, peak_flops=1e9,
+                   peak_source="test", clock=clock,
+                   printer=lambda *_: None)
+    assert hb.observe(epoch=0, step=0, samples=8, elapsed_s=1.0) is None
+    rec = hb.finish(epoch=0, step=0)
+    assert rec is not None and hb.emitted == 1
+    assert rec["mfu"] == 1.0 and rec["mfu_raw"] > 1.0
+    assert hb.finish(epoch=0, step=0) is None  # nothing pending
+
+
+def test_heartbeat_survives_flops_failure(tmp_path):
+    def boom():
+        raise RuntimeError("no cost model")
+
+    hb = Heartbeat(every_s=100.0, out_path=str(tmp_path / "h.jsonl"),
+                   batch_size=8, flops_fn=boom, peak_flops=1e9,
+                   peak_source="test", printer=lambda *_: None)
+    hb.observe(epoch=0, step=0, samples=8, elapsed_s=1.0)
+    rec = hb.finish(epoch=0, step=0)
+    assert rec["mfu"] is None and rec["flops_per_step"] is None
+    parse_heartbeat(json.dumps(rec))  # null MFU is schema-legal
+
+
+# -- profiler hook -------------------------------------------------------------
+
+
+def test_profiler_hook_rate_limits_to_one_capture(tmp_path):
+    clock = FakeClock()
+    captured = []
+    hook = ProfilerHook(str(tmp_path), cooldown_s=60.0, duration_s=0.0,
+                        clock=clock,
+                        capture_fn=lambda p, d: captured.append(p))
+    assert hook.maybe_trigger("first") is not None
+    assert hook.wait(10.0)
+    for _ in range(5):
+        assert hook.maybe_trigger("burst") is None
+    clock.advance(61.0)
+    assert hook.maybe_trigger("after cooldown") is not None
+    assert hook.wait(10.0)
+    assert hook.captures == 2 and len(captured) == 2
+    assert hook.rate_limited == 5
+
+
+def test_profiler_hook_clean_skip_when_capture_unavailable(tmp_path):
+    def unavailable(_p, _d):
+        raise RuntimeError("no profiler in this build")
+
+    hook = ProfilerHook(str(tmp_path), cooldown_s=0.0, duration_s=0.0,
+                        capture_fn=unavailable)
+    hook.maybe_trigger("slo")
+    assert hook.wait(10.0)
+    assert hook.captures == 0
+    assert len(hook.skips) == 1
+    assert "no profiler in this build" in hook.skips[0]
