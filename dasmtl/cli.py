@@ -53,6 +53,19 @@ def serve_main(argv=None) -> int:
     return main(argv)
 
 
+def router_main(argv=None) -> int:
+    """``dasmtl-router`` — the scale-out serving tier (dasmtl/serve/
+    router.py): least-outstanding placement over N dasmtl-serve
+    replicas, bounded retry on shed/failure, aggregated /metrics, and
+    blue/green rollout from the artifact registry (docs/SERVING.md
+    'Router tier & blue/green rollout')."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    apply_device_flag(argv)
+    from dasmtl.serve.router import main
+
+    return main(argv)
+
+
 def lint_main(argv=None) -> int:
     """``dasmtl-lint`` — the JAX-aware tracing-discipline linter
     (dasmtl/analysis/lint.py; rules in docs/STATIC_ANALYSIS.md).  Pure AST
@@ -122,6 +135,8 @@ _SUBCOMMANDS = {
     "stream": (stream_main, "streaming inference (dasmtl-stream)"),
     "export": (export_main, "export a serving artifact (dasmtl-export)"),
     "serve": (serve_main, "online inference server (dasmtl-serve)"),
+    "router": (router_main, "replica router tier: scale-out serving + "
+                            "blue/green rollout (dasmtl-router)"),
     "doctor": (doctor_main, "environment diagnostics (dasmtl-doctor)"),
     "lint": (lint_main, "JAX-aware AST linter (dasmtl-lint)"),
     "audit": (audit_main, "compile-time HLO/cost auditor (dasmtl-audit)"),

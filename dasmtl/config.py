@@ -174,6 +174,16 @@ class Config:
     serve_inflight: int = 2
     serve_devices: int = -1
     serve_shard_largest: bool = False
+    # With shard_largest under jax.distributed: span the shard mesh over
+    # EVERY process's devices (jax.devices() is global multi-controller)
+    # instead of only the local ones — one largest-bucket batch then
+    # shards across the whole pool, hosts included (mesh.serve_shard_plan).
+    serve_shard_multihost: bool = False
+    # Versioned artifact registry directory (dasmtl.export.ArtifactRegistry;
+    # None = not configured): dasmtl-export --registry publishes into it,
+    # dasmtl-serve --registry serves from it, and the router tier's
+    # blue/green rollouts resolve versions against it.
+    serve_registry_dir: Optional[str] = None
     # Serving precision preset (docs/SERVING.md "Precision presets"):
     # f32 = the reference forward; bf16 = params cast once at load,
     # bf16 activations, f32 decode tail; int8 = post-training per-channel
@@ -183,6 +193,29 @@ class Config:
     # (`dasmtl-serve --parity-check`, docs/PARITY.md) and, for exported
     # artifacts, match the artifact header's recorded precision.
     serve_precision: str = "f32"  # f32 | bf16 | int8
+
+    # ---- replica router tier (dasmtl/serve/router.py, docs/SERVING.md
+    # "Router tier & blue/green rollout") ----
+    # dasmtl-router load-balances POST /infer over router_replicas
+    # dasmtl-serve processes: least-outstanding-requests placement,
+    # router_retry_budget bounded re-placements per request on
+    # shed/closed/transport failure (each on a replica not yet tried),
+    # /readyz probes every router_probe_interval_s with exponential
+    # backoff (capped at router_probe_backoff_max_s) for failing
+    # replicas, and replica-by-replica blue/green rollout
+    # (router_swap_policy "drain" cordons + waits for outstanding
+    # requests before each swap; "hot" swaps in place — the in-process
+    # flip is atomic either way).
+    router_replicas: int = 2
+    router_host: str = "127.0.0.1"
+    router_port: int = 8320
+    # Fixed replica ports, one per replica (empty = ephemeral: each
+    # spawned replica binds port 0 and reports through --port_file).
+    router_replica_ports: tuple = ()
+    router_retry_budget: int = 1
+    router_probe_interval_s: float = 1.0
+    router_probe_backoff_max_s: float = 30.0
+    router_swap_policy: str = "drain"  # drain | hot
 
     # ---- observability (dasmtl/obs/, docs/OBSERVABILITY.md) ----
     # Train heartbeat cadence in seconds (0 = off): periodic structured
@@ -272,6 +305,35 @@ class Config:
             raise ValueError(
                 f"unknown serve_precision {self.serve_precision!r}; "
                 f"expected f32 | bf16 | int8")
+        if self.router_replicas < 1:
+            raise ValueError("router_replicas must be >= 1")
+        ports = tuple(int(v) for v in self.router_replica_ports)
+        if ports:
+            if len(ports) != self.router_replicas:
+                raise ValueError(
+                    f"router_replica_ports holds {len(ports)} port(s) "
+                    f"for router_replicas={self.router_replicas} — give "
+                    f"one per replica, or none for ephemeral ports")
+            if len(set(ports)) != len(ports) or min(ports) < 1:
+                raise ValueError(
+                    f"router_replica_ports must be distinct positive "
+                    f"ports, got {self.router_replica_ports!r}")
+        self.router_replica_ports = ports
+        if self.router_retry_budget < 0:
+            raise ValueError("router_retry_budget must be >= 0 "
+                             "(0 = never re-place a request)")
+        if self.router_probe_interval_s <= 0:
+            raise ValueError("router_probe_interval_s must be > 0")
+        if self.router_probe_backoff_max_s < self.router_probe_interval_s:
+            raise ValueError(
+                f"router_probe_backoff_max_s "
+                f"({self.router_probe_backoff_max_s}) must be >= "
+                f"router_probe_interval_s "
+                f"({self.router_probe_interval_s})")
+        if self.router_swap_policy not in ("drain", "hot"):
+            raise ValueError(
+                f"unknown router_swap_policy "
+                f"{self.router_swap_policy!r}; expected drain | hot")
         if self.obs_heartbeat_s < 0:
             raise ValueError("obs_heartbeat_s must be >= 0 (0 = off)")
         lat = tuple(float(b) for b in self.obs_latency_buckets_ms)
@@ -578,6 +640,17 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
                    default=d.serve_shard_largest,
                    help="run largest-bucket serve batches mesh-sharded "
                         "over the whole pool instead of on one device")
+    p.add_argument("--serve_shard_multihost", action=_CompatBoolAction,
+                   default=d.serve_shard_multihost,
+                   help="with serve_shard_largest under jax.distributed: "
+                        "span the shard mesh over every process's "
+                        "devices, not just local ones")
+    p.add_argument("--serve_registry_dir", type=str,
+                   default=d.serve_registry_dir, metavar="DIR",
+                   help="versioned serving-artifact registry directory "
+                        "(dasmtl-export --registry publishes, "
+                        "dasmtl-serve --registry serves, router "
+                        "rollouts resolve versions here)")
     p.add_argument("--serve_precision", type=str,
                    default=d.serve_precision,
                    choices=["f32", "bf16", "int8"],
@@ -586,6 +659,34 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
                         "conv/dense kernels per-channel (f32 decode tail "
                         "either way); gated by dasmtl-serve "
                         "--parity-check (docs/SERVING.md)")
+    # Replica-router block (dasmtl/serve/router.py, docs/SERVING.md
+    # "Router tier") — dasmtl-router carries first-class flags; these
+    # keep the config.json/CLI-parity invariant so a run's config
+    # records its serving-tier geometry too.
+    p.add_argument("--router_replicas", type=int, default=d.router_replicas,
+                   help="replica processes behind dasmtl-router")
+    p.add_argument("--router_host", type=str, default=d.router_host)
+    p.add_argument("--router_port", type=int, default=d.router_port)
+    p.add_argument("--router_replica_ports", type=_parse_bucket_list,
+                   default=d.router_replica_ports, metavar="P1,P2,...",
+                   help="fixed replica ports, one per replica (empty = "
+                        "ephemeral via --port_file)")
+    p.add_argument("--router_retry_budget", type=int,
+                   default=d.router_retry_budget,
+                   help="bounded re-placements per routed request on "
+                        "shed/closed/transport failure")
+    p.add_argument("--router_probe_interval_s", type=float,
+                   default=d.router_probe_interval_s,
+                   help="replica /readyz probe cadence (seconds)")
+    p.add_argument("--router_probe_backoff_max_s", type=float,
+                   default=d.router_probe_backoff_max_s,
+                   help="cap on the exponential re-probe backoff of a "
+                        "failing replica")
+    p.add_argument("--router_swap_policy", type=str,
+                   default=d.router_swap_policy,
+                   choices=["drain", "hot"],
+                   help="blue/green rollout default: cordon+drain each "
+                        "replica before its swap, or swap hot in place")
     # Observability block (dasmtl/obs/, docs/OBSERVABILITY.md) — the
     # serve CLI carries first-class --trace_ring/--slo_p99_ms flags;
     # these keep the config.json/CLI-parity invariant for training runs.

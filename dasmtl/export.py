@@ -40,9 +40,11 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import struct
 import sys
-from typing import Callable, Tuple
+import tempfile
+from typing import Callable, List, Optional, Tuple
 
 #: Container magic of versioned artifacts; a file not starting with this
 #: is a legacy bare ``jax.export`` blob.
@@ -186,13 +188,14 @@ def pack_artifact(payload: bytes, header: dict) -> bytes:
     return ARTIFACT_MAGIC + struct.pack("<I", len(head)) + head + payload
 
 
-def read_artifact(path: str) -> Tuple[dict, bytes]:
-    """``(header, payload)`` of an artifact file.  Legacy bare blobs (no
-    container magic) return the payload unchanged under a synthesized
-    ``{"artifact_version": 0, "precision": "f32"}`` header — every
-    pre-versioning artifact was an f32 export."""
-    with open(path, "rb") as f:
-        blob = f.read()
+def split_artifact(blob: bytes, origin: str = "<bytes>"
+                   ) -> Tuple[dict, bytes]:
+    """``(header, payload)`` of in-memory artifact bytes — the parsing
+    half of :func:`read_artifact`, shared with the registry (which
+    validates blobs BEFORE committing them to a version slot).  Legacy
+    bare blobs (no container magic) return the payload unchanged under a
+    synthesized ``{"artifact_version": 0, "precision": "f32"}`` header —
+    every pre-versioning artifact was an f32 export."""
     if not blob.startswith(ARTIFACT_MAGIC):
         return {"artifact_version": 0, "precision": "f32"}, blob
     off = len(ARTIFACT_MAGIC)
@@ -201,10 +204,18 @@ def read_artifact(path: str) -> Tuple[dict, bytes]:
     try:
         header = json.loads(blob[off:off + n].decode("utf-8"))
     except (UnicodeDecodeError, json.JSONDecodeError) as exc:
-        raise ValueError(f"corrupt artifact header in {path}: {exc}") \
+        raise ValueError(f"corrupt artifact header in {origin}: {exc}") \
             from None
-    _validate_header(header, path)
+    _validate_header(header, origin)
     return header, blob[off + n:]
+
+
+def read_artifact(path: str) -> Tuple[dict, bytes]:
+    """``(header, payload)`` of an artifact file (see
+    :func:`split_artifact` for the container/legacy semantics)."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    return split_artifact(blob, origin=path)
 
 
 def _validate_header(header: dict, path: str) -> None:
@@ -277,6 +288,134 @@ def load_exported(path: str) -> Callable:
     return deserialize_exported(path).call
 
 
+# -- versioned artifact registry ----------------------------------------------
+
+#: Registry entry filename: zero-padded monotone version, then the
+#: header's model/precision repeated for human listing (the header is
+#: the source of truth — the name only orders versions).
+_REGISTRY_RE = re.compile(r"^v(\d{4,})-[A-Za-z0-9_.-]+\.stablehlo$")
+
+
+class ArtifactRegistry:
+    """A directory of versioned serving artifacts — the single source of
+    compiled forwards shared by export, serving, and the router tier's
+    blue/green rollouts.
+
+    Layout is deliberately dumb: one ``v0007-<model>-<precision>
+    .stablehlo`` file per published version, no index file — the
+    container header inside each artifact (:func:`read_artifact`) carries
+    the truth, so the registry survives manual copies, rsync, and
+    partial checkouts.  Versions are monotone ints assigned at
+    ``publish`` (max existing + 1); publishing writes to a temp file and
+    renames, so a reader never sees a torn artifact.
+
+    Consumers resolve ``"latest"`` or an explicit version to a path
+    (``dasmtl-serve --registry DIR --registry_version 7``), and a
+    replica's ``POST /swap {"version": ...}`` loads its blue executor
+    from here.  ``dasmtl doctor --registry DIR`` lists what is
+    available.
+    """
+
+    def __init__(self, root: str):
+        self.root = str(root)
+
+    def versions(self) -> List[dict]:
+        """Every well-formed entry, ascending by version: ``{"version",
+        "path", "file", "model", "precision", "input_hw",
+        "artifact_version"}``.  Files that do not match the naming
+        convention are ignored (the dir may hold notes/licenses); a
+        matching file with an unreadable header is reported as a
+        ``"corrupt"`` entry rather than hidden — version skew and torn
+        copies must be visible, not silently skipped."""
+        try:
+            names = sorted(os.listdir(self.root))
+        except FileNotFoundError:
+            return []
+        out = []
+        for name in names:
+            m = _REGISTRY_RE.match(name)
+            if not m:
+                continue
+            path = os.path.join(self.root, name)
+            entry = {"version": int(m.group(1)), "path": path,
+                     "file": name}
+            try:
+                header = artifact_header(path)
+                entry.update(
+                    model=header.get("model"),
+                    precision=header.get("precision", "f32"),
+                    input_hw=header.get("input_hw"),
+                    artifact_version=header.get("artifact_version", 0))
+            except (OSError, ValueError) as exc:
+                entry["corrupt"] = str(exc)
+            out.append(entry)
+        out.sort(key=lambda e: e["version"])
+        return out
+
+    def latest(self) -> Optional[dict]:
+        good = [e for e in self.versions() if "corrupt" not in e]
+        return good[-1] if good else None
+
+    def resolve(self, version=None) -> dict:
+        """The entry for ``version`` (int, numeric string, ``"latest"``
+        or None = latest).  Raises ``ValueError`` with an operational
+        message naming what IS available — a registry miss is a rollout
+        error an operator has to act on, not a stack trace."""
+        entries = [e for e in self.versions() if "corrupt" not in e]
+        have = ", ".join(f"v{e['version']}" for e in entries) or "none"
+        if version in (None, "latest"):
+            if not entries:
+                raise ValueError(
+                    f"artifact registry {self.root} holds no readable "
+                    f"versions — publish one with dasmtl-export "
+                    f"--registry {self.root}")
+            return entries[-1]
+        try:
+            want = int(version)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"bad registry version {version!r} (an int or "
+                f"'latest'); available: {have}") from None
+        for e in entries:
+            if e["version"] == want:
+                return e
+        raise ValueError(
+            f"artifact registry {self.root} has no version {want}; "
+            f"available: {have}")
+
+    def publish(self, blob: bytes) -> dict:
+        """Commit artifact bytes as the next version; returns its entry.
+        The blob is parsed/validated FIRST (a corrupt artifact must
+        never occupy a version slot), then written via temp-file +
+        rename so concurrent readers see old-or-new, never torn."""
+        header, _ = split_artifact(blob, origin=f"publish->{self.root}")
+        existing = self.versions()
+        version = (existing[-1]["version"] + 1) if existing else 1
+        name = (f"v{version:04d}-{header.get('model', 'model')}-"
+                f"{header.get('precision', 'f32')}.stablehlo")
+        os.makedirs(self.root, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, os.path.join(self.root, name))
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return {"version": version, "path": os.path.join(self.root, name),
+                "file": name, "model": header.get("model"),
+                "precision": header.get("precision", "f32"),
+                "input_hw": header.get("input_hw"),
+                "artifact_version": header.get("artifact_version", 0)}
+
+    def publish_file(self, path: str) -> dict:
+        with open(path, "rb") as f:
+            return self.publish(f.read())
+
+
 # -- CLI ----------------------------------------------------------------------
 
 
@@ -290,8 +429,13 @@ def main(argv=None) -> int:
     ap.add_argument("--model_path", type=str, required=True,
                     help="checkpoint dir (step_*/best) to restore weights "
                          "from, like test.py --model_path")
-    ap.add_argument("--out", type=str, required=True,
+    ap.add_argument("--out", type=str, default=None,
                     help="output file (suggested suffix: .stablehlo)")
+    ap.add_argument("--registry", type=str, default=None, metavar="DIR",
+                    help="also/instead publish into a versioned artifact "
+                         "registry directory (next monotone version; the "
+                         "serving tier's blue/green rollouts load from "
+                         "here — docs/SERVING.md 'Router tier')")
     ap.add_argument("--device", type=str, default="auto",
                     choices=("auto", "tpu", "cpu"),
                     help="platform to trace on (the artifact itself is "
@@ -306,6 +450,8 @@ def main(argv=None) -> int:
                          "int8 weights + f32 scales; decode tail f32 "
                          "always — docs/SERVING.md 'Precision presets')")
     args = ap.parse_args(argv)
+    if not args.out and not args.registry:
+        ap.error("nowhere to write: give --out PATH and/or --registry DIR")
 
     from dasmtl.utils.platform import apply_device
 
@@ -323,12 +469,19 @@ def main(argv=None) -> int:
     print(f"restored weights from {args.model_path}", file=sys.stderr)
 
     blob = export_infer(spec, state, precision=args.precision)
-    os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
-    with open(args.out, "wb") as f:
-        f.write(blob)
-    print(f"exported {args.model} inference ({len(blob)/1e6:.2f} MB, "
-          f"precision {args.precision}, artifact v{ARTIFACT_VERSION}, "
-          f"symbolic batch, platforms cpu+tpu+axon) -> {args.out}")
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "wb") as f:
+            f.write(blob)
+        print(f"exported {args.model} inference ({len(blob)/1e6:.2f} MB, "
+              f"precision {args.precision}, artifact v{ARTIFACT_VERSION}, "
+              f"symbolic batch, platforms cpu+tpu+axon) -> {args.out}")
+    if args.registry:
+        entry = ArtifactRegistry(args.registry).publish(blob)
+        print(f"published {args.model} inference as registry "
+              f"v{entry['version']} (precision {entry['precision']}) "
+              f"-> {entry['path']}")
     return 0
 
 

@@ -74,6 +74,23 @@ def replicated_sharding(plan: MeshPlan) -> NamedSharding:
     return NamedSharding(plan.mesh, P())
 
 
+def serve_shard_plan(devices: Optional[Sequence[jax.Device]] = None,
+                     multihost: bool = False) -> MeshPlan:
+    """The serving pool's dp-only mesh for ``serve_shard_largest`` —
+    generalized beyond local devices: with ``multihost`` (and
+    ``jax.distributed`` initialized) the plan spans EVERY process's
+    devices (``jax.devices()`` is the global list in multi-controller
+    JAX), so one largest-bucket batch shards across the whole serving
+    pool, hosts included — ICI within a slice, DCN across, exactly like
+    the training mesh.  Single-process, global == local and this
+    degrades to the PR 5 behavior.  ``devices`` (e.g. the pool's member
+    subset) overrides the discovery entirely."""
+    if devices is None:
+        devices = jax.devices() if multihost else jax.local_devices()
+    devices = list(devices)
+    return create_mesh(dp=len(devices), sp=1, devices=devices)
+
+
 def infer_batch_sharding(plan: MeshPlan) -> NamedSharding:
     """Layout of one ``(bucket, h, w, 1)`` inference batch over the dp
     axis — what the serving executor pool uses for its largest bucket

@@ -21,6 +21,12 @@ deployment layer (docs/SERVING.md):
   unified telemetry registry (:mod:`dasmtl.obs`) behind ``GET /metrics``,
   with per-request span tracing at ``GET /trace`` and SLO-triggered
   profiler capture (docs/OBSERVABILITY.md);
+- :mod:`~dasmtl.serve.replica` + :mod:`~dasmtl.serve.router` — the
+  scale-out tier (``dasmtl-router``): least-outstanding-requests
+  placement over N replica processes speaking the shed/closed/readyz
+  contract, bounded retry, eviction + re-probe backoff, aggregated
+  ``/metrics``, and replica-by-replica blue/green rollout against the
+  versioned artifact registry (:class:`dasmtl.export.ArtifactRegistry`);
 - :mod:`~dasmtl.serve.parity` — the precision parity gate: a reduced
   serving preset (``serve_precision`` bf16/int8,
   :mod:`dasmtl.models.precision`) vs the f32 reference over a seeded
@@ -46,6 +52,10 @@ from dasmtl.serve.batcher import (BatchPlan, MicroBatcher, StagingBuffers,
 from dasmtl.serve.executor import ExecutorPool, InferExecutor, InflightBatch
 from dasmtl.serve.metrics import ServeMetrics
 from dasmtl.serve.queue import QueueClosed, Request, RequestQueue, ServeResult
+from dasmtl.serve.replica import (HttpTransport, ReplicaHandle,
+                                  ReplicaProcess, TransportError)
+from dasmtl.serve.router import (Router, RouterCore, aggregate_expositions,
+                                 make_router_http_server)
 from dasmtl.serve.server import (ServeLoop, install_signal_handlers,
                                  make_http_server)
 
@@ -53,5 +63,8 @@ __all__ = [
     "BatchPlan", "MicroBatcher", "StagingBuffers", "choose_bucket",
     "ExecutorPool", "InferExecutor", "InflightBatch",
     "ServeMetrics", "QueueClosed", "Request", "RequestQueue", "ServeResult",
+    "HttpTransport", "ReplicaHandle", "ReplicaProcess", "TransportError",
+    "Router", "RouterCore", "aggregate_expositions",
+    "make_router_http_server",
     "ServeLoop", "install_signal_handlers", "make_http_server",
 ]

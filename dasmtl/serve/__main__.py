@@ -3,10 +3,14 @@ surface as the installed ``dasmtl-serve`` console script and
 ``dasmtl serve``).
 
 Serve a StableHLO artifact (``--exported``, the deployment path: no
-framework rebuild, weights ride inside the file) or a checkpoint
-(``--model_path``); fire requests at ``POST /infer``; SIGTERM drains
-gracefully (in-flight batches finish, new work gets an explicit
-``closed``).  ``--selftest`` runs the in-process smoke instead — the CI
+framework rebuild, weights ride inside the file), a versioned artifact
+registry (``--registry DIR [--registry_version N|latest]`` — the
+blue/green rollout source; ``POST /swap`` re-resolves here), or a
+checkpoint (``--model_path``); fire requests at ``POST /infer``;
+``GET /readyz`` is 503 until warmup compiled every bucket (the HTTP
+front end binds BEFORE warmup, so liveness answers while buckets
+compile); SIGTERM drains gracefully (in-flight batches finish, new work
+gets an explicit ``closed``).  ``--selftest`` runs the in-process smoke instead — the CI
 serve job's entry point — and ``--parity-check`` runs the precision
 parity gate (reduced preset vs f32 reference, ints >= the committed
 threshold, log-probs within tolerance, NaN rejection identical) and can
@@ -37,6 +41,15 @@ def main(argv=None) -> int:
                      help="serve seed-deterministic fresh-init weights "
                           "(identical compute to a checkpoint; the "
                           "bench/CI path when no trained weights exist)")
+    src.add_argument("--registry", type=str, default=d.serve_registry_dir,
+                     metavar="DIR",
+                     help="serve from a versioned artifact registry "
+                          "(dasmtl-export --registry publishes into one); "
+                          "POST /swap re-resolves here for blue/green "
+                          "rollouts — docs/SERVING.md 'Router tier'")
+    p.add_argument("--registry_version", type=str, default="latest",
+                   help="registry version to load at startup (an int or "
+                        "'latest'); POST /swap may name its own")
     p.add_argument("--model", type=str, default="MTL",
                    help="model family (CSV columns / decode; must match "
                         "the artifact's family when --exported)")
@@ -59,6 +72,10 @@ def main(argv=None) -> int:
                         "(default: 90%% of --queue_depth)")
     p.add_argument("--host", type=str, default=d.serve_host)
     p.add_argument("--port", type=int, default=d.serve_port)
+    p.add_argument("--port_file", type=str, default=None, metavar="PATH",
+                   help="write the bound port here once the front end is "
+                        "listening (--port 0 = ephemeral; this is how a "
+                        "replica supervisor learns the address)")
     p.add_argument("--inflight", type=int, default=d.serve_inflight,
                    help="pipeline depth: batches dispatched but not yet "
                         "collected (>= 2 overlaps batch assembly with "
@@ -72,6 +89,12 @@ def main(argv=None) -> int:
                    help="run largest-bucket batches mesh-sharded over the "
                         "whole pool (dp NamedSharding) instead of on one "
                         "device")
+    p.add_argument("--shard_multihost", action="store_true",
+                   default=d.serve_shard_multihost,
+                   help="with --shard_largest under jax.distributed: span "
+                        "the shard mesh over EVERY process's devices "
+                        "(jax.devices()) instead of only local ones "
+                        "(dasmtl/parallel/mesh.py serve_shard_plan)")
     p.add_argument("--precision", type=str, default=d.serve_precision,
                    choices=["f32", "bf16", "int8"],
                    help="serving precision preset (docs/SERVING.md "
@@ -179,10 +202,10 @@ def main(argv=None) -> int:
         return 0 if all(r.passed for r in reports) else 1
 
     n_sources = sum(1 for v in (args.exported, args.model_path,
-                                args.fresh_init) if v)
+                                args.fresh_init, args.registry) if v)
     if n_sources != 1:
         p.error("exactly one of --exported / --model_path / --fresh_init "
-                "is required (or --selftest)")
+                "/ --registry is required (or --selftest)")
     try:
         buckets = tuple(int(b) for b in args.buckets.split(",") if b)
     except ValueError:
@@ -200,24 +223,47 @@ def main(argv=None) -> int:
     from dasmtl.serve.server import (ServeLoop, install_signal_handlers,
                                      make_http_server)
 
+    # One builder serves startup AND every later blue/green swap
+    # (POST /swap rebuilds through it in the background, so a registry
+    # replica re-resolves "latest" at swap time and a checkpoint replica
+    # re-reads its weights).
+    pool_kw = dict(devices=args.devices, shard_largest=args.shard_largest,
+                   shard_multihost=args.shard_multihost,
+                   precision=args.precision)
+
+    if args.exported:
+        def build_executor(version=None):
+            return ExecutorPool.from_exported(
+                args.exported, buckets, expected_hw=window, **pool_kw)
+    elif args.registry:
+        from dasmtl.export import ArtifactRegistry
+
+        registry = ArtifactRegistry(args.registry)
+
+        def build_executor(version=None):
+            entry = registry.resolve(version
+                                     if version is not None
+                                     else args.registry_version)
+            print(f"dasmtl-serve: registry {args.registry} -> "
+                  f"v{entry['version']} ({entry['file']})",
+                  file=sys.stderr)
+            return ExecutorPool.from_exported(
+                entry["path"], buckets, expected_hw=window, **pool_kw)
+    else:
+        def build_executor(version=None):
+            return ExecutorPool.from_checkpoint(
+                args.model, args.model_path, buckets, input_hw=window,
+                **pool_kw)
+
     # Input-spec compatibility is a STARTUP error (the doctor-style check):
     # an artifact exported for a different window must never reach traffic.
-    if args.exported:
-        try:
-            executor = ExecutorPool.from_exported(
-                args.exported, buckets, expected_hw=window,
-                devices=args.devices, shard_largest=args.shard_largest,
-                precision=args.precision)
-        except ValueError as exc:
-            # Precision/window disagreement is an OPERATIONAL error with
-            # a named fix — never a dtype/shape traceback mid-request.
-            print(f"dasmtl-serve: {exc}", file=sys.stderr)
-            return 2
-    else:
-        executor = ExecutorPool.from_checkpoint(
-            args.model, args.model_path, buckets, input_hw=window,
-            devices=args.devices, shard_largest=args.shard_largest,
-            precision=args.precision)
+    try:
+        executor = build_executor()
+    except ValueError as exc:
+        # Precision/window/registry disagreement is an OPERATIONAL error
+        # with a named fix — never a dtype/shape traceback mid-request.
+        print(f"dasmtl-serve: {exc}", file=sys.stderr)
+        return 2
 
     from dasmtl.obs.profiler import ProfilerHook
 
@@ -243,17 +289,30 @@ def main(argv=None) -> int:
                      latency_buckets_s=latency_buckets_s,
                      slo_p99_ms=args.slo_p99_ms,
                      profiler=profiler)
+    # Bind the front end BEFORE warmup: /healthz (liveness) answers while
+    # buckets compile, /readyz stays 503 until warm — a router probing
+    # readiness never routes traffic at a replica mid-compilation.
+    httpd = make_http_server(loop, args.host, args.port,
+                             swap_builder=build_executor)
+    host, port = httpd.server_address[:2]
+    if args.port_file:
+        with open(args.port_file, "w", encoding="utf-8") as f:
+            f.write(f"{port}\n")
+    import threading
+
+    stop = threading.Event()
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
     print(f"warming {len(buckets)} bucket(s) "
           f"{list(buckets)} on {executor.input_hw[0]}x"
           f"{executor.input_hw[1]} windows (precision "
           f"{executor.precision}, staging {executor.input_dtype}) across "
-          f"{len(executor.executors)} device(s) ...", file=sys.stderr)
+          f"{len(executor.executors)} device(s); liveness already up on "
+          f"http://{host}:{port} ...", file=sys.stderr)
     loop.start()
-    httpd = make_http_server(loop, args.host, args.port)
-    host, port = httpd.server_address[:2]
     print(f"serving {executor.source} on http://{host}:{port} "
-          f"(POST /infer, GET /healthz, GET /stats, GET /metrics, "
-          f"GET /trace, POST /profile); warmup "
+          f"(POST /infer, GET /healthz, GET /readyz, GET /stats, "
+          f"GET /metrics, GET /trace, POST /swap, POST /profile); warmup "
           f"{loop.stats()['warmup_s']:.2f}s; in-flight window "
           f"{loop.inflight_window}; SIGTERM drains; SIGUSR2 profiles",
           file=sys.stderr)
@@ -261,12 +320,7 @@ def main(argv=None) -> int:
     # SIGTERM/SIGINT: refuse new work, let the dispatcher finish what is
     # queued, then stop accepting connections.  shutdown() must not run in
     # the signal handler (it joins the serve_forever thread) — flag + poll.
-    import threading
-
-    stop = threading.Event()
     install_signal_handlers(loop, on_drain=lambda _s: stop.set())
-    t = threading.Thread(target=httpd.serve_forever, daemon=True)
-    t.start()
     stop.wait()
     drained = loop.drain(timeout=60.0)
     httpd.shutdown()

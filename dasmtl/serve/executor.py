@@ -377,21 +377,30 @@ class ExecutorPool:
 
     @classmethod
     def _build(cls, make_executor, hw, buckets, devices, shard_largest,
-               **kw) -> "ExecutorPool":
+               shard_multihost: bool = False, **kw) -> "ExecutorPool":
         devs = cls._pool_devices(devices)
         executors = [make_executor(d, **kw) for d in devs]
         shard_ex = None
         largest = max(int(b) for b in buckets)
-        if shard_largest and len(devs) > 1:
-            if largest % len(devs):
+        if shard_largest and (len(devs) > 1 or shard_multihost):
+            from dasmtl.parallel.mesh import (infer_batch_sharding,
+                                              serve_shard_plan)
+
+            # shard_multihost widens the mesh to EVERY process's devices
+            # (jax.devices() is global under jax.distributed) — the
+            # largest bucket then shards across the whole serving pool,
+            # not just this host (mesh.serve_shard_plan).
+            plan = serve_shard_plan(None if shard_multihost else devs,
+                                    multihost=shard_multihost)
+            if plan.n_devices < 2:
+                plan = None  # a 1-device "mesh" is just the plain member
+            elif largest % plan.n_devices:
                 raise ValueError(
                     f"shard_largest needs the largest bucket ({largest}) "
-                    f"divisible by the pool size ({len(devs)})")
-            from dasmtl.parallel.mesh import create_mesh, infer_batch_sharding
-
-            plan = create_mesh(dp=len(devs), sp=1, devices=devs)
-            shard_ex = make_executor(infer_batch_sharding(plan),
-                                     buckets=(largest,), **kw)
+                    f"divisible by the mesh size ({plan.n_devices})")
+            if plan is not None:
+                shard_ex = make_executor(infer_batch_sharding(plan),
+                                         buckets=(largest,), **kw)
         return cls(executors, shard_ex)
 
     @classmethod
@@ -399,6 +408,7 @@ class ExecutorPool:
                         buckets: Sequence[int],
                         input_hw: Optional[Tuple[int, int]] = None,
                         devices=None, shard_largest: bool = False,
+                        shard_multihost: bool = False,
                         precision: str = "f32",
                         **kw) -> "ExecutorPool":
         """Pool over a checkpoint forward: the model is built, the
@@ -414,12 +424,14 @@ class ExecutorPool:
                                  placement=placement, precision=precision,
                                  precision_meta=meta, **kw)
 
-        return cls._build(make, hw, buckets, devices, shard_largest)
+        return cls._build(make, hw, buckets, devices, shard_largest,
+                          shard_multihost)
 
     @classmethod
     def from_exported(cls, path: str, buckets: Sequence[int],
                       expected_hw: Optional[Tuple[int, int]] = None,
                       devices=None, shard_largest: bool = False,
+                      shard_multihost: bool = False,
                       precision: Optional[str] = None,
                       **kw) -> "ExecutorPool":
         """Pool over one deserialized StableHLO artifact: the artifact's
@@ -440,7 +452,8 @@ class ExecutorPool:
                                 header.get("artifact_version", 0)},
                 **kw)
 
-        return cls._build(make, hw, buckets, devices, shard_largest)
+        return cls._build(make, hw, buckets, devices, shard_largest,
+                          shard_multihost)
 
     # -- execution -----------------------------------------------------------
     def warmup(self) -> float:

@@ -1,0 +1,366 @@
+"""The router's model of one serving replica, in three layers.
+
+**The replica contract** is everything PR 4/5/8 already committed a
+single ``dasmtl-serve`` process to: structured ``shed`` (backpressure —
+retryable elsewhere), ``closed`` (draining — leave rotation until
+``/readyz`` recovers), ``nonfinite`` (a per-request property, final),
+``GET /readyz`` (503 while compiling buckets or draining), and a
+Prometheus ``/metrics`` exposition.  Nothing replica-side was invented
+for the router: a plain ``dasmtl-serve`` IS a conforming replica.
+
+- :class:`ReplicaHandle` — the contract as a **pure state machine**: how
+  the router's view of one replica evolves on probe results, request
+  outcomes, and connection failures (eviction + exponential re-probe
+  backoff), plus cordon/uncordon for rollout orchestration.  No I/O, no
+  clock, no threads — every method takes ``now``, so placement/eviction
+  policy is exactly testable the ``MicroBatcher.take_batch(now)`` way
+  (tests/test_serve_router.py).
+
+- :class:`HttpTransport` — the one place router-side I/O lives: pooled
+  keep-alive connections (thread-local per address — the stdlib front
+  end speaks HTTP/1.1 with Content-Length, so reuse works), every
+  failure normalized to :class:`TransportError`.  Swappable for an
+  in-process fake, which is how the fake-clock tests drive a whole
+  router with zero processes.
+
+- :class:`ReplicaProcess` — a real ``python -m dasmtl.serve`` child:
+  spawn with ``--port 0 --port_file`` (the supervisor learns the
+  ephemeral port from the file — no stderr scraping, no port races),
+  SIGTERM to drain, SIGKILL for failure injection (the selftest's
+  mid-load kill is a REAL kill).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Optional, Sequence
+
+
+class TransportError(RuntimeError):
+    """Any transport-level failure talking to a replica (refused /
+    reset / timeout / torn body).  The router treats every one the same
+    way: immediate eviction + re-probe with backoff."""
+
+
+# -- the replica contract as a pure state machine -----------------------------
+
+
+class ReplicaHandle:
+    """Router-side state for one replica.  Health state is ``probing``
+    (out of rotation, being re-checked on a backoff schedule) or
+    ``ready``; ``cordoned`` is an orthogonal administrative bit (rollout
+    takes a healthy replica out of rotation without calling it sick).
+    ``outstanding`` is the live least-outstanding-requests placement key.
+    """
+
+    def __init__(self, name: str, address: str, *,
+                 probe_interval_s: float = 1.0,
+                 backoff_max_s: float = 30.0):
+        self.name = name
+        self.address = address
+        self.probe_interval_s = float(probe_interval_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.state = "probing"
+        self.cordoned = False
+        self.outstanding = 0
+        self.failures = 0  # consecutive probe/transport failures
+        self._next_probe = float("-inf")  # probe immediately on start
+        # Last readiness payload highlights (what /healthz reported).
+        self.generation: Optional[int] = None
+        self.source: Optional[str] = None
+        self.last_error: Optional[str] = None
+        # Counters the router aggregates into its own metrics.
+        self.sent = 0
+        self.evictions = 0
+
+    # -- rotation ------------------------------------------------------------
+    @property
+    def in_rotation(self) -> bool:
+        return self.state == "ready" and not self.cordoned
+
+    def cordon(self) -> None:
+        self.cordoned = True
+
+    def uncordon(self) -> None:
+        self.cordoned = False
+
+    # -- request lifecycle ---------------------------------------------------
+    def on_send(self) -> None:
+        self.outstanding += 1
+        self.sent += 1
+
+    def on_done(self) -> None:
+        self.outstanding = max(0, self.outstanding - 1)
+
+    def evict(self, now: float, reason: str) -> None:
+        """Connection failure or a ``closed`` answer: out of rotation NOW,
+        next probe after an exponential backoff (capped) — a flapping
+        replica gets probed ever less often instead of hammered."""
+        self.state = "probing"
+        self.failures += 1
+        self.evictions += 1
+        self.last_error = reason
+        self._next_probe = now + self._backoff()
+
+    def _backoff(self) -> float:
+        return min(self.probe_interval_s * (2.0 ** (self.failures - 1)),
+                   self.backoff_max_s)
+
+    # -- probing -------------------------------------------------------------
+    def next_probe_at(self) -> float:
+        """When this replica is next due a ``/readyz`` probe: ready
+        replicas re-check each ``probe_interval_s`` (to catch a silent
+        drain), probing ones follow their backoff schedule."""
+        return self._next_probe
+
+    def on_probe_ok(self, now: float, payload: dict) -> None:
+        """A probe that got an HTTP answer — ``payload`` is the
+        /readyz (== /healthz) body; its ``ready`` bit decides rotation.
+        An un-ready answer is a clean 'not yet' (warming/draining):
+        re-probe at the plain interval, no backoff escalation."""
+        self.failures = 0
+        self.last_error = None
+        self.generation = payload.get("generation", self.generation)
+        self.source = payload.get("source", self.source)
+        self.state = "ready" if payload.get("ready") else "probing"
+        self._next_probe = now + self.probe_interval_s
+
+    def on_probe_fail(self, now: float, reason: str) -> None:
+        """No HTTP answer at all: connection-level failure, backoff."""
+        self.state = "probing"
+        self.failures += 1
+        self.last_error = reason
+        self._next_probe = now + self._backoff()
+
+    def snapshot(self) -> dict:
+        return {"name": self.name, "address": self.address,
+                "state": self.state, "cordoned": self.cordoned,
+                "in_rotation": self.in_rotation,
+                "outstanding": self.outstanding,
+                "failures": self.failures, "sent": self.sent,
+                "evictions": self.evictions,
+                "generation": self.generation, "source": self.source,
+                "last_error": self.last_error}
+
+
+# -- HTTP transport -----------------------------------------------------------
+
+
+class HttpTransport:
+    """Keep-alive HTTP client for replica traffic: one pooled connection
+    per (thread, address) — the forwarding hot path never pays TCP
+    setup per request — with every failure mode collapsed into
+    :class:`TransportError` (and the broken connection dropped, so the
+    next attempt reconnects cleanly)."""
+
+    def __init__(self, timeout_s: float = 30.0):
+        self.timeout_s = float(timeout_s)
+        self._local = threading.local()
+
+    def _conn(self, address: str, timeout_s: float
+              ) -> http.client.HTTPConnection:
+        pool = getattr(self._local, "pool", None)
+        if pool is None:
+            pool = self._local.pool = {}
+        conn = pool.get(address)
+        if conn is None:
+            host, _, port = address.rpartition(":")
+            conn = http.client.HTTPConnection(host, int(port),
+                                              timeout=timeout_s)
+            pool[address] = conn
+        else:
+            conn.timeout = timeout_s
+        return conn
+
+    def _drop(self, address: str) -> None:
+        pool = getattr(self._local, "pool", None)
+        conn = pool.pop(address, None) if pool else None
+        if conn is not None:
+            conn.close()
+
+    def request(self, address: str, method: str, path: str,
+                body: Optional[bytes] = None,
+                timeout_s: Optional[float] = None) -> tuple:
+        """``(status, raw bytes)`` or :class:`TransportError`.  A 4xx/5xx
+        with a body is an ANSWER (the replica contract speaks through
+        status+JSON), not a transport failure."""
+        timeout_s = self.timeout_s if timeout_s is None else timeout_s
+        conn = self._conn(address, timeout_s)
+        try:
+            conn.request(method, path, body=body,
+                         headers={"Content-Type": "application/json"}
+                         if body is not None else {})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        except Exception as exc:  # noqa: BLE001 — normalize every failure
+            self._drop(address)
+            raise TransportError(
+                f"{method} {address}{path}: "
+                f"{type(exc).__name__}: {exc}") from None
+
+    def request_json(self, address: str, method: str, path: str,
+                     obj=None, timeout_s: Optional[float] = None) -> tuple:
+        body = (json.dumps(obj).encode() if obj is not None else None)
+        status, raw = self.request(address, method, path, body, timeout_s)
+        try:
+            return status, (json.loads(raw) if raw else {})
+        except json.JSONDecodeError as exc:
+            raise TransportError(
+                f"{method} {address}{path}: non-JSON body: {exc}") \
+                from None
+
+    # -- the calls the router makes ------------------------------------------
+    def infer(self, address: str, body: bytes,
+              timeout_s: Optional[float] = None) -> tuple:
+        """``(status, raw response bytes)``.  Raw on purpose: the router's
+        hot path forwards a success verbatim (status code 200 already
+        says "ok") — parsing + re-serializing every answer on a host the
+        replicas share would tax the very compute being routed to."""
+        return self.request(address, "POST", "/infer", body, timeout_s)
+
+    def infer_json(self, address: str, body: bytes,
+                   timeout_s: Optional[float] = None) -> tuple:
+        """``(status, payload dict)`` — for clients (selftest/bench) that
+        want the parsed answer; the router itself uses :meth:`infer`."""
+        status, raw = self.infer(address, body, timeout_s)
+        try:
+            return status, (json.loads(raw) if raw else {})
+        except json.JSONDecodeError as exc:
+            raise TransportError(
+                f"POST {address}/infer: non-JSON body: {exc}") from None
+
+    def probe(self, address: str,
+              timeout_s: Optional[float] = None) -> dict:
+        """The /readyz body regardless of status (200 and 503 both carry
+        the healthz payload; ``ready`` inside is the truth)."""
+        _status, payload = self.request_json(address, "GET", "/readyz",
+                                             timeout_s=timeout_s or 5.0)
+        return payload
+
+    def swap(self, address: str, version=None,
+             timeout_s: Optional[float] = None) -> tuple:
+        return self.request_json(address, "POST", "/swap",
+                                 {"version": version},
+                                 timeout_s=timeout_s)
+
+    def swap_status(self, address: str) -> dict:
+        return self.request_json(address, "GET", "/swap",
+                                 timeout_s=5.0)[1]
+
+    def stats(self, address: str) -> dict:
+        return self.request_json(address, "GET", "/stats",
+                                 timeout_s=10.0)[1]
+
+    def metrics_text(self, address: str) -> str:
+        status, raw = self.request(address, "GET", "/metrics",
+                                   timeout_s=10.0)
+        if status != 200:
+            raise TransportError(f"GET {address}/metrics: HTTP {status}")
+        return raw.decode("utf-8")
+
+
+# -- real replica processes ---------------------------------------------------
+
+
+class ReplicaProcess:
+    """One real ``python -m dasmtl.serve`` child on an ephemeral port.
+
+    The child binds its HTTP front end BEFORE warmup and writes the bound
+    port to ``--port_file``; the supervisor polls that file, so startup
+    needs no fixed ports and no output scraping.  Liveness (`/healthz`)
+    is up as soon as the file exists — readiness comes later, when the
+    child finishes compiling its buckets, and that is the router's
+    business, not the supervisor's.
+    """
+
+    def __init__(self, serve_args: Sequence[str], *, name: str = "replica",
+                 host: str = "127.0.0.1",
+                 startup_timeout_s: float = 180.0,
+                 env: Optional[dict] = None,
+                 log_path: Optional[str] = None):
+        self.name = name
+        self.host = host
+        self._dir = tempfile.mkdtemp(prefix=f"dasmtl-{name}-")
+        port_file = os.path.join(self._dir, "port")
+        self.log_path = log_path or os.path.join(self._dir, "serve.log")
+        self._log = open(self.log_path, "wb")
+        cmd = [sys.executable, "-m", "dasmtl.serve", *serve_args,
+               "--host", host, "--port", "0", "--port_file", port_file]
+        self.proc = subprocess.Popen(cmd, stdout=self._log,
+                                     stderr=subprocess.STDOUT,
+                                     env=env)
+        deadline = time.monotonic() + startup_timeout_s
+        self.port: Optional[int] = None
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica {name} exited rc={self.proc.returncode} "
+                    f"before binding — log: {self.log_path}\n"
+                    f"{self.log_tail()}")
+            try:
+                with open(port_file, "r", encoding="utf-8") as f:
+                    text = f.read().strip()
+                if text:
+                    self.port = int(text)
+                    break
+            except FileNotFoundError:
+                pass
+            time.sleep(0.05)
+        if self.port is None:
+            self.proc.kill()
+            raise RuntimeError(f"replica {name} never bound a port "
+                               f"within {startup_timeout_s}s — log: "
+                               f"{self.log_path}\n{self.log_tail()}")
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self) -> None:
+        """SIGKILL — the failure-injection path (no drain, no goodbye)."""
+        if self.alive:
+            os.kill(self.proc.pid, signal.SIGKILL)
+        self.proc.wait()
+
+    def terminate(self, timeout_s: float = 60.0) -> int:
+        """SIGTERM (graceful drain) and wait; returns the exit code."""
+        if self.alive:
+            self.proc.terminate()
+        try:
+            return self.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            return self.proc.wait()
+
+    def log_tail(self, max_bytes: int = 4096) -> str:
+        try:
+            self._log.flush()
+            with open(self.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - max_bytes))
+                return f.read().decode("utf-8", "replace")
+        except OSError:
+            return "<log unreadable>"
+
+    def close(self) -> None:
+        self.terminate()
+        self._log.close()
+
+    def __enter__(self) -> "ReplicaProcess":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,727 @@
+"""``dasmtl-router`` — the scale-out serving tier: a thin asynchronous
+router in front of N ``dasmtl-serve`` replica processes.
+
+One replica process is a single point of failure that cannot be updated
+without downtime; the router converts N of them into one endpoint that
+stays up through replica crashes AND model updates:
+
+- **Placement** is least-outstanding-requests over the in-rotation
+  replicas (ties round-robin): the router holds no queue of its own —
+  replicas already own queueing, micro-batching, and shedding, so the
+  router's only job is to put each request where it will wait least.
+- **The replica contract** (dasmtl/serve/replica.py) is the protocol PR
+  4/5/8 already committed: ``shed`` → ONE bounded retry on a different
+  replica (backpressure is retryable elsewhere, not a failure);
+  ``closed`` → the replica is draining: out of rotation until its
+  ``/readyz`` recovers, and the request retries elsewhere; a transport
+  failure → immediate eviction + exponential re-probe backoff, and the
+  request retries elsewhere (inference is idempotent — a dead
+  connection may only lose an answer, never corrupt state).
+- **Aggregated observability**: ``GET /metrics`` on the router scrapes
+  every replica's Prometheus exposition, re-labels each sample with
+  ``replica="<name>"`` (via the PR 8 ``parse_exposition``), and appends
+  the router's own ``dasmtl_router_*`` families — one scrape for the
+  whole tier.
+- **Blue/green rollout** (``POST /rollout``): replica by replica —
+  cordon (healthy but out of rotation) → wait for its outstanding
+  requests to drain → ``POST /swap`` (the replica builds + warms the
+  incoming executor in the background and flips atomically) → rejoin
+  only when ``/readyz`` reports ready at the NEW generation.  At most
+  one replica is ever out of rotation, so a swap under sustained load
+  drops nothing and answers nothing with ``closed``; the incoming
+  executor's recompile counter proving 0 post-warmup compiles is the
+  warmth guarantee (the selftest asserts all of it).
+
+Entry points: ``dasmtl-router`` / ``dasmtl router`` /
+``python -m dasmtl.serve.router``.  Attach to running replicas
+(``--replicas host:port,host:port``) or spawn them (``--spawn N`` plus
+the usual serve model-source flags).  docs/SERVING.md "Router tier &
+blue/green rollout".
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence
+from urllib.parse import urlsplit
+
+from dasmtl.obs.registry import (MetricsRegistry, escape_label_value,
+                                 parse_exposition, render_prometheus)
+from dasmtl.serve.replica import HttpTransport, ReplicaHandle, TransportError
+
+#: Outcomes the router's own requests_total counter distinguishes (the
+#: replica outcomes plus the two only a router can produce).
+ROUTER_OUTCOMES = ("ok", "shed", "closed", "nonfinite", "error",
+                   "no_replica", "unreachable")
+
+
+class RouterCore:
+    """Placement + probe scheduling as plain state (no I/O, no threads):
+    the fake-clock-testable half of the router, mirroring how
+    ``MicroBatcher`` carries the batching policy for the server loop.
+    Thread-safety is the CALLER's job (the threaded :class:`Router`
+    wraps every call in one lock)."""
+
+    def __init__(self, replicas: Sequence[ReplicaHandle],
+                 retry_budget: int = 1):
+        if not replicas:
+            raise ValueError("a router needs at least one replica")
+        self.replicas = list(replicas)
+        self.retry_budget = max(0, int(retry_budget))
+        self._rr = 0
+
+    def by_address(self, address: str) -> Optional[ReplicaHandle]:
+        for r in self.replicas:
+            if r.address == address:
+                return r
+        return None
+
+    def in_rotation(self) -> List[ReplicaHandle]:
+        return [r for r in self.replicas if r.in_rotation]
+
+    def pick(self, exclude: Sequence[str] = ()) -> Optional[ReplicaHandle]:
+        """Least-outstanding-requests placement over in-rotation replicas
+        not in ``exclude`` (the addresses a retry already tried); ties
+        break round-robin so equal replicas share load instead of
+        dogpiling index 0."""
+        cands = [r for r in self.in_rotation() if r.address not in exclude]
+        if not cands:
+            return None
+        least = min(r.outstanding for r in cands)
+        tied = [r for r in cands if r.outstanding == least]
+        choice = tied[self._rr % len(tied)]
+        self._rr += 1
+        return choice
+
+    def due_probes(self, now: float) -> List[ReplicaHandle]:
+        return [r for r in self.replicas if r.next_probe_at() <= now]
+
+
+def aggregate_expositions(texts: Dict[str, str]) -> str:
+    """One Prometheus exposition over many replicas' scrapes: each
+    sample re-labeled with ``replica="<name>"`` so per-replica series
+    survive aggregation (a scraper sums/joins on the label).  Families
+    merge across replicas; HELP/TYPE render once per family."""
+    families: Dict[str, dict] = {}
+    order: List[str] = []
+    for name, text in texts.items():
+        for fam, info in parse_exposition(text).items():
+            dst = families.get(fam)
+            if dst is None:
+                dst = families[fam] = {"type": info["type"],
+                                       "help": info["help"], "rows": []}
+                order.append(fam)
+            for (sample, labels), value in sorted(info["samples"].items()):
+                dst["rows"].append((sample, labels, name, value))
+    lines: List[str] = []
+    for fam in order:
+        info = families[fam]
+        if info["help"]:
+            lines.append(f"# HELP {fam} {info['help']}")
+        lines.append(f"# TYPE {fam} {info['type']}")
+        for sample, labels, replica, value in info["rows"]:
+            pairs = [*labels, ("replica", replica)]
+            pairs.sort()
+            body = ",".join(f'{k}="{escape_label_value(v)}"'
+                            for k, v in pairs)
+            v = float(value)
+            vs = (str(int(v)) if v == int(v) and abs(v) < 1e15
+                  else format(v, ".10g"))
+            lines.append(f"{sample}{{{body}}} {vs}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+class Router:
+    """The threaded router: a probe thread keeps every replica's
+    :class:`ReplicaHandle` current, ``handle_infer`` forwards with the
+    bounded-retry policy, and ``rollout`` drives blue/green swaps.  All
+    shared state sits behind one lock; the transport is injectable (the
+    fake-clock tests drive everything with zero processes)."""
+
+    def __init__(self, replicas: Sequence[ReplicaHandle], *,
+                 transport=None, retry_budget: int = 1,
+                 request_timeout_s: float = 30.0,
+                 probe_tick_s: float = 0.05,
+                 clock=time.monotonic):
+        self.core = RouterCore(replicas, retry_budget=retry_budget)
+        self.transport = transport or HttpTransport(request_timeout_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self.probe_tick_s = float(probe_tick_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        self._rollout_thread: Optional[threading.Thread] = None
+        self._rollout = {"state": "idle"}
+        self._rollouts = 0
+        # -- router-own metrics (dasmtl_router_* families) --------------------
+        reg = self.registry = MetricsRegistry()
+        self._m_requests = reg.counter(
+            "dasmtl_router_requests_total",
+            "Routed requests by final outcome", labelnames=("outcome",))
+        self._m_retries = reg.counter(
+            "dasmtl_router_retries_total",
+            "Bounded re-placements by cause (shed/closed/unreachable)",
+            labelnames=("reason",))
+        self._m_evictions = reg.counter(
+            "dasmtl_router_evictions_total",
+            "Replicas knocked out of rotation by a transport failure or "
+            "a closed answer")
+        self._m_probes = reg.counter(
+            "dasmtl_router_probes_total",
+            "Readiness probes by result", labelnames=("result",))
+        self._m_ready = reg.gauge(
+            "dasmtl_router_replicas_in_rotation",
+            "Replicas currently eligible for placement")
+        self._m_rollouts = reg.counter(
+            "dasmtl_router_rollouts_total",
+            "Blue/green rollouts finished, by result",
+            labelnames=("result",))
+        for outcome in ROUTER_OUTCOMES:
+            self._m_requests.inc(0, (outcome,))
+        for reason in ("shed", "closed", "unreachable"):
+            self._m_retries.inc(0, (reason,))
+        self._m_evictions.inc(0)
+        self._m_rollouts.inc(0, ("done",))
+        self._m_rollouts.inc(0, ("failed",))
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "Router":
+        self.probe_once()  # synchronous first pass: known state at start
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="dasmtl-router-probe",
+            daemon=True)
+        self._probe_thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in (self._probe_thread, self._rollout_thread):
+            if t is not None:
+                t.join(timeout=30.0)
+
+    # -- probing -------------------------------------------------------------
+    def probe_once(self, now: Optional[float] = None) -> None:
+        """Probe every replica whose schedule says it is due.  The HTTP
+        round-trips run OUTSIDE the lock (a slow replica must not stall
+        placement); state transitions apply under it."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            due = self.core.due_probes(now)
+        for r in due:
+            try:
+                payload = self.transport.probe(r.address)
+            except TransportError as exc:
+                with self._lock:
+                    r.on_probe_fail(self.clock(), str(exc))
+                self._m_probes.inc(1, ("unreachable",))
+                continue
+            with self._lock:
+                r.on_probe_ok(self.clock(), payload)
+            self._m_probes.inc(
+                1, ("ready" if payload.get("ready") else "not_ready",))
+        with self._lock:
+            self._m_ready.set(len(self.core.in_rotation()))
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_tick_s):
+            self.probe_once()
+
+    # -- the data path -------------------------------------------------------
+    @staticmethod
+    def _payload_of(raw) -> dict:
+        """Lazy view of a replica answer: fake transports hand dicts,
+        the HTTP transport hands raw bytes (parsed only on the paths
+        that need the ``error`` field)."""
+        if isinstance(raw, dict):
+            return raw
+        try:
+            return json.loads(raw) if raw else {}
+        except json.JSONDecodeError:
+            return {"ok": False, "error": "error",
+                    "detail": "replica answered non-JSON"}
+
+    def handle_infer(self, body: bytes) -> tuple:
+        """Forward one ``POST /infer`` body; returns ``(status, reply)``
+        where ``reply`` is raw bytes (the zero-parse passthrough of a
+        clean success — on a shared-core host every router cycle is
+        stolen from the replicas) or an annotated dict on the slow paths
+        (refusal, retry, no replica).  Placement + the bounded retry
+        policy of the module docstring; every terminal outcome is
+        structured (the router never converts a replica answer into a
+        hang or a bare 500)."""
+        tried: list = []
+        retries = 0
+        last = None
+        while True:
+            with self._lock:
+                replica = self.core.pick(exclude=tried)
+                if replica is not None:
+                    replica.on_send()
+            if replica is None:
+                if last is not None:
+                    status, payload, outcome = last
+                    payload = dict(self._payload_of(payload))
+                    payload["router"] = {"retries": retries,
+                                         "exhausted": True}
+                    self._m_requests.inc(1, (outcome,))
+                    return status, payload
+                self._m_requests.inc(1, ("no_replica",))
+                return 503, {"ok": False, "error": "no_replica",
+                             "detail": "no replica in rotation — replicas "
+                                       "warming, draining, or down "
+                                       "(GET /stats lists them)",
+                             "router": {"retries": retries}}
+            try:
+                status, raw = self.transport.infer(
+                    replica.address, body, self.request_timeout_s)
+            except TransportError as exc:
+                now = self.clock()
+                with self._lock:
+                    replica.on_done()
+                    replica.evict(now, str(exc))
+                    self._m_ready.set(len(self.core.in_rotation()))
+                self._m_evictions.inc()
+                tried.append(replica.address)
+                last = (502, {"ok": False, "error": "unreachable",
+                              "detail": str(exc)}, "unreachable")
+                if retries < self.core.retry_budget:
+                    retries += 1
+                    self._m_retries.inc(1, ("unreachable",))
+                    continue
+                status, payload, outcome = last
+                payload = dict(payload)
+                payload["router"] = {"retries": retries,
+                                     "exhausted": True}
+                self._m_requests.inc(1, (outcome,))
+                return status, payload
+            with self._lock:
+                replica.on_done()
+            if status == 200 and retries == 0:
+                # The hot path: a clean success passes through verbatim
+                # (no JSON parse, no re-serialize — the status code
+                # already carries the outcome).
+                self._m_requests.inc(1, ("ok",))
+                return status, raw
+            payload = self._payload_of(raw)
+            error = payload.get("error")
+            exhausted = False
+            if error in ("shed", "closed"):
+                if error == "closed":
+                    # Draining: out of rotation until /readyz recovers.
+                    now = self.clock()
+                    with self._lock:
+                        replica.evict(now, "answered closed (draining)")
+                        self._m_ready.set(len(self.core.in_rotation()))
+                    self._m_evictions.inc()
+                tried.append(replica.address)
+                last = (status, payload, error)
+                if retries < self.core.retry_budget:
+                    retries += 1
+                    self._m_retries.inc(1, (error,))
+                    continue
+                exhausted = True
+            outcome = ("ok" if payload.get("ok")
+                       else (error if error in ROUTER_OUTCOMES
+                             else "error"))
+            self._m_requests.inc(1, (outcome,))
+            payload = dict(payload)
+            payload["router"] = {"replica": replica.name,
+                                 "retries": retries}
+            if exhausted:
+                payload["router"]["exhausted"] = True
+            return status, payload
+
+    # -- blue/green rollout --------------------------------------------------
+    def rollout(self, version=None, policy: str = "drain",
+                drain_timeout_s: float = 60.0,
+                swap_timeout_s: float = 600.0) -> dict:
+        """Start a replica-by-replica blue/green rollout in a background
+        thread (one at a time — a second request while one runs is
+        refused).  Returns the immediately-readable status dict; poll
+        :attr:`rollout_status` (``GET /rollout``) for progress."""
+        if policy not in ("drain", "hot"):
+            raise ValueError(f"unknown rollout policy {policy!r} "
+                             f"(drain | hot)")
+        with self._lock:
+            if self._rollout.get("state") == "running":
+                return {"state": "refused",
+                        "detail": "a rollout is already running",
+                        "current": dict(self._rollout)}
+            self._rollouts += 1
+            self._rollout = {"state": "running", "version": version,
+                             "policy": policy, "steps": [],
+                             "started_t": time.time()}
+        self._rollout_thread = threading.Thread(
+            target=self._run_rollout,
+            args=(version, policy, drain_timeout_s, swap_timeout_s),
+            name="dasmtl-router-rollout", daemon=True)
+        self._rollout_thread.start()
+        return dict(self._rollout)
+
+    @property
+    def rollout_status(self) -> dict:
+        with self._lock:
+            return json.loads(json.dumps(self._rollout))  # deep copy
+
+    def _rollout_step(self, step: dict) -> None:
+        with self._lock:
+            self._rollout["steps"].append(step)
+
+    def _finish_rollout(self, state: str, detail: str = "") -> None:
+        with self._lock:
+            self._rollout["state"] = state
+            if detail:
+                self._rollout["detail"] = detail
+        self._m_rollouts.inc(
+            1, ("done" if state == "done" else "failed",))
+
+    def _run_rollout(self, version, policy: str, drain_timeout_s: float,
+                     swap_timeout_s: float) -> None:
+        """One replica at a time: cordon → drain outstanding → swap →
+        readiness-gated rejoin.  A failed step STOPS the rollout with
+        that replica still cordoned — rolling a bad artifact onto the
+        remaining replicas would convert one sick replica into an
+        outage (the runbook in docs/OPERATIONS.md picks it up)."""
+        with self._lock:
+            replicas = list(self.core.replicas)
+        for r in replicas:
+            step = {"replica": r.name, "address": r.address,
+                    "phase": "cordon"}
+            self._rollout_step(step)
+            try:
+                if policy == "drain":
+                    with self._lock:
+                        r.cordon()
+                    deadline = time.monotonic() + drain_timeout_s
+                    while True:
+                        with self._lock:
+                            outstanding = r.outstanding
+                        if outstanding == 0:
+                            break
+                        if time.monotonic() > deadline:
+                            raise RuntimeError(
+                                f"{r.name}: {outstanding} request(s) "
+                                f"still outstanding after "
+                                f"{drain_timeout_s}s cordon")
+                        time.sleep(0.01)
+                step["phase"] = "swap"
+                before = r.generation
+                status, payload = self.transport.swap(r.address, version)
+                if status not in (200, 202):
+                    raise RuntimeError(f"{r.name}: POST /swap -> HTTP "
+                                       f"{status}: {payload}")
+                step["phase"] = "await_ready"
+                deadline = time.monotonic() + swap_timeout_s
+                while True:
+                    swap = self.transport.swap_status(r.address)
+                    state = swap.get("swap", {}).get("state")
+                    if state == "failed":
+                        raise RuntimeError(
+                            f"{r.name}: swap failed: "
+                            f"{swap['swap'].get('detail')}")
+                    probe = self.transport.probe(r.address)
+                    with self._lock:
+                        r.on_probe_ok(self.clock(), probe)
+                    if (state == "done" and probe.get("ready")
+                            and (before is None
+                                 or probe.get("generation", 0) > before)):
+                        break
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"{r.name}: not ready at a new generation "
+                            f"within {swap_timeout_s}s (swap state "
+                            f"{state!r})")
+                    time.sleep(0.05)
+                with self._lock:
+                    r.uncordon()
+                step["phase"] = "done"
+                step["generation"] = r.generation
+            except (TransportError, RuntimeError) as exc:
+                step["phase"] = "failed"
+                step["detail"] = str(exc)
+                self._finish_rollout(
+                    "failed",
+                    f"stopped at {r.name} (still cordoned): {exc}")
+                return
+        self._finish_rollout("done")
+
+    # -- observability -------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            replicas = [r.snapshot() for r in self.core.replicas]
+            rollout = json.loads(json.dumps(self._rollout))
+        return {"replicas": replicas,
+                "in_rotation": sum(1 for r in replicas
+                                   if r["in_rotation"]),
+                "retry_budget": self.core.retry_budget,
+                "rollout": rollout,
+                "rollouts": self._rollouts}
+
+    def metrics_text(self) -> str:
+        """The aggregated tier scrape: every reachable replica's
+        exposition re-labeled ``replica="<name>"``, then the router's own
+        families.  An unreachable replica contributes a
+        ``dasmtl_router_scrape_errors_total`` bump instead of failing
+        the whole scrape."""
+        texts: Dict[str, str] = {}
+        with self._lock:
+            members = [(r.name, r.address) for r in self.core.replicas]
+        errors = self.registry.counter(
+            "dasmtl_router_scrape_errors_total",
+            "Replica /metrics scrapes that failed",
+            labelnames=("replica",))
+        for name, address in members:
+            try:
+                texts[name] = self.transport.metrics_text(address)
+            except (TransportError, ValueError):
+                errors.inc(1, (name,))
+        return (aggregate_expositions(texts)
+                + render_prometheus(self.registry))
+
+    def healthz(self) -> dict:
+        with self._lock:
+            n_rot = len(self.core.in_rotation())
+            n_all = len(self.core.replicas)
+        return {"status": "routing", "replicas": n_all,
+                "in_rotation": n_rot, "ready": n_rot > 0}
+
+
+# -- HTTP front end -----------------------------------------------------------
+
+
+def _make_router_handler(router: Router):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args) -> None:  # quiet by default
+            pass
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self._reply_raw(code, body, "application/json")
+
+        def _reply_raw(self, code: int, body: bytes,
+                       content_type: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self) -> None:  # noqa: N802 — http.server API shape
+            url = urlsplit(self.path)
+            if url.path == "/healthz":
+                self._reply(200, router.healthz())
+            elif url.path == "/readyz":
+                h = router.healthz()
+                self._reply(200 if h["ready"] else 503, h)
+            elif url.path == "/stats":
+                self._reply(200, router.stats())
+            elif url.path == "/rollout":
+                self._reply(200, router.rollout_status)
+            elif url.path == "/metrics":
+                self._reply_raw(200, router.metrics_text().encode(),
+                                "text/plain; version=0.0.4; charset=utf-8")
+            else:
+                self._reply(404, {"error": f"unknown path {url.path}"})
+
+        def do_POST(self) -> None:  # noqa: N802 — http.server API shape
+            if self.path == "/rollout":
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n)) if n else {}
+                    status = router.rollout(
+                        version=body.get("version"),
+                        policy=body.get("policy", "drain"))
+                except (ValueError, json.JSONDecodeError) as exc:
+                    self._reply(400, {"error": "bad_request",
+                                      "detail": str(exc)})
+                    return
+                code = 409 if status.get("state") == "refused" else 202
+                self._reply(code, {"rollout": status})
+                return
+            if self.path != "/infer":
+                self._reply(404, {"error": f"unknown path {self.path}"})
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            status, reply = router.handle_infer(body)
+            if isinstance(reply, (bytes, bytearray)):
+                self._reply_raw(status, reply, "application/json")
+            else:
+                self._reply(status, reply)
+
+    return Handler
+
+
+def make_router_http_server(router: Router, host: str = "127.0.0.1",
+                            port: int = 0) -> ThreadingHTTPServer:
+    """Bind (port 0 = ephemeral) but do not serve — callers run
+    ``serve_forever``/``shutdown`` themselves, like the replica's."""
+    return ThreadingHTTPServer((host, port), _make_router_handler(router))
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys
+
+    from dasmtl.config import Config
+
+    d = Config()
+    p = argparse.ArgumentParser(
+        description="dasmtl replica router: least-outstanding placement "
+                    "over N dasmtl-serve replicas, bounded retry on "
+                    "shed/failure, aggregated /metrics, blue/green "
+                    "rollout (docs/SERVING.md)")
+    tier = p.add_argument_group("replica tier (exactly one)")
+    tier.add_argument("--replicas", type=str, default=None,
+                      metavar="HOST:PORT,...",
+                      help="attach to already-running replicas")
+    tier.add_argument("--spawn", type=int, default=None, metavar="N",
+                      help="spawn N replica processes on ephemeral ports "
+                           "(model-source flags below are passed through "
+                           "to each)")
+    p.add_argument("--host", type=str, default=d.router_host)
+    p.add_argument("--port", type=int, default=d.router_port)
+    p.add_argument("--retry_budget", type=int, default=d.router_retry_budget,
+                   help="re-placements per request on shed/closed/"
+                        "transport failure (each on a replica not yet "
+                        "tried)")
+    p.add_argument("--probe_interval_s", type=float,
+                   default=d.router_probe_interval_s,
+                   help="readiness re-probe cadence for healthy replicas")
+    p.add_argument("--probe_backoff_max_s", type=float,
+                   default=d.router_probe_backoff_max_s,
+                   help="cap on the exponential re-probe backoff of a "
+                        "failing replica")
+    p.add_argument("--swap_policy", type=str, default=d.router_swap_policy,
+                   choices=["drain", "hot"],
+                   help="rollout default: 'drain' cordons each replica "
+                        "and waits for its outstanding requests before "
+                        "swapping; 'hot' swaps in place (the in-process "
+                        "flip is atomic either way)")
+    p.add_argument("--request_timeout_s", type=float, default=30.0)
+    spawn = p.add_argument_group("spawned-replica model source "
+                                 "(with --spawn)")
+    spawn.add_argument("--fresh_init", action="store_true")
+    spawn.add_argument("--exported", type=str, default=None)
+    spawn.add_argument("--model_path", type=str, default=None)
+    spawn.add_argument("--registry", type=str, default=d.serve_registry_dir)
+    spawn.add_argument("--model", type=str, default="MTL")
+    spawn.add_argument("--window", type=str, default=None, metavar="HxW")
+    spawn.add_argument("--buckets", type=str, default=None)
+    spawn.add_argument("--precision", type=str, default=d.serve_precision,
+                       choices=["f32", "bf16", "int8"])
+    p.add_argument("--selftest", action="store_true",
+                   help="run the router-tier selftest instead of "
+                        "serving: 2 real replicas under load, a REAL "
+                        "mid-run replica SIGKILL, and a blue/green swap "
+                        "mid-load — 0 dropped, 0 closed-to-accepted, 0 "
+                        "post-warmup recompiles on the incoming "
+                        "executor (dasmtl/serve/selftest_router.py)")
+    p.add_argument("--selftest_requests", type=int, default=400)
+    p.add_argument("--selftest_clients", type=int, default=8)
+    args = p.parse_args(argv)
+
+    if args.selftest:
+        from dasmtl.serve.selftest_router import (run_router_selftest,
+                                                  write_router_job_summary)
+
+        report = run_router_selftest(requests=args.selftest_requests,
+                                     clients=args.selftest_clients,
+                                     retry_budget=args.retry_budget)
+        write_router_job_summary(report)
+        return 0 if report["passed"] else 1
+
+    if bool(args.replicas) == bool(args.spawn):
+        p.error("exactly one of --replicas / --spawn is required "
+                "(or --selftest)")
+
+    procs = []
+    if args.spawn:
+        from dasmtl.serve.replica import ReplicaProcess
+
+        serve_args = []
+        n_sources = sum(1 for v in (args.exported, args.model_path,
+                                    args.fresh_init, args.registry) if v)
+        if n_sources != 1:
+            p.error("--spawn needs exactly one model source: "
+                    "--fresh_init / --exported / --model_path / "
+                    "--registry")
+        if args.fresh_init:
+            serve_args.append("--fresh_init")
+        if args.exported:
+            serve_args += ["--exported", args.exported]
+        if args.model_path:
+            serve_args += ["--model_path", args.model_path]
+        if args.registry:
+            serve_args += ["--registry", args.registry]
+        serve_args += ["--model", args.model,
+                       "--precision", args.precision]
+        if args.window:
+            serve_args += ["--window", args.window]
+        if args.buckets:
+            serve_args += ["--buckets", args.buckets]
+        print(f"spawning {args.spawn} replica(s): dasmtl-serve "
+              f"{' '.join(serve_args)}", file=sys.stderr)
+        try:
+            for i in range(args.spawn):
+                procs.append(ReplicaProcess(serve_args, name=f"r{i}"))
+        except RuntimeError as exc:
+            print(f"dasmtl-router: {exc}", file=sys.stderr)
+            for pr in procs:
+                pr.close()
+            return 2
+        handles = [ReplicaHandle(
+            pr.name, pr.address,
+            probe_interval_s=args.probe_interval_s,
+            backoff_max_s=args.probe_backoff_max_s) for pr in procs]
+    else:
+        addrs = [a.strip() for a in args.replicas.split(",") if a.strip()]
+        handles = [ReplicaHandle(
+            f"r{i}", a, probe_interval_s=args.probe_interval_s,
+            backoff_max_s=args.probe_backoff_max_s)
+            for i, a in enumerate(addrs)]
+
+    router = Router(handles, retry_budget=args.retry_budget,
+                    request_timeout_s=args.request_timeout_s).start()
+    httpd = make_router_http_server(router, args.host, args.port)
+    host, port = httpd.server_address[:2]
+    print(f"routing {len(handles)} replica(s) on http://{host}:{port} "
+          f"(POST /infer, GET /healthz, GET /readyz, GET /stats, "
+          f"GET /metrics, POST /rollout); retry budget "
+          f"{args.retry_budget}; SIGTERM stops", file=sys.stderr)
+
+    import signal as _signal
+
+    stop = threading.Event()
+
+    def _stop(signum, frame):  # noqa: ARG001 — signal API shape
+        stop.set()
+
+    for s in (_signal.SIGTERM, _signal.SIGINT):
+        _signal.signal(s, _stop)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    stop.wait()
+    httpd.shutdown()
+    t.join(timeout=10.0)
+    router.close()
+    for pr in procs:
+        pr.close()
+    stats = router.stats()
+    print(f"router stopped; replicas={stats['replicas']}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

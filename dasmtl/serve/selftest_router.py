@@ -1,0 +1,292 @@
+"""Router-tier selftest: the scale-out contract proves itself with REAL
+processes and real failures.
+
+Spins 2 genuine ``dasmtl-serve`` replica processes (fresh-init weights,
+reduced window — identical machinery to production) behind a real
+:class:`~dasmtl.serve.router.Router` + HTTP front end, then runs
+sustained closed-loop load through the router while the two events the
+tier exists to survive actually happen:
+
+1. **a blue/green rollout mid-load** (``POST /rollout``, drain policy):
+   replica by replica — cordon, drain outstanding, ``POST /swap`` (the
+   replica warms the incoming executor in the background and flips
+   atomically), readiness-gated rejoin;
+2. **a real mid-run SIGKILL** of one replica (no drain, no goodbye):
+   in-flight requests to it fail at the transport, the router evicts and
+   retries them on the survivor, and the probe keeps it out of rotation.
+
+Asserted invariants (the ISSUE 9 acceptance criteria, verbatim):
+
+- **0 dropped requests** — every submission resolves with a structured
+  answer (ok / nonfinite / shed), through the kill and the rollout;
+- **0 ``closed`` responses to accepted work** — the rollout never
+  drains a replica's ServeLoop, it only cordons at the router, so no
+  caller ever sees a draining refusal;
+- **0 post-warmup recompiles on the incoming executor** of every
+  swapped replica (scraped from the replica's ``/stats`` after load
+  continued on the new executor — the recompile counter IS the warmth
+  proof);
+- **bounded retries** — total retries <= requests x retry budget, and
+  the SIGKILL demonstrably exercised eviction (>= 1).
+
+Run via ``dasmtl-router --selftest`` / ``python -m dasmtl.serve.router
+--selftest`` — the CI serve job's router leg.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from dasmtl.serve.replica import (HttpTransport, ReplicaHandle,
+                                  ReplicaProcess, TransportError)
+from dasmtl.serve.router import Router, make_router_http_server
+
+#: Reduced-window replica spec (the PR 4 selftest convention: identical
+#: serving machinery, smaller conv stacks).
+_HW = (52, 64)
+_BUCKETS = "1,2,4"
+
+
+def _wait(predicate, timeout_s: float, what: str,
+          interval_s: float = 0.1) -> None:
+    deadline = time.monotonic() + timeout_s
+    while not predicate():
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"timed out after {timeout_s}s waiting "
+                               f"for {what}")
+        time.sleep(interval_s)
+
+
+def _drain(sem: threading.Semaphore, k: int, what: str,
+           per_item_timeout_s: float = 180.0) -> None:
+    """Wait for ``k`` completions; a stalled tier (nothing completing
+    for minutes) is a finding, not a hang."""
+    for _ in range(k):
+        if not sem.acquire(timeout=per_item_timeout_s):
+            raise TimeoutError(f"load stalled while waiting for {what}")
+
+
+def run_router_selftest(*, requests: int = 400, clients: int = 8,
+                        retry_budget: int = 1,
+                        verbose: bool = True) -> dict:
+    """Returns a report dict ``{"passed": bool, "failures": [...], ...}``.
+    ``requests`` paces the phases (load before the rollout, load after
+    the kill); the total served is whatever sustained load produced —
+    the point is that events happen UNDER load, not a fixed count."""
+    say = print if verbose else (lambda *_a, **_k: None)
+    serve_args = ["--fresh_init", "--device", "cpu",
+                  "--window", f"{_HW[0]}x{_HW[1]}",
+                  "--buckets", _BUCKETS, "--max_wait_ms", "2"]
+    failures: list = []
+    outcomes: list = []
+    out_lock = threading.Lock()
+    completed = threading.Semaphore(0)
+    stop = threading.Event()
+    transport = HttpTransport(timeout_s=120.0)
+
+    say(f"[router-selftest] spawning 2 replicas "
+        f"(dasmtl-serve {' '.join(serve_args)}) ...")
+    replicas = [ReplicaProcess(serve_args, name=f"r{i}") for i in range(2)]
+    handles = [ReplicaHandle(r.name, r.address, probe_interval_s=0.1,
+                             backoff_max_s=2.0) for r in replicas]
+    router = Router(handles, retry_budget=retry_budget,
+                    request_timeout_s=120.0, probe_tick_s=0.02).start()
+    httpd = make_router_http_server(router, "127.0.0.1", 0)
+    addr = "%s:%d" % httpd.server_address[:2]
+    http_thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    http_thread.start()
+
+    rng = np.random.default_rng(0)
+    windows = rng.normal(size=(32, *_HW)).astype(np.float32)
+    bodies = [json.dumps({"x": w.tolist()}).encode() for w in windows]
+
+    def client(cid: int) -> None:
+        k = cid
+        while not stop.is_set():
+            try:
+                status, payload = transport.infer_json(
+                    addr, bodies[k % len(bodies)], timeout_s=120.0)
+                rec = (payload.get("error") or "ok", status,
+                       payload.get("router", {}).get("retries", 0))
+            except TransportError as exc:
+                rec = ("DROPPED", 0, str(exc))
+            with out_lock:
+                outcomes.append(rec)
+            completed.release()
+            k += clients
+
+    try:
+        say("[router-selftest] waiting for both replicas to report "
+            "ready (warmup compiles run behind /readyz=503) ...")
+        _wait(lambda: router.stats()["in_rotation"] == 2, 300.0,
+              "both replicas in rotation")
+        threads = [threading.Thread(target=client, args=(c,), daemon=True)
+                   for c in range(clients)]
+        for t in threads:
+            t.start()
+        phase1 = max(50, requests // 4)
+        _drain(completed, phase1, "pre-rollout load")
+        say(f"[router-selftest] {phase1} answered; starting blue/green "
+            f"rollout (drain policy) under sustained load ...")
+        status, payload = transport.request_json(
+            addr, "POST", "/rollout", {"policy": "drain"},
+            timeout_s=30.0)
+        if status != 202:
+            failures.append(f"POST /rollout -> HTTP {status}: {payload}")
+
+        def rollout_state():
+            return transport.request_json(
+                addr, "GET", "/rollout", timeout_s=10.0)[1].get("state")
+
+        _wait(lambda: rollout_state() in ("done", "failed"), 900.0,
+              "rollout to finish", interval_s=0.25)
+        rollout = transport.request_json(addr, "GET", "/rollout",
+                                         timeout_s=10.0)[1]
+        if rollout.get("state") != "done":
+            failures.append(f"rollout did not complete: {rollout}")
+        say(f"[router-selftest] rollout {rollout.get('state')}; steps: "
+            f"{[(s['replica'], s['phase']) for s in rollout.get('steps', [])]}")
+
+        # Load continues on the SWAPPED executors before the kill — the
+        # post-warmup recompile counters scraped at the end cover real
+        # traffic through the incoming executor, not just its warmup.
+        mid = max(50, requests // 4)
+        _drain(completed, mid, "post-rollout load")
+
+        say(f"[router-selftest] SIGKILL replica {replicas[1].name} "
+            f"(pid {replicas[1].proc.pid}) mid-load ...")
+        replicas[1].kill()
+        # Post-kill phase: the survivor must carry everything.
+        _drain(completed, max(100, requests // 2), "post-kill load")
+    except (TimeoutError, TransportError, RuntimeError) as exc:
+        failures.append(f"{type(exc).__name__}: {exc}")
+        for r in replicas:
+            say(f"[router-selftest] --- {r.name} log tail ---\n"
+                f"{r.log_tail()}")
+    finally:
+        stop.set()
+        time.sleep(0.2)  # let clients notice before teardown
+
+    with out_lock:
+        n = len(outcomes)
+        dropped = [o for o in outcomes if o[0] == "DROPPED"]
+        closed = [o for o in outcomes if o[0] == "closed"]
+        by_outcome: dict = {}
+        for o in outcomes:
+            by_outcome[o[0]] = by_outcome.get(o[0], 0) + 1
+        max_retries = max((o[2] for o in outcomes
+                           if isinstance(o[2], int)), default=0)
+        total_retries = sum(o[2] for o in outcomes
+                            if isinstance(o[2], int))
+
+    if dropped:
+        failures.append(f"{len(dropped)} request(s) DROPPED (no "
+                        f"structured answer), e.g. {dropped[0]}")
+    if closed:
+        failures.append(f"{len(closed)} request(s) answered 'closed' — "
+                        f"the rollout leaked a draining refusal to an "
+                        f"accepted caller")
+    for bad in ("no_replica", "unreachable", "error"):
+        if by_outcome.get(bad):
+            failures.append(f"{by_outcome[bad]} request(s) ended "
+                            f"{bad!r} — the retry policy failed to "
+                            f"place them")
+    if max_retries > retry_budget:
+        failures.append(f"a request recorded {max_retries} retries > "
+                        f"budget {retry_budget}")
+    router_stats = router.stats()
+    evictions = sum(r["evictions"] for r in router_stats["replicas"])
+    if evictions < 1:
+        failures.append("SIGKILL produced no eviction — the transport-"
+                        "failure path never fired")
+
+    # Survivor: generation advanced by the rollout AND zero post-warmup
+    # recompiles on the incoming executor after serving real load.
+    survivor = replicas[0]
+    surv_stats: Optional[dict] = None
+    try:
+        surv_stats = transport.stats(survivor.address)
+        health = transport.request_json(survivor.address, "GET",
+                                        "/healthz", timeout_s=10.0)[1]
+        if health.get("generation", 1) < 2:
+            failures.append(f"survivor {survivor.name} never swapped "
+                            f"(generation {health.get('generation')})")
+        ex = surv_stats.get("executor", {})
+        if ex.get("post_warmup_compiles", 0):
+            failures.append(
+                f"incoming executor on {survivor.name} recompiled "
+                f"{ex['post_warmup_compiles']}x post-warmup — the "
+                f"background warmup missed a (bucket, device) executable")
+        for member in ex.get("per_device", []):
+            if member.get("post_warmup_compiles", 0):
+                failures.append(f"{survivor.name} device "
+                                f"{member.get('placement')}: post-warmup "
+                                f"recompiles on the incoming executor")
+    except TransportError as exc:
+        failures.append(f"survivor {survivor.name} unreachable at the "
+                        f"end: {exc}")
+
+    say("[router-selftest] shutting down ...")
+    httpd.shutdown()
+    http_thread.join(timeout=10.0)
+    router.close()
+    for r in replicas:
+        r.close()
+
+    report = {
+        "passed": not failures,
+        "failures": failures,
+        "requests_served": n,
+        "outcomes": by_outcome,
+        "dropped": len(dropped),
+        "closed_to_accepted": len(closed),
+        "total_retries": total_retries,
+        "max_retries_per_request": max_retries,
+        "retry_budget": retry_budget,
+        "evictions": evictions,
+        "rollout": router_stats.get("rollout"),
+        "survivor_stats": {
+            "post_warmup_compiles": (surv_stats or {}).get(
+                "executor", {}).get("post_warmup_compiles"),
+            "warmup_s": (surv_stats or {}).get("warmup_s"),
+        },
+        "replicas": router_stats["replicas"],
+    }
+    say(f"[router-selftest] {n} answered ({by_outcome}); retries "
+        f"{total_retries} (max/request {max_retries}); evictions "
+        f"{evictions}; dropped {len(dropped)}; closed {len(closed)}")
+    for f in failures:
+        say(f"[router-selftest] FAIL: {f}")
+    say(f"[router-selftest] {'PASSED' if report['passed'] else 'FAILED'}")
+    return report
+
+
+def write_router_job_summary(report: dict,
+                             path: Optional[str] = None) -> None:
+    """Append a markdown summary to CI's ``$GITHUB_STEP_SUMMARY``."""
+    path = path or os.environ.get("GITHUB_STEP_SUMMARY")
+    if not path:
+        return
+    lines = [
+        "### router selftest (2 replicas, SIGKILL + blue/green swap "
+        "mid-load)",
+        "",
+        f"- passed: **{report['passed']}**",
+        f"- requests served: **{report['requests_served']}** "
+        f"({report['outcomes']})",
+        f"- dropped: **{report['dropped']}**; closed-to-accepted: "
+        f"**{report['closed_to_accepted']}**",
+        f"- retries: {report['total_retries']} total, max "
+        f"{report['max_retries_per_request']}/request "
+        f"(budget {report['retry_budget']}); evictions "
+        f"{report['evictions']}",
+        f"- rollout: {report.get('rollout', {}).get('state')}",
+    ]
+    with open(path, "a", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")

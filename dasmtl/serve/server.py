@@ -39,6 +39,25 @@ flight are collected) and every later submit resolves instantly with
 ``begin_drain`` — signal-safe because it only flips flags and notifies;
 the blocking wait stays in the main loop.
 
+**Blue/green executor swap** (``swap_executor`` / ``swap_to``): a new
+executor (typically built from the artifact registry,
+:class:`dasmtl.export.ArtifactRegistry`) is warmed OFF the serving path —
+every (bucket, device, precision) executable compiled while the old
+executor keeps answering — then the data plane flips atomically.  Each
+dispatched batch snapshots the executor+staging pair it launched
+through, so in-flight batches collect through the OUTGOING executor
+after the flip, and the outgoing executor closes only once its last
+in-flight batch has collected.  Zero dropped requests, zero ``closed``
+refusals, and zero post-warmup recompiles on the incoming executor —
+the selftests assert all three under sustained load.
+
+**Liveness vs readiness**: ``/healthz`` answers as soon as the HTTP
+front end binds (liveness — the process is up), while ``GET /readyz``
+is 503 until warmup has compiled every bucket and flips back to 503
+during drain (readiness — safe to route traffic here).  The router
+tier (:mod:`dasmtl.serve.router`) probes ``/readyz``, so a replica
+still compiling buckets never sees traffic.
+
 The HTTP front end is deliberately stdlib-only (``http.server``): a
 thread-per-connection ``ThreadingHTTPServer`` whose POST handler blocks on
 ``loop.submit`` — concurrency and batching live in the loop, not the
@@ -136,6 +155,16 @@ class ServeLoop:
         self._collector: Optional[threading.Thread] = None
         self._warmup_s: Optional[float] = None
         self._inflight = 0  # dispatched-but-uncollected batches (stats)
+        # -- blue/green swap state (docstring above; docs/SERVING.md) --------
+        # generation counts executor flips (1 = the executor start() warmed);
+        # _outstanding maps id(executor) -> dispatched-but-uncollected
+        # batches through THAT executor, so a retired executor closes only
+        # after its last in-flight batch collects.
+        self.generation = 1
+        self._outstanding: dict = {}
+        self._retired: list = []
+        self._swap_lock = threading.Lock()
+        self._swap = {"state": "idle"}
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ServeLoop":
@@ -178,11 +207,109 @@ class ServeLoop:
 
     def close(self) -> None:
         self.drain(timeout=30.0)
+        with self._cv:
+            retired, self._retired = list(self._retired), []
+        for ex in retired:
+            ex.close()
         self.executor.close()
 
     @property
     def draining(self) -> bool:
         return self.batcher.draining
+
+    @property
+    def ready(self) -> bool:
+        """Readiness (vs liveness): warm — every bucket compiled — and
+        not draining.  ``GET /readyz`` and the router tier's probe are
+        exactly this bit; it stays True during a blue/green swap (the
+        outgoing executor keeps serving until the flip)."""
+        return self._warmup_s is not None and not self.batcher.draining
+
+    # -- blue/green executor swap --------------------------------------------
+    def swap_executor(self, new_executor, warm: bool = True) -> float:
+        """Warm ``new_executor`` (every bucket — the recompile counter
+        proves warmth), then atomically flip the data plane onto it.
+        Requests keep flowing throughout: the old executor serves until
+        the flip, in-flight batches collect through it afterwards, and it
+        closes once its last batch drains.  Returns warmup seconds."""
+        if tuple(new_executor.input_hw) != tuple(self.executor.input_hw):
+            raise ValueError(
+                f"incoming executor takes {new_executor.input_hw} windows, "
+                f"serving {self.executor.input_hw} — blue/green swap "
+                f"cannot change the window shape; roll new replicas")
+        if tuple(new_executor.buckets) != tuple(self.batcher.buckets):
+            raise ValueError(
+                f"incoming executor compiled buckets "
+                f"{tuple(new_executor.buckets)}, the batcher flushes "
+                f"{tuple(self.batcher.buckets)} — a mismatch would be a "
+                f"post-warmup recompile; rebuild with matching --buckets")
+        warmup_s = new_executor.warmup() if warm else 0.0
+        new_dtype = np.dtype(getattr(new_executor, "input_dtype",
+                                     np.float32))
+        new_staging = self._staging
+        if new_dtype != np.dtype(getattr(self.executor, "input_dtype",
+                                         np.float32)):
+            # Precision changed across the swap: fresh staging in the
+            # incoming dtype.  Old buffers drain back to the old pool
+            # (each in-flight batch carries its own staging snapshot).
+            new_staging = StagingBuffers.for_buckets(
+                self.batcher.buckets, new_executor.input_hw,
+                depth=self.inflight_window + 1, dtype=new_dtype)
+        with self._cv:
+            outgoing = self.executor
+            self.executor = new_executor
+            self._staging = new_staging
+            self._retired.append(outgoing)
+            self.generation += 1
+        # Reap immediately if nothing was in flight through the old one.
+        to_close = []
+        with self._cv:
+            for ex in list(self._retired):
+                if not self._outstanding.get(id(ex)):
+                    self._retired.remove(ex)
+                    to_close.append(ex)
+        for ex in to_close:
+            ex.close()
+        return warmup_s
+
+    def swap_to(self, builder, version=None) -> dict:
+        """Drive one full blue/green swap from an executor ``builder``
+        (``builder(version) -> executor``, e.g. a registry load): build,
+        warm, flip — recording progress in the ``swap`` status dict that
+        ``/healthz`` and ``GET /swap`` expose so a router can poll the
+        rollout.  One swap at a time; a second request while warming is
+        refused (status unchanged)."""
+        with self._swap_lock:
+            if self._swap.get("state") == "warming":
+                return {"state": "refused",
+                        "detail": "a swap is already warming",
+                        "current": dict(self._swap)}
+            self._swap = {"state": "warming", "version": version,
+                          "started_t": time.time()}
+        try:
+            new_executor = builder(version)
+            warmup_s = self.swap_executor(new_executor)
+            status = {
+                "state": "done", "version": version,
+                "generation": self.generation,
+                "warmup_s": round(warmup_s, 3),
+                "source": getattr(new_executor, "source", "?"),
+                "precision": getattr(new_executor, "precision", "f32"),
+                "incoming_post_warmup_recompiles": getattr(
+                    new_executor, "post_warmup_compiles", 0),
+            }
+        except Exception as exc:  # noqa: BLE001 — a failed swap is status
+            status = {"state": "failed", "version": version,
+                      "detail": f"{type(exc).__name__}: {exc}",
+                      "generation": self.generation}
+        with self._swap_lock:
+            self._swap = status
+        return status
+
+    @property
+    def swap_status(self) -> dict:
+        with self._swap_lock:
+            return dict(self._swap)
 
     @property
     def inflight_depth(self) -> int:
@@ -235,15 +362,24 @@ class ServeLoop:
         self.metrics.observe_stage(
             "queue_wait", max(0.0, t_taken - plan.requests[0].enqueue_t))
         self._slots.acquire()  # the bounded in-flight window
-        buf = self._staging.acquire(plan.bucket)
+        # Snapshot the executor+staging PAIR under the lock: a blue/green
+        # flip may swap both mid-flight, and this batch must assemble into,
+        # dispatch through, and release back to the pair it started with.
+        with self._cv:
+            executor = self.executor
+            staging = self._staging
+            self._outstanding[id(executor)] = \
+                self._outstanding.get(id(executor), 0) + 1
+        buf = staging.acquire(plan.bucket)
         t_form = self.clock()
         try:
             plan.assemble_into(buf)
             t_formed = self.clock()
-            handle = self.executor.dispatch(buf)
+            handle = executor.dispatch(buf)
         except Exception as exc:  # noqa: BLE001 — must answer the callers
-            self._staging.release(buf)
+            staging.release(buf)
             self._slots.release()
+            self._executor_done(executor)
             self._fail_plan(plan, exc)
             return
         self.metrics.observe_stage("form", t_formed - t_form)
@@ -266,7 +402,25 @@ class ServeLoop:
         with self._cv:
             self._inflight += 1
             self.metrics.observe_inflight(self._inflight)
-        self._completion.put((plan, handle, buf))
+        self._completion.put((plan, handle, buf, staging, executor))
+
+    def _executor_done(self, executor) -> None:
+        """One batch through ``executor`` finished (collected or failed):
+        drop its outstanding count and close any RETIRED executor whose
+        count reached zero — the outgoing side of a blue/green flip."""
+        to_close = []
+        with self._cv:
+            left = self._outstanding.get(id(executor), 1) - 1
+            if left <= 0:
+                self._outstanding.pop(id(executor), None)
+            else:
+                self._outstanding[id(executor)] = left
+            for ex in list(self._retired):
+                if not self._outstanding.get(id(ex)):
+                    self._retired.remove(ex)
+                    to_close.append(ex)
+        for ex in to_close:
+            ex.close()
 
     # -- stage 2: collector --------------------------------------------------
     def _collect_loop(self) -> None:
@@ -274,17 +428,21 @@ class ServeLoop:
             item = self._completion.get()
             if item is _SENTINEL:
                 return
-            plan, handle, buf = item
+            plan, handle, buf, staging, executor = item
             t0 = self.clock()
             try:
-                preds, bad, log_probs = self.executor.collect(
+                # Collection routes through the executor that DISPATCHED
+                # the batch (recorded on the snapshot), so a blue/green
+                # flip mid-flight never misroutes a device buffer.
+                preds, bad, log_probs = executor.collect(
                     handle, want_log_probs=plan.want_log_probs)
             except Exception as exc:  # noqa: BLE001 — answer the callers
                 self._fail_plan(plan, exc)
                 continue
             finally:
-                self._staging.release(buf)
+                staging.release(buf)
                 self._slots.release()
+                self._executor_done(executor)
                 with self._cv:
                     self._inflight -= 1
                     self._cv.notify_all()
@@ -446,11 +604,23 @@ class ServeLoop:
         return render_prometheus(default_registry(), reg)
 
     def healthz(self) -> dict:
+        """Liveness payload (``GET /healthz`` — always 200 while the
+        process answers) PLUS the ``ready`` bit ``GET /readyz`` gates on:
+        false while warmup is still compiling buckets and again during
+        drain.  ``generation``/``source``/``swap`` let the router tier
+        confirm a blue/green rollout landed on this replica."""
+        warming = self._warmup_s is None and not self.batcher.draining
         return {
-            "status": "draining" if self.batcher.draining else "serving",
+            "status": ("draining" if self.batcher.draining
+                       else "warming" if warming else "serving"),
+            "ready": self.ready,
             "warm": self._warmup_s is not None,
             "queue_depth": self.batcher.depth,
             "inflight": self.inflight_depth,
+            "generation": self.generation,
+            "source": getattr(self.executor, "source", "?"),
+            "precision": getattr(self.executor, "precision", "f32"),
+            "swap": self.swap_status,
             "post_warmup_recompiles": getattr(
                 self.executor, "post_warmup_compiles", 0),
         }
@@ -476,9 +646,12 @@ def install_signal_handlers(loop: ServeLoop,
 # -- HTTP front end -----------------------------------------------------------
 
 
-def _make_handler(loop: ServeLoop, request_timeout_s: float):
+def _make_handler(loop: ServeLoop, request_timeout_s: float,
+                  swap_builder=None):
     """Handler class closed over the loop (BaseHTTPRequestHandler is
-    instantiated per connection by the server, so state rides the class)."""
+    instantiated per connection by the server, so state rides the class).
+    ``swap_builder(version) -> executor`` arms ``POST /swap`` — the
+    replica half of the router tier's blue/green rollout."""
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -503,6 +676,15 @@ def _make_handler(loop: ServeLoop, request_timeout_s: float):
             if url.path == "/healthz":
                 h = loop.healthz()
                 self._reply(503 if h["status"] == "draining" else 200, h)
+            elif url.path == "/readyz":
+                # Readiness (router-facing): 503 while warmup is still
+                # compiling buckets AND during drain — /healthz stays the
+                # liveness view (200 while warming).
+                h = loop.healthz()
+                self._reply(200 if h["ready"] else 503, h)
+            elif url.path == "/swap":
+                self._reply(200, {"swap": loop.swap_status,
+                                  "generation": loop.generation})
             elif url.path == "/stats":
                 self._reply(200, loop.stats())
             elif url.path == "/metrics":
@@ -533,6 +715,41 @@ def _make_handler(loop: ServeLoop, request_timeout_s: float):
                 self._reply(200, {"triggered": path is not None,
                                   "capture_dir": path,
                                   "profiler": loop.profiler.summary()})
+                return
+            if self.path == "/swap":
+                # Blue/green rollout, replica side: build + warm the new
+                # executor in the BACKGROUND (old one keeps serving), flip
+                # atomically when warm.  202 now; poll GET /swap (or
+                # /healthz "swap"/"generation") for completion.
+                if swap_builder is None:
+                    self._reply(503, {"swap": {
+                        "state": "unavailable",
+                        "detail": "this replica was started without a "
+                                  "swappable model source"}})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n)) if n else {}
+                    version = body.get("version")
+                except (ValueError, json.JSONDecodeError) as exc:
+                    self._reply(400, {"error": "bad_request",
+                                      "detail": f"expected JSON "
+                                                f'{{"version": ...}}: '
+                                                f"{exc}"})
+                    return
+                with loop._swap_lock:
+                    busy = loop._swap.get("state") == "warming"
+                if busy:
+                    self._reply(409, {"swap": loop.swap_status,
+                                      "detail": "a swap is already "
+                                                "warming"})
+                    return
+                threading.Thread(
+                    target=loop.swap_to, args=(swap_builder, version),
+                    name="dasmtl-serve-swap", daemon=True).start()
+                self._reply(202, {"swap": {"state": "started",
+                                           "version": version},
+                                  "generation": loop.generation})
                 return
             if self.path != "/infer":
                 self._reply(404, {"error": f"unknown path {self.path}"})
@@ -580,9 +797,11 @@ def _make_handler(loop: ServeLoop, request_timeout_s: float):
 
 
 def make_http_server(loop: ServeLoop, host: str = "127.0.0.1",
-                     port: int = 0, request_timeout_s: float = 30.0
-                     ) -> ThreadingHTTPServer:
+                     port: int = 0, request_timeout_s: float = 30.0,
+                     swap_builder=None) -> ThreadingHTTPServer:
     """Bind (port 0 = ephemeral; read ``server_address[1]``) but do not
-    serve — callers run ``serve_forever`` and ``shutdown`` themselves."""
+    serve — callers run ``serve_forever`` and ``shutdown`` themselves.
+    ``swap_builder(version) -> executor`` arms ``POST /swap``."""
     return ThreadingHTTPServer((host, port),
-                               _make_handler(loop, request_timeout_s))
+                               _make_handler(loop, request_timeout_s,
+                                             swap_builder))

@@ -135,8 +135,24 @@ def collect() -> dict:
         "inflight": d.serve_inflight,
         "devices": d.serve_devices,
         "shard_largest": d.serve_shard_largest,
+        "shard_multihost": d.serve_shard_multihost,
         "precision": d.serve_precision,
     }
+
+    # Replica router tier (dasmtl/serve/router.py, docs/SERVING.md
+    # "Router tier & blue/green rollout"): the resolved router config
+    # plus the artifact registry's available versions (the blue/green
+    # rollout's source of truth) when one is configured.
+    info["router_defaults"] = {
+        "replicas": d.router_replicas,
+        "endpoint": f"{d.router_host}:{d.router_port}",
+        "replica_ports": list(d.router_replica_ports) or "ephemeral",
+        "retry_budget": d.router_retry_budget,
+        "probe_interval_s": d.router_probe_interval_s,
+        "probe_backoff_max_s": d.router_probe_backoff_max_s,
+        "swap_policy": d.router_swap_policy,
+    }
+    info["artifact_registry"] = _registry_summary(d.serve_registry_dir)
 
     # Unified telemetry layer (dasmtl/obs/, docs/OBSERVABILITY.md): the
     # resolved obs config — heartbeat cadence, latency buckets, trace
@@ -172,6 +188,27 @@ def collect() -> dict:
         "determinism_baseline": _determinism_baseline_summary(),
     }
     return info
+
+
+def _registry_summary(root: Optional[str]) -> dict:
+    """Available versions of the serving-artifact registry — header
+    metadata only (dasmtl.export.ArtifactRegistry reads the container
+    headers; nothing is deserialized or compiled here)."""
+    if not root:
+        return {"status": "not-configured",
+                "hint": "set --serve_registry_dir / publish with "
+                        "dasmtl-export --registry DIR"}
+    from dasmtl.export import ArtifactRegistry
+
+    entries = ArtifactRegistry(root).versions()
+    if not entries:
+        return {"path": root, "status": "empty"}
+    return {"path": root, "status": "ok",
+            "versions": [
+                {k: e.get(k) for k in ("version", "file", "model",
+                                       "precision", "input_hw", "corrupt")
+                 if e.get(k) is not None}
+                for e in entries]}
 
 
 def _audit_baseline_summary() -> dict:
@@ -252,8 +289,14 @@ def main(argv=None) -> int:
                     help="with --exported: also require the artifact's "
                          "recorded precision preset to match (the other "
                          "half of the dasmtl-serve startup check)")
+    ap.add_argument("--registry", type=str, default=None, metavar="DIR",
+                    help="list a serving-artifact registry's available "
+                         "versions (what a router blue/green rollout "
+                         "can resolve — docs/SERVING.md 'Router tier')")
     args = ap.parse_args(argv)
     info = collect()
+    if args.registry:
+        info["artifact_registry"] = _registry_summary(args.registry)
     rc = 0
     if args.exported:
         info["exported_artifact"] = check_exported_artifact(
@@ -300,6 +343,21 @@ def main(argv=None) -> int:
     print("  serve defaults: " + ", ".join(
         f"{k}={v}" for k, v in info["serve_defaults"].items())
         + " (dasmtl-serve; docs/SERVING.md)")
+    print("  router defaults: " + ", ".join(
+        f"{k}={v}" for k, v in info["router_defaults"].items())
+        + " (dasmtl-router; docs/SERVING.md 'Router tier')")
+    reg = info.get("artifact_registry", {})
+    if reg.get("status") == "ok":
+        vs = ", ".join(
+            f"v{e['version']} {e.get('model')}/{e.get('precision')}"
+            + (" CORRUPT" if e.get("corrupt") else "")
+            for e in reg["versions"])
+        print(f"  artifact registry: {reg['path']} — {vs} "
+              f"(blue/green rollouts resolve here)")
+    else:
+        print(f"  artifact registry: {reg.get('status')}"
+              + (f" at {reg['path']}" if reg.get("path") else "")
+              + (f" — {reg['hint']}" if reg.get("hint") else ""))
     ob = info["obs"]
     print(f"  obs: heartbeat_s={ob['heartbeat_s']} "
           f"trace_ring={ob['trace_ring']} "

@@ -50,6 +50,17 @@ else
     echo "== dasmtl serve selftest skipped (DASMTL_LINT_SKIP_SERVE set)"
 fi
 
+# Router-tier smoke: 2 real replica processes behind a real router,
+# blue/green rollout + SIGKILL under load (dasmtl/serve/router.py,
+# docs/SERVING.md "Router tier").  Spawns subprocesses and compiles two
+# replicas' buckets, so skippable alongside the serve smoke.
+if [ "${DASMTL_LINT_SKIP_ROUTER:-}" = "" ]; then
+    echo "== dasmtl router --selftest"
+    python -m dasmtl.serve.router --selftest || rc=1
+else
+    echo "== router selftest skipped (DASMTL_LINT_SKIP_ROUTER set)"
+fi
+
 # Precision parity gate: both reduced serving presets vs the f32
 # reference on the tiny seeded model (ints on decisive windows,
 # log-prob tolerance, NaN-mask identity — dasmtl/serve/parity.py).

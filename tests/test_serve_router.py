@@ -1,0 +1,551 @@
+"""Router-tier unit tests (dasmtl/serve/router.py + replica.py).
+
+The replica contract is tested as a PURE state machine — fake clock,
+scripted transports, zero real processes — mirroring the
+``MicroBatcher.take_batch(now)`` pattern: placement under skewed
+outstanding counts, the single-bounded-retry-on-shed policy, eviction +
+re-probe backoff, and blue/green rollout ordering are all asserted
+deterministically.  The in-process ServeLoop swap tests drive the real
+data plane over the fake executors from tests/test_serve.py.  The
+real-process leg (2 replicas, SIGKILL, HTTP) lives in the router
+selftest (``dasmtl-router --selftest``; the slow pytest wrapper here).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dasmtl.obs.registry import MetricsRegistry, parse_exposition
+from dasmtl.serve import (ReplicaHandle, Router, RouterCore, ServeLoop,
+                          TransportError, aggregate_expositions,
+                          make_http_server)
+from test_serve import HW, FakeClock, FakeExecutor, GatedExecutor, win
+
+
+def handle(name="r0", address=None, interval=1.0, backoff=30.0):
+    return ReplicaHandle(name, address or f"{name}:80",
+                         probe_interval_s=interval, backoff_max_s=backoff)
+
+
+def ready_handle(**kw):
+    h = handle(**kw)
+    h.on_probe_ok(0.0, {"ready": True, "generation": 1})
+    return h
+
+
+# -- ReplicaHandle: the contract as a state machine ---------------------------
+
+
+def test_replica_starts_probing_and_joins_on_ready_probe():
+    h = handle()
+    assert h.state == "probing" and not h.in_rotation
+    assert h.next_probe_at() == float("-inf")  # due immediately
+    h.on_probe_ok(10.0, {"ready": False, "generation": 1})
+    assert h.state == "probing"  # warming/draining: clean not-yet
+    assert h.next_probe_at() == pytest.approx(11.0)  # plain interval
+    h.on_probe_ok(11.0, {"ready": True, "generation": 1})
+    assert h.in_rotation and h.generation == 1
+
+
+def test_replica_eviction_backoff_doubles_and_caps():
+    h = handle(interval=1.0, backoff=4.0)
+    h.on_probe_ok(0.0, {"ready": True})
+    t = 100.0
+    h.evict(t, "connection reset")
+    assert not h.in_rotation
+    assert h.next_probe_at() == pytest.approx(t + 1.0)  # 1 * 2^0
+    h.on_probe_fail(t + 1.0, "refused")
+    assert h.next_probe_at() == pytest.approx(t + 1.0 + 2.0)
+    h.on_probe_fail(t + 3.0, "refused")
+    assert h.next_probe_at() == pytest.approx(t + 3.0 + 4.0)
+    h.on_probe_fail(t + 7.0, "refused")  # capped at backoff_max
+    assert h.next_probe_at() == pytest.approx(t + 7.0 + 4.0)
+    # Recovery resets the failure ladder.
+    h.on_probe_ok(t + 11.0, {"ready": True})
+    assert h.in_rotation and h.failures == 0
+
+
+def test_replica_cordon_is_orthogonal_to_health():
+    h = ready_handle()
+    h.cordon()
+    assert h.state == "ready" and not h.in_rotation
+    h.uncordon()
+    assert h.in_rotation
+
+
+# -- RouterCore: placement ----------------------------------------------------
+
+
+def test_least_outstanding_placement_under_skewed_latency():
+    """A slow replica accumulates outstanding requests; placement must
+    drift to the fast ones (this is the whole point of the policy)."""
+    slow, fast, mid = (ready_handle(name=n) for n in ("slow", "fast",
+                                                      "mid"))
+    for _ in range(5):
+        slow.on_send()
+    mid.on_send()
+    core = RouterCore([slow, fast, mid])
+    assert core.pick().name == "fast"
+    fast.on_send()
+    fast.on_send()
+    assert core.pick().name == "mid"
+
+
+def test_tied_placement_round_robins():
+    a, b = ready_handle(name="a"), ready_handle(name="b")
+    core = RouterCore([a, b])
+    picks = [core.pick().name for _ in range(4)]
+    assert sorted(picks[:2]) == ["a", "b"] and picks[:2] == picks[2:]
+
+
+def test_pick_honors_exclusion_and_rotation():
+    a, b = ready_handle(name="a"), ready_handle(name="b")
+    core = RouterCore([a, b])
+    assert core.pick(exclude=[a.address]).name == "b"
+    b.evict(0.0, "down")
+    assert core.pick(exclude=[a.address]) is None
+    assert core.pick().name == "a"
+
+
+# -- Router data path: scripted transports, no threads ------------------------
+
+
+class ScriptedTransport:
+    """Replica surface as a script: per-address infer behavior, probe
+    payloads, recorded call order."""
+
+    def __init__(self, behaviors):
+        self.behaviors = dict(behaviors)
+        self.calls = []
+
+    def infer(self, address, body, timeout_s=None):
+        self.calls.append(("infer", address))
+        beh = self.behaviors[address]
+        if isinstance(beh, Exception):
+            raise beh
+        if callable(beh):
+            return beh()
+        return beh
+
+    def probe(self, address, timeout_s=None):
+        self.calls.append(("probe", address))
+        return {"ready": True, "generation": 1}
+
+    def metrics_text(self, address):
+        return ""
+
+
+def make_router(handles, behaviors, retry_budget=1):
+    return Router(handles, transport=ScriptedTransport(behaviors),
+                  retry_budget=retry_budget, clock=FakeClock())
+
+
+def test_single_bounded_retry_on_shed():
+    a, b = ready_handle(name="a"), ready_handle(name="b")
+    shed = (503, {"ok": False, "error": "shed", "detail": "watermark"})
+    ok = (200, {"ok": True, "predictions": {"event": 1}})
+    router = make_router([a, b], {a.address: shed, b.address: ok})
+    status, payload = router.handle_infer(b"{}")
+    # Whichever replica went first shed; the ONE retry landed elsewhere.
+    assert status == 200 and payload["ok"]
+    assert payload["router"]["retries"] == 1
+    infers = [c for c in router.transport.calls if c[0] == "infer"]
+    assert len(infers) == 2 and infers[0][1] != infers[1][1]
+    # Shedding is load, not death: the shedder stays in rotation.
+    assert a.in_rotation and b.in_rotation
+
+
+def test_retry_budget_exhaustion_returns_the_shed_answer():
+    a, b = ready_handle(name="a"), ready_handle(name="b")
+    shed = (503, {"ok": False, "error": "shed", "detail": "watermark"})
+    router = make_router([a, b], {a.address: shed, b.address: shed},
+                         retry_budget=1)
+    status, payload = router.handle_infer(b"{}")
+    assert status == 503 and payload["error"] == "shed"
+    assert payload["router"]["exhausted"] is True
+    assert len([c for c in router.transport.calls
+                if c[0] == "infer"]) == 2  # 1 + budget, never more
+
+
+def test_connection_failure_evicts_and_retries_elsewhere():
+    a, b = ready_handle(name="a"), ready_handle(name="b")
+    ok = (200, {"ok": True, "predictions": {"event": 0}})
+    router = make_router(
+        [a, b], {a.address: TransportError("connection refused"),
+                 b.address: ok})
+    # Force the failing replica to be tried first (least outstanding).
+    b.on_send()
+    status, payload = router.handle_infer(b"{}")
+    assert status == 200 and payload["router"]["retries"] == 1
+    assert not a.in_rotation and a.state == "probing"
+    assert a.next_probe_at() > 0  # backoff scheduled, not hammered
+    assert a.outstanding == 0  # the failed send was released
+
+
+def test_closed_answer_takes_replica_out_of_rotation():
+    a, b = ready_handle(name="a"), ready_handle(name="b")
+    closed = (503, {"ok": False, "error": "closed",
+                    "detail": "draining"})
+    ok = (200, {"ok": True, "predictions": {"event": 0}})
+    router = make_router([a, b], {a.address: closed, b.address: ok})
+    b.on_send()  # a goes first
+    status, payload = router.handle_infer(b"{}")
+    assert status == 200 and payload["ok"]
+    assert not a.in_rotation  # draining replica left rotation
+
+
+def test_no_replica_is_a_structured_503():
+    a = handle(name="a")  # still probing — never joined rotation
+    router = make_router([a], {a.address: (200, {"ok": True})})
+    status, payload = router.handle_infer(b"{}")
+    assert status == 503 and payload["error"] == "no_replica"
+    assert "detail" in payload
+
+
+# -- Rollout ordering ---------------------------------------------------------
+
+
+class RolloutTransport:
+    """Replicas that swap instantly; every call recorded in order."""
+
+    def __init__(self, fail_at=None):
+        self.calls = []
+        self.generations = {}
+        self.fail_at = fail_at
+
+    def infer(self, address, body, timeout_s=None):
+        return (200, {"ok": True})
+
+    def probe(self, address, timeout_s=None):
+        self.calls.append(("probe", address))
+        return {"ready": True,
+                "generation": self.generations.get(address, 1)}
+
+    def swap(self, address, version=None, timeout_s=None):
+        self.calls.append(("swap", address))
+        if address == self.fail_at:
+            return (202, {"swap": {"state": "started"}})
+        self.generations[address] = self.generations.get(address, 1) + 1
+        return (202, {"swap": {"state": "started"}})
+
+    def swap_status(self, address):
+        state = "failed" if address == self.fail_at else "done"
+        detail = "injected swap failure" if state == "failed" else None
+        return {"swap": {"state": state, "detail": detail}}
+
+    def metrics_text(self, address):
+        return ""
+
+
+def wait_rollout(router, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while router.rollout_status["state"] == "running":
+        assert time.monotonic() < deadline, "rollout never finished"
+        time.sleep(0.01)
+    return router.rollout_status
+
+
+def test_rollout_swaps_one_replica_at_a_time_in_order():
+    a, b = ready_handle(name="a"), ready_handle(name="b")
+    transport = RolloutTransport()
+    router = Router([a, b], transport=transport)
+    status = router.rollout(policy="drain")
+    assert status["state"] in ("running", "done")  # thread may be quick
+    final = wait_rollout(router)
+    assert final["state"] == "done"
+    swaps = [c[1] for c in transport.calls if c[0] == "swap"]
+    assert swaps == [a.address, b.address]  # strictly replica-by-replica
+    assert [s["phase"] for s in final["steps"]] == ["done", "done"]
+    assert a.in_rotation and b.in_rotation  # both rejoined
+    # A second rollout while one runs would be refused; after done it
+    # starts fresh.
+    assert router.rollout(policy="hot")["state"] in ("running", "done")
+    assert wait_rollout(router)["state"] == "done"
+
+
+def test_rollout_drain_waits_for_outstanding_requests():
+    """The cordoned replica must reach outstanding == 0 BEFORE its swap
+    is issued — the drain half of drain→swap→rejoin."""
+    a, b = ready_handle(name="a"), ready_handle(name="b")
+    a.on_send()  # one request in flight at rollout start
+    transport = RolloutTransport()
+    router = Router([a, b], transport=transport)
+    router.rollout(policy="drain", drain_timeout_s=5.0)
+    time.sleep(0.15)  # rollout thread is now waiting on the drain
+    assert [c for c in transport.calls if c[0] == "swap"] == []
+    assert a.cordoned and a.state == "ready"
+    a.on_done()  # the in-flight request completes
+    final = wait_rollout(router)
+    assert final["state"] == "done"
+    assert [c[1] for c in transport.calls
+            if c[0] == "swap"] == [a.address, b.address]
+
+
+def test_rollout_stops_on_failed_swap_and_keeps_replica_cordoned():
+    a, b = ready_handle(name="a"), ready_handle(name="b")
+    transport = RolloutTransport(fail_at=a.address)
+    router = Router([a, b], transport=transport)
+    router.rollout(policy="drain")
+    final = wait_rollout(router)
+    assert final["state"] == "failed"
+    assert "injected swap failure" in final["detail"]
+    # The bad artifact never reached the second replica.
+    assert [c[1] for c in transport.calls if c[0] == "swap"] == [a.address]
+    assert a.cordoned and not a.in_rotation  # quarantined for the runbook
+    assert b.in_rotation  # the healthy replica keeps serving
+
+
+# -- metrics aggregation ------------------------------------------------------
+
+
+def test_aggregate_expositions_adds_replica_label_and_round_trips():
+    def scrape(n_ok):
+        reg = MetricsRegistry()
+        c = reg.counter("dasmtl_serve_requests_total", "by outcome",
+                        labelnames=("outcome",))
+        c.inc(n_ok, ("ok",))
+        reg.gauge("dasmtl_serve_queue_depth", "queued").set(3)
+        return reg.render()
+
+    text = aggregate_expositions({"r0": scrape(5), "r1": scrape(7)})
+    families = parse_exposition(text)
+    fam = families["dasmtl_serve_requests_total"]
+    assert fam["type"] == "counter"
+    values = {labels: v for (name, labels), v in fam["samples"].items()}
+    assert values[(("outcome", "ok"), ("replica", "r0"))] == 5
+    assert values[(("outcome", "ok"), ("replica", "r1"))] == 7
+    depth = families["dasmtl_serve_queue_depth"]["samples"]
+    assert len(depth) == 2  # one series per replica, label disambiguated
+
+
+# -- ServeLoop blue/green swap (the replica half, in process) -----------------
+
+
+def test_swap_executor_keeps_serving_and_drains_old_in_flight():
+    """The zero-downtime core: batches in flight through the OUTGOING
+    executor collect after the flip (and only then does it close), while
+    new submissions run on the incoming executor."""
+    old = GatedExecutor()
+    loop = ServeLoop(old, max_wait_s=0.002, queue_depth=32,
+                     inflight=2).start()
+    try:
+        futs = [loop.submit_async(win(i) + 1.0) for i in range(2)]
+        assert old.dispatched.acquire(timeout=10.0)  # in flight on OLD
+
+        new = FakeExecutor()
+        loop.swap_executor(new)
+        assert loop.generation == 2
+        assert loop.ready  # never left readiness
+        assert not old.closed  # still owed an in-flight collect
+
+        old.release(4)
+        results = [f.result(timeout=10.0) for f in futs]
+        assert all(r.ok for r in results)
+
+        after = loop.submit(win(9) + 1.0, timeout=10.0)
+        assert after.ok
+        assert new.batches, "post-swap batch must run on the incoming " \
+                            "executor"
+        deadline = time.monotonic() + 5.0
+        while not old.closed and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert old.closed, "outgoing executor must close once its " \
+                           "in-flight batches drained"
+        assert not new.closed
+    finally:
+        old.release(16)
+        loop.close()
+    assert new.closed
+
+
+def test_swap_executor_rejects_window_and_bucket_mismatch():
+    loop = ServeLoop(FakeExecutor(), max_wait_s=0.002,
+                     queue_depth=32).start()
+    try:
+        wrong_hw = FakeExecutor()
+        wrong_hw.input_hw = (HW[0] + 1, HW[1])
+        with pytest.raises(ValueError, match="window shape"):
+            loop.swap_executor(wrong_hw)
+        wrong_buckets = FakeExecutor(buckets=(1, 2))
+        with pytest.raises(ValueError, match="buckets"):
+            loop.swap_executor(wrong_buckets)
+        assert loop.generation == 1
+    finally:
+        loop.close()
+
+
+def test_swap_to_records_status_and_failure_is_status_not_raise():
+    loop = ServeLoop(FakeExecutor(), max_wait_s=0.002,
+                     queue_depth=32).start()
+    try:
+        status = loop.swap_to(lambda version: FakeExecutor(), version=3)
+        assert status["state"] == "done" and status["version"] == 3
+        assert status["generation"] == 2
+        assert loop.swap_status["state"] == "done"
+
+        def broken(version):
+            raise RuntimeError("registry miss")
+
+        status = loop.swap_to(broken, version=9)
+        assert status["state"] == "failed"
+        assert "registry miss" in status["detail"]
+        assert loop.generation == 2  # failed swap changed nothing
+    finally:
+        loop.close()
+
+
+# -- readiness + swap over the real HTTP front end ----------------------------
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_readyz_splits_liveness_from_readiness():
+    loop = ServeLoop(FakeExecutor(), max_wait_s=0.002, queue_depth=32)
+    httpd = make_http_server(loop, port=0)
+    host, port = httpd.server_address[:2]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://{host}:{port}"
+    try:
+        # Pre-warmup: alive (200 /healthz) but NOT ready (503 /readyz) —
+        # the probe that used to route traffic at a compiling replica.
+        status, h = _get(f"{base}/healthz")
+        assert status == 200 and h["status"] == "warming"
+        assert h["ready"] is False
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base}/readyz")
+        assert ei.value.code == 503
+
+        loop.start()
+        status, h = _get(f"{base}/readyz")
+        assert status == 200 and h["ready"] and h["generation"] == 1
+
+        loop.begin_drain()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"{base}/readyz")
+        assert ei.value.code == 503
+    finally:
+        httpd.shutdown()
+        t.join(timeout=5)
+        loop.close()
+
+
+def test_post_swap_endpoint_flips_in_background():
+    incoming = FakeExecutor()
+    loop = ServeLoop(FakeExecutor(), max_wait_s=0.002,
+                     queue_depth=32).start()
+    httpd = make_http_server(loop, port=0,
+                             swap_builder=lambda version: incoming)
+    host, port = httpd.server_address[:2]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://{host}:{port}"
+    try:
+        req = urllib.request.Request(
+            f"{base}/swap", data=json.dumps({"version": 2}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 202
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            _s, body = _get(f"{base}/swap")
+            if body["swap"].get("state") == "done":
+                break
+            time.sleep(0.02)
+        assert body["swap"]["state"] == "done"
+        assert body["generation"] == 2
+        res = loop.submit(win(1) + 1.0, timeout=10.0)
+        assert res.ok and incoming.batches
+    finally:
+        httpd.shutdown()
+        t.join(timeout=5)
+        loop.close()
+
+
+def test_swap_endpoint_without_builder_is_structured_503():
+    loop = ServeLoop(FakeExecutor(), max_wait_s=0.002,
+                     queue_depth=32).start()
+    httpd = make_http_server(loop, port=0)
+    host, port = httpd.server_address[:2]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        req = urllib.request.Request(
+            f"http://{host}:{port}/swap", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["swap"]["state"] == \
+            "unavailable"
+    finally:
+        httpd.shutdown()
+        t.join(timeout=5)
+        loop.close()
+
+
+# -- config block -------------------------------------------------------------
+
+
+def test_config_router_block_validation():
+    from dasmtl.config import Config
+
+    cfg = Config()
+    assert cfg.router_replicas == 2
+    assert cfg.router_swap_policy == "drain"
+    assert cfg.router_replica_ports == ()
+    assert Config.from_json(cfg.to_json()).router_replica_ports == ()
+    with pytest.raises(ValueError, match="router_replicas"):
+        Config(router_replicas=0)
+    with pytest.raises(ValueError, match="one per replica"):
+        Config(router_replicas=2, router_replica_ports=(8401,))
+    with pytest.raises(ValueError, match="distinct positive"):
+        Config(router_replicas=2, router_replica_ports=(8401, 8401))
+    with pytest.raises(ValueError, match="router_retry_budget"):
+        Config(router_retry_budget=-1)
+    with pytest.raises(ValueError, match="router_probe_interval_s"):
+        Config(router_probe_interval_s=0)
+    with pytest.raises(ValueError, match="router_probe_backoff_max_s"):
+        Config(router_probe_interval_s=5.0,
+               router_probe_backoff_max_s=1.0)
+    with pytest.raises(ValueError, match="router_swap_policy"):
+        Config(router_swap_policy="yolo")
+
+
+def test_router_cli_flags_reach_config():
+    from dasmtl.config import parse_train_args
+
+    cfg = parse_train_args([
+        "--router_replicas", "3", "--router_replica_ports",
+        "8401,8402,8403", "--router_retry_budget", "2",
+        "--router_swap_policy", "hot",
+        "--serve_registry_dir", "/tmp/registry",
+        "--serve_shard_multihost"])
+    assert cfg.router_replicas == 3
+    assert cfg.router_replica_ports == (8401, 8402, 8403)
+    assert cfg.router_retry_budget == 2
+    assert cfg.router_swap_policy == "hot"
+    assert cfg.serve_registry_dir == "/tmp/registry"
+    assert cfg.serve_shard_multihost is True
+
+
+# -- the real thing (slow: subprocess replicas, SIGKILL, HTTP) ----------------
+
+
+@pytest.mark.slow
+def test_router_selftest_end_to_end():
+    from dasmtl.serve.selftest_router import run_router_selftest
+
+    report = run_router_selftest(requests=300, clients=6, verbose=False)
+    assert report["passed"], report["failures"]
+    assert report["dropped"] == 0
+    assert report["closed_to_accepted"] == 0
+    assert report["evictions"] >= 1

@@ -134,3 +134,43 @@ def test_doctor_validates_exported_artifact(exported_artifact):
     assert bad["status"] == "MISMATCH"
     assert check_exported_artifact("/nonexistent")["status"].startswith(
         "unreadable")
+
+
+def test_serve_from_registry_resolves_and_swap_rebuilds(exported_artifact,
+                                                        tmp_path):
+    """The registry serving path: publish the artifact, resolve
+    'latest', serve through the resolved path, and prove the blue/green
+    builder loop — swap_to(registry build) warms a NEW pool and flips
+    with zero post-warmup recompiles on the incoming executor."""
+    from dasmtl.export import ArtifactRegistry
+    from dasmtl.serve import ExecutorPool, ServeLoop
+
+    registry = ArtifactRegistry(str(tmp_path / "registry"))
+    entry = registry.publish_file(exported_artifact)
+    assert entry["version"] == 1 and entry["input_hw"] == list(HW)
+
+    def build(version=None):
+        resolved = registry.resolve(version)
+        return ExecutorPool.from_exported(resolved["path"], (1, 2),
+                                          expected_hw=HW)
+
+    loop = ServeLoop(build(), buckets=(1, 2), max_wait_s=0.002,
+                     queue_depth=16).start()
+    try:
+        rng = np.random.default_rng(0)
+        assert loop.submit(rng.normal(size=HW).astype(np.float32),
+                           timeout=60.0).ok
+        # Publish v2 (same bytes — a real rollout would carry new
+        # weights) and roll onto it.
+        registry.publish_file(exported_artifact)
+        status = loop.swap_to(build, version="latest")
+        assert status["state"] == "done", status
+        assert status["incoming_post_warmup_recompiles"] == 0
+        assert loop.generation == 2
+        res = loop.submit(rng.normal(size=HW).astype(np.float32),
+                          timeout=60.0)
+        assert res.ok
+        stats = loop.stats()
+        assert stats["executor"]["post_warmup_compiles"] == 0
+    finally:
+        loop.close()
