@@ -35,8 +35,16 @@ def test_main(argv=None) -> None:
 
 
 def stream_main(argv=None) -> int:
+    """``dasmtl-stream`` — the streaming tier.  ``serve`` as the first
+    argument starts continuous live inference over unbounded fibers
+    (dasmtl/stream/live.py, docs/STREAMING.md); anything else is the
+    long-standing offline record sweep (dasmtl/stream/offline.py)."""
     argv = list(sys.argv[1:] if argv is None else argv)
     apply_device_flag(argv)
+    if argv[:1] == ["serve"]:
+        from dasmtl.stream.live import serve_main as stream_serve_main
+
+        return stream_serve_main(argv[1:])
     from dasmtl.stream import main
 
     return main(argv)
@@ -132,7 +140,9 @@ def export_main(argv=None) -> int:
 _SUBCOMMANDS = {
     "train": (train_main, "train a model (dasmtl-train)"),
     "test": (test_main, "evaluate a checkpoint (dasmtl-test)"),
-    "stream": (stream_main, "streaming inference (dasmtl-stream)"),
+    "stream": (stream_main, "streaming inference: offline sweep, or "
+                            "'stream serve' for live multi-fiber "
+                            "tracking (dasmtl-stream)"),
     "export": (export_main, "export a serving artifact (dasmtl-export)"),
     "serve": (serve_main, "online inference server (dasmtl-serve)"),
     "router": (router_main, "replica router tier: scale-out serving + "

@@ -217,6 +217,44 @@ class Config:
     router_probe_backoff_max_s: float = 30.0
     router_swap_policy: str = "drain"  # drain | hot
 
+    # ---- live streaming (dasmtl/stream/, docs/STREAMING.md) ----
+    # `dasmtl stream serve`: continuous inference over unbounded fibers.
+    # Windowing: temporal stride in samples and spatial tile stride in
+    # channels (0 = the window dimension itself, i.e. non-overlapping);
+    # `stream_ring_samples` bounds each fiber's in-memory history —
+    # falling behind it is an explicit counted overrun, never a silent
+    # read of overwritten data.  `stream_chunk_samples` is how much one
+    # pump cycle polls per fiber (0 = one temporal stride).
+    stream_stride_time: int = 0
+    stream_stride_channels: int = 0
+    stream_ring_samples: int = 16384
+    stream_chunk_samples: int = 0
+    # Tenancy: all fibers may submit `stream_cycle_budget` windows per
+    # pump cycle TOTAL, split by per-fiber weight — the fairness gate
+    # that makes a saturating fiber shed its own windows, not its
+    # neighbors'.  `stream_max_wait_ms` is the serve micro-batching
+    # deadline for a weight-1.0 fiber (scaled by 1/weight per tenant);
+    # `stream_poll_ms` the pump cadence.
+    stream_cycle_budget: int = 64
+    stream_max_wait_ms: float = 5.0
+    stream_poll_ms: float = 2.0
+    # Event tracks: `stream_open_windows` consecutive confident decodes
+    # (prob >= stream_min_event_prob) open a track, `stream_close_windows`
+    # consecutive negatives close it; a track opening within
+    # `stream_track_merge_bins` distance-bins of an open same-type track
+    # in an adjacent overlapping tile merges into it.  Distance/position
+    # estimates smooth with EWMA weight `stream_distance_ewma`.
+    stream_open_windows: int = 3
+    stream_close_windows: int = 3
+    stream_min_event_prob: float = 0.9
+    stream_track_merge_bins: float = 2.0
+    stream_distance_ewma: float = 0.3
+    # Track-record sinks: the last `stream_events_ring` records stay
+    # queryable at GET /events; `stream_events_path` additionally appends
+    # every record as JSONL (None = no file sink).
+    stream_events_ring: int = 1024
+    stream_events_path: Optional[str] = None
+
     # ---- observability (dasmtl/obs/, docs/OBSERVABILITY.md) ----
     # Train heartbeat cadence in seconds (0 = off): periodic structured
     # lines + JSONL with samples/s EWMA, step wall time, loader stalls,
@@ -305,6 +343,35 @@ class Config:
             raise ValueError(
                 f"unknown serve_precision {self.serve_precision!r}; "
                 f"expected f32 | bf16 | int8")
+        if self.stream_stride_time < 0 or self.stream_stride_channels < 0:
+            raise ValueError("stream strides must be >= 0 (0 = the "
+                             "window dimension, non-overlapping)")
+        if self.stream_ring_samples < 1:
+            raise ValueError("stream_ring_samples must be >= 1")
+        if self.stream_chunk_samples < 0:
+            raise ValueError("stream_chunk_samples must be >= 0 "
+                             "(0 = one temporal stride per pump cycle)")
+        if self.stream_cycle_budget < 1:
+            raise ValueError("stream_cycle_budget must be >= 1")
+        if self.stream_max_wait_ms < 0:
+            raise ValueError("stream_max_wait_ms must be >= 0")
+        if self.stream_poll_ms <= 0:
+            raise ValueError("stream_poll_ms must be > 0")
+        if self.stream_open_windows < 1 or self.stream_close_windows < 1:
+            raise ValueError("stream_open_windows and "
+                             "stream_close_windows must be >= 1")
+        if not 0.0 < self.stream_min_event_prob <= 1.0:
+            raise ValueError(
+                f"stream_min_event_prob {self.stream_min_event_prob} "
+                f"outside (0, 1]")
+        if self.stream_track_merge_bins < 0:
+            raise ValueError("stream_track_merge_bins must be >= 0")
+        if not 0.0 < self.stream_distance_ewma <= 1.0:
+            raise ValueError(
+                f"stream_distance_ewma {self.stream_distance_ewma} "
+                f"outside (0, 1]")
+        if self.stream_events_ring < 1:
+            raise ValueError("stream_events_ring must be >= 1")
         if self.router_replicas < 1:
             raise ValueError("router_replicas must be >= 1")
         ports = tuple(int(v) for v in self.router_replica_ports)
@@ -687,6 +754,64 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
                    choices=["drain", "hot"],
                    help="blue/green rollout default: cordon+drain each "
                         "replica before its swap, or swap hot in place")
+    # Live-streaming block (dasmtl/stream/, docs/STREAMING.md) — the
+    # `dasmtl stream serve` CLI carries first-class flags; these keep the
+    # config.json/CLI-parity invariant so a run's config records its
+    # streaming geometry too.
+    p.add_argument("--stream_stride_time", type=int,
+                   default=d.stream_stride_time,
+                   help="live temporal window stride in samples "
+                        "(0 = window width, non-overlapping)")
+    p.add_argument("--stream_stride_channels", type=int,
+                   default=d.stream_stride_channels,
+                   help="live spatial tile stride in channels "
+                        "(0 = window height, non-overlapping tiles)")
+    p.add_argument("--stream_ring_samples", type=int,
+                   default=d.stream_ring_samples,
+                   help="per-fiber ring-buffer capacity in samples "
+                        "(falling behind it is a counted overrun)")
+    p.add_argument("--stream_chunk_samples", type=int,
+                   default=d.stream_chunk_samples,
+                   help="samples polled per fiber per pump cycle "
+                        "(0 = one temporal stride)")
+    p.add_argument("--stream_cycle_budget", type=int,
+                   default=d.stream_cycle_budget,
+                   help="total windows all fibers may submit per pump "
+                        "cycle, split by weight (the fairness gate)")
+    p.add_argument("--stream_max_wait_ms", type=float,
+                   default=d.stream_max_wait_ms,
+                   help="serve micro-batch deadline for a weight-1.0 "
+                        "fiber (scaled by 1/weight per tenant)")
+    p.add_argument("--stream_poll_ms", type=float,
+                   default=d.stream_poll_ms,
+                   help="stream pump cycle cadence (ms)")
+    p.add_argument("--stream_open_windows", type=int,
+                   default=d.stream_open_windows,
+                   help="consecutive confident decodes that open a track "
+                        "(shorter runs debounce away)")
+    p.add_argument("--stream_close_windows", type=int,
+                   default=d.stream_close_windows,
+                   help="consecutive negatives that close an open track")
+    p.add_argument("--stream_min_event_prob", type=float,
+                   default=d.stream_min_event_prob,
+                   help="event probability at or above which a window "
+                        "counts as a confident positive")
+    p.add_argument("--stream_track_merge_bins", type=float,
+                   default=d.stream_track_merge_bins,
+                   help="distance-bin tolerance for merging a track "
+                        "opening in an adjacent overlapping tile into "
+                        "the same physical event's open track")
+    p.add_argument("--stream_distance_ewma", type=float,
+                   default=d.stream_distance_ewma,
+                   help="EWMA weight smoothing a track's distance/"
+                        "position estimate across windows")
+    p.add_argument("--stream_events_ring", type=int,
+                   default=d.stream_events_ring,
+                   help="track records held for GET /events")
+    p.add_argument("--stream_events_path", type=str,
+                   default=d.stream_events_path, metavar="PATH",
+                   help="append every track record as JSONL here "
+                        "(default: no file sink)")
     # Observability block (dasmtl/obs/, docs/OBSERVABILITY.md) — the
     # serve CLI carries first-class --trace_ring/--slo_p99_ms flags;
     # these keep the config.json/CLI-parity invariant for training runs.

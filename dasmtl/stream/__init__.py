@@ -1,0 +1,62 @@
+"""Streaming inference: offline record sweeps + continuous live serving.
+
+Two tiers share this package:
+
+- **offline** (:mod:`dasmtl.stream.offline`, the original
+  ``dasmtl/stream.py``) — sweep a fully materialized ``(channels, time)``
+  record with one compiled executable and write per-window predictions to
+  CSV; :mod:`dasmtl.stream.merge` recombines its multi-host shards.
+- **live** (:mod:`dasmtl.stream.live` + ``feed``/``windower``/``tracks``)
+  — continuous inference over unbounded multi-fiber feeds: per-fiber ring
+  buffers, sliding windows x spatial tiles, multi-tenant submission into
+  the :mod:`dasmtl.serve` data plane, and hysteresis-fused event tracks
+  (docs/STREAMING.md).  ``python -m dasmtl.stream serve`` /
+  ``dasmtl stream serve`` is the entry point;
+  :mod:`dasmtl.stream.selftest` is the CI soak.
+
+Importing the package stays light on purpose: only the offline surface
+(numpy + stdlib at import time) and the pure-python ingestion/track
+modules load eagerly.  The live tier — which pulls :mod:`dasmtl.serve`
+and, transitively, jax — resolves lazily on attribute access, so
+``from dasmtl.stream import stream_predict`` never drags the serve stack
+in (pinned by tests/test_stream_pkg.py).
+"""
+
+from __future__ import annotations
+
+from dasmtl.stream.feed import (FiberFeed, FileTailSource, PlantedEvent,
+                                SocketSource, SyntheticSource)
+from dasmtl.stream.merge import find_shards, merge_shards
+from dasmtl.stream.offline import (EVENT_NAMES, _resolve_stride, main,
+                                   shard_csv_path, stream_predict)
+from dasmtl.stream.tracks import Track, TrackBook, TrackFuser, WindowDecode
+from dasmtl.stream.windower import CutWindow, LiveWindower
+
+#: Live-tier names resolved lazily (they import dasmtl.serve -> jax).
+_LIVE_EXPORTS = {
+    "StreamLoop": "dasmtl.stream.live",
+    "StreamTenant": "dasmtl.stream.live",
+    "make_stream_http_server": "dasmtl.stream.live",
+    "serve_main": "dasmtl.stream.live",
+    "run_selftest": "dasmtl.stream.selftest",
+    "write_stream_job_summary": "dasmtl.stream.selftest",
+}
+
+__all__ = [
+    "EVENT_NAMES", "stream_predict", "shard_csv_path", "main",
+    "find_shards", "merge_shards",
+    "FiberFeed", "SyntheticSource", "FileTailSource", "SocketSource",
+    "PlantedEvent", "LiveWindower", "CutWindow",
+    "TrackFuser", "TrackBook", "Track", "WindowDecode",
+    *sorted(_LIVE_EXPORTS),
+]
+
+
+def __getattr__(name: str):
+    module = _LIVE_EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)

@@ -1,0 +1,26 @@
+"""``python -m dasmtl.stream`` — the streaming tier's entry point.
+
+``serve`` as the first argument routes to the live tier
+(:func:`dasmtl.stream.live.serve_main`); anything else keeps the
+long-standing offline sweep semantics (:func:`dasmtl.stream.offline.main`)
+— existing ``python -m dasmtl.stream --record ...`` invocations are
+untouched by the package split."""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["serve"]:
+        from dasmtl.stream.live import serve_main
+
+        return serve_main(argv[1:])
+    from dasmtl.stream.offline import main as offline_main
+
+    return offline_main(argv or None)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,271 @@
+"""Live ingestion: per-fiber ring buffers and chunk sources.
+
+A deployed DAS interrogator emits an unbounded ``(channels, time)``
+stream per fiber.  :class:`FiberFeed` is the bounded landing zone: an
+append-only ring of the most recent ``ring_samples`` samples, addressed
+by *absolute* sample index (sample 0 is the first ever appended), so the
+windower downstream can detect when it has fallen behind the ring
+(overrun) instead of silently reading overwritten data.
+
+Three chunk sources share one tiny protocol — ``channels`` attribute,
+``poll(max_samples) -> (channels, k) array | None``, ``close()``:
+
+- :class:`SyntheticSource` — deterministic generator with planted
+  ground-truth events; the soak selftest's signal (and the demo mode of
+  ``dasmtl stream serve``).  Amplitudes follow the synthetic-data
+  convention of :mod:`dasmtl.data.synthetic`: an event rides a small
+  channel span, and its type is separable from per-channel-group RMS.
+- :class:`FileTailSource` — tail a growing raw float32 file (one frame =
+  ``channels`` consecutive values at one time instant).
+- :class:`SocketSource` — the same framing over a TCP connection,
+  non-blocking.
+
+Everything here is numpy + stdlib; nothing imports jax or dasmtl.serve.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket as socketlib
+from collections import deque
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class FiberFeed:
+    """Append-only ring buffer over one fiber's ``(channels, time)`` samples.
+
+    ``total`` is the absolute stream position (samples ever appended);
+    the ring retains ``[oldest, total)``.  ``view`` raises on any read
+    outside that range — falling behind the ring is an *overrun* the
+    caller must handle explicitly (:class:`~dasmtl.stream.windower.
+    LiveWindower` skips forward and counts the loss), never a silent
+    wrap-around read.
+
+    ``append`` also timestamps arrivals so the sample->event latency
+    histogram can anchor on when a window's data actually landed:
+    ``arrival_time(i)`` returns the clock reading of the append that
+    first made sample ``i`` available.
+    """
+
+    def __init__(self, channels: int, ring_samples: int,
+                 dtype=np.float32):
+        if channels < 1 or ring_samples < 1:
+            raise ValueError(f"channels {channels} and ring_samples "
+                             f"{ring_samples} must be >= 1")
+        self.channels = int(channels)
+        self.ring_samples = int(ring_samples)
+        self._buf = np.zeros((self.channels, self.ring_samples), dtype)
+        self.total = 0
+        # (total_after_append, clock_reading) pairs, oldest first; pruned
+        # to entries still covering retained samples.
+        self._arrivals: deque = deque()
+
+    @property
+    def oldest(self) -> int:
+        """First absolute sample index still retained."""
+        return max(0, self.total - self.ring_samples)
+
+    def append(self, chunk: np.ndarray, now: float = 0.0) -> int:
+        """Append ``(channels, n_new)`` samples; returns ``n_new``.  A
+        chunk wider than the ring keeps only its newest tail (the older
+        part is already unreadable by definition)."""
+        chunk = np.asarray(chunk)
+        if chunk.ndim != 2 or chunk.shape[0] != self.channels:
+            raise ValueError(f"chunk shape {chunk.shape} != "
+                             f"({self.channels}, n_new)")
+        n = chunk.shape[1]
+        if n == 0:
+            return 0
+        if n >= self.ring_samples:
+            # Oversized chunk: only its newest ring-width tail is ever
+            # readable; write it at the slots its absolute indices map to.
+            chunk = chunk[:, n - self.ring_samples:]
+            pos = (self.total + n - self.ring_samples) % self.ring_samples
+        else:
+            pos = self.total % self.ring_samples
+        end = pos + chunk.shape[1]
+        if end <= self.ring_samples:
+            self._buf[:, pos:end] = chunk
+        else:
+            first = self.ring_samples - pos
+            self._buf[:, pos:] = chunk[:, :first]
+            self._buf[:, :end - self.ring_samples] = chunk[:, first:]
+        self.total += n
+        self._arrivals.append((self.total, now))
+        while (len(self._arrivals) > 1
+               and self._arrivals[1][0] <= self.oldest):
+            self._arrivals.popleft()
+        return n
+
+    def view(self, t0: int, n: int) -> np.ndarray:
+        """Copy of absolute samples ``[t0, t0 + n)`` as ``(channels, n)``."""
+        if t0 < self.oldest:
+            raise IndexError(f"samples from {t0} overwritten — ring "
+                             f"retains [{self.oldest}, {self.total})")
+        if t0 + n > self.total:
+            raise IndexError(f"samples to {t0 + n} not yet appended "
+                             f"(total {self.total})")
+        pos = t0 % self.ring_samples
+        end = pos + n
+        if end <= self.ring_samples:
+            return self._buf[:, pos:end].copy()
+        return np.concatenate(
+            [self._buf[:, pos:], self._buf[:, :end - self.ring_samples]],
+            axis=1)
+
+    def arrival_time(self, sample: int) -> float:
+        """Clock reading of the append that first covered ``sample``
+        (0.0 if unknown — e.g. already pruned)."""
+        for covered, now in self._arrivals:
+            if covered > sample:
+                return now
+        return self._arrivals[-1][1] if self._arrivals else 0.0
+
+
+# -- chunk sources -------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class PlantedEvent:
+    """Ground truth for one synthetic event: ``onset``/``duration`` in
+    samples, ``event`` type (0 striking / 1 excavating), and the center
+    channel of its 8-channel span on the fiber."""
+
+    onset: int
+    duration: int
+    event: int
+    center_channel: int
+
+
+#: Signal amplitudes per event type, chosen so per-channel-group RMS over
+#: a full window separates cleanly: background noise (std 1.0) -> RMS ~1;
+#: striking (A=8) -> RMS ~5.7; excavating (A=16) -> RMS ~11.4.  The soak
+#: oracle detector thresholds at 2.5 and 8.0 (dasmtl/stream/selftest.py).
+EVENT_AMPLITUDE = (8.0, 16.0)
+
+#: Channels an event's signal rides on (group-aligned spans keep the
+#: oracle's 16-group RMS argmax crisp).
+EVENT_SPAN_CHANNELS = 8
+
+
+class SyntheticSource:
+    """Deterministic synthetic fiber: unit-variance Gaussian background
+    plus planted sinusoid events, generated chunk-by-chunk so an
+    unbounded stream never materializes.  ``nan_samples`` poisons single
+    samples (channel ``nan_channel``) to exercise the serve tier's
+    SAN202 per-window rejection downstream."""
+
+    def __init__(self, channels: int, *, seed: int = 0,
+                 events: Sequence[PlantedEvent] = (),
+                 nan_samples: Sequence[int] = (),
+                 nan_channel: Optional[int] = None):
+        self.channels = int(channels)
+        self.events = tuple(events)
+        self.nan_samples = frozenset(int(s) for s in nan_samples)
+        self.nan_channel = (self.channels // 2 if nan_channel is None
+                            else int(nan_channel))
+        self._rng = np.random.default_rng(seed)
+        self._pos = 0
+
+    def poll(self, max_samples: int) -> Optional[np.ndarray]:
+        n = int(max_samples)
+        if n <= 0:
+            return None
+        p0 = self._pos
+        out = self._rng.standard_normal((self.channels, n)
+                                        ).astype(np.float32)
+        t = np.arange(p0, p0 + n, dtype=np.float64)
+        for ev in self.events:
+            lo = max(p0, ev.onset)
+            hi = min(p0 + n, ev.onset + ev.duration)
+            if lo >= hi:
+                continue
+            c0 = max(0, min(self.channels - EVENT_SPAN_CHANNELS,
+                            ev.center_channel - EVENT_SPAN_CHANNELS // 2))
+            amp = EVENT_AMPLITUDE[ev.event]
+            wave = amp * np.sin(
+                2.0 * np.pi * 0.05 * t[lo - p0:hi - p0]).astype(np.float32)
+            out[c0:c0 + EVENT_SPAN_CHANNELS, lo - p0:hi - p0] += wave
+        for s in self.nan_samples:
+            if p0 <= s < p0 + n:
+                out[self.nan_channel, s - p0] = np.nan
+        self._pos += n
+        return out
+
+    def close(self) -> None:
+        pass
+
+
+class FileTailSource:
+    """Tail a growing raw float32 file.  Framing: one frame is
+    ``channels`` consecutive float32 values sampled at one time instant
+    (sample-major) — ``poll`` returns complete frames transposed to
+    ``(channels, k)`` and carries partial trailing bytes to the next
+    call."""
+
+    def __init__(self, path: str, channels: int):
+        self.channels = int(channels)
+        self._frame_bytes = 4 * self.channels
+        self._f = open(path, "rb")
+        self._carry = b""
+
+    def poll(self, max_samples: int) -> Optional[np.ndarray]:
+        want = int(max_samples) * self._frame_bytes - len(self._carry)
+        data = self._carry + (self._f.read(max(0, want)) or b"")
+        n_frames = len(data) // self._frame_bytes
+        if n_frames == 0:
+            self._carry = data
+            return None
+        cut = n_frames * self._frame_bytes
+        self._carry = data[cut:]
+        frames = np.frombuffer(data[:cut], np.float32).reshape(
+            n_frames, self.channels)
+        return np.ascontiguousarray(frames.T)
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class SocketSource:
+    """The file-tail framing over TCP: connect to ``host:port`` and
+    drain whatever complete frames have arrived, without blocking."""
+
+    def __init__(self, host: str, port: int, channels: int,
+                 connect_timeout_s: float = 10.0):
+        self.channels = int(channels)
+        self._frame_bytes = 4 * self.channels
+        self._sock = socketlib.create_connection(
+            (host, int(port)), timeout=connect_timeout_s)
+        self._sock.setblocking(False)
+        self._carry = b""
+
+    def poll(self, max_samples: int) -> Optional[np.ndarray]:
+        budget = int(max_samples) * self._frame_bytes
+        chunks = [self._carry]
+        got = len(self._carry)
+        while got < budget:
+            try:
+                piece = self._sock.recv(min(65536, budget - got))
+            except BlockingIOError:
+                break
+            if not piece:  # peer closed; keep returning what we have
+                break
+            chunks.append(piece)
+            got += len(piece)
+        data = b"".join(chunks)
+        n_frames = len(data) // self._frame_bytes
+        if n_frames == 0:
+            self._carry = data
+            return None
+        cut = n_frames * self._frame_bytes
+        self._carry = data[cut:]
+        frames = np.frombuffer(data[:cut], np.float32).reshape(
+            n_frames, self.channels)
+        return np.ascontiguousarray(frames.T)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,646 @@
+"""Continuous multi-fiber streaming over the serve data plane.
+
+This is the live tier's conductor: N fibers (each a chunk source + ring
+buffer + windower + track book) multiplex onto ONE
+:class:`~dasmtl.serve.ServeLoop` — the existing micro-batcher / staging /
+executor-pool machinery, not a parallel execution path.  What this module
+adds on top is *tenancy*:
+
+- **Weighted fairness** — each tenant gets a per-pump-cycle submission
+  quota and an outstanding-window budget proportional to its weight.  A
+  fiber offering more windows than its share sheds ITS OWN excess at the
+  gate (counted per fiber in ``dasmtl_stream_shed_total``); a neighbor
+  under its share never sheds because of it.  On top of the gate, each
+  tenant's windows carry a weight-scaled deadline into the serve queue
+  (``max_wait_s / weight``), so the deadline-ordered batcher flushes
+  heavier tenants first under contention.
+- **Track fusion** — every resolved window feeds the tenant's
+  :class:`~dasmtl.stream.tracks.TrackBook`; rejected windows (SAN202
+  ``nonfinite``, shed) pass through as neutral.  Emitted records land in
+  an in-memory ring (``GET /events``), optionally a JSONL file, and the
+  ``dasmtl_stream_*`` metric families (docs/OBSERVABILITY.md).
+
+``serve_main`` below is the ``dasmtl stream serve`` /
+``python -m dasmtl.stream serve`` entry point; ``--selftest`` runs the
+soak (:mod:`dasmtl.stream.selftest`) — the CI stream job's leg.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from dasmtl.obs.registry import (DEFAULT_LATENCY_BUCKETS_S, MetricsRegistry)
+from dasmtl.stream.feed import FiberFeed
+from dasmtl.stream.tracks import TrackBook, WindowDecode
+from dasmtl.stream.windower import LiveWindower
+
+#: Metric families a healthy stream scrape must carry — the acceptance
+#: catalog of docs/OBSERVABILITY.md's ``dasmtl_stream_*`` section.
+REQUIRED_STREAM_METRIC_FAMILIES = (
+    "dasmtl_stream_windows_total",
+    "dasmtl_stream_shed_total",
+    "dasmtl_stream_serve_refusals_total",
+    "dasmtl_stream_rejected_total",
+    "dasmtl_stream_ring_overrun_windows_total",
+    "dasmtl_stream_track_opens_total",
+    "dasmtl_stream_track_closes_total",
+    "dasmtl_stream_open_tracks",
+    "dasmtl_stream_tile_occupancy",
+    "dasmtl_stream_sample_to_event_latency_seconds",
+)
+
+
+class StreamMetrics:
+    """The ``dasmtl_stream_*`` families on one registry (rendered after
+    the serve loop's own in ``StreamLoop.metrics_text``)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 latency_buckets_s: Optional[Sequence[float]] = None):
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        r = self.registry
+        lab = ("fiber",)
+        self.windows = r.counter(
+            "dasmtl_stream_windows_total",
+            "Windows submitted into the serve loop, per fiber", lab)
+        self.shed = r.counter(
+            "dasmtl_stream_shed_total",
+            "Windows shed at the per-tenant fairness gate (the fiber "
+            "exceeded its own quota/outstanding budget)", lab)
+        self.serve_refusals = r.counter(
+            "dasmtl_stream_serve_refusals_total",
+            "Submitted windows the serve tier refused (shed/closed)", lab)
+        self.rejected = r.counter(
+            "dasmtl_stream_rejected_total",
+            "Submitted windows rejected nonfinite (SAN202) — neutral to "
+            "open tracks", lab)
+        self.overrun = r.counter(
+            "dasmtl_stream_ring_overrun_windows_total",
+            "Windows lost because the feed outpaced the ring buffer", lab)
+        self.track_opens = r.counter(
+            "dasmtl_stream_track_opens_total",
+            "Event tracks opened (hysteresis threshold crossed)", lab)
+        self.track_closes = r.counter(
+            "dasmtl_stream_track_closes_total",
+            "Event tracks closed (close threshold crossed on every "
+            "member tile)", lab)
+        self.open_tracks = r.gauge(
+            "dasmtl_stream_open_tracks", "Tracks currently open", lab)
+        self.tile_occupancy = r.gauge(
+            "dasmtl_stream_tile_occupancy",
+            "Fraction of a fiber's tiles holding an open track", lab)
+        self.latency = r.histogram(
+            "dasmtl_stream_sample_to_event_latency_seconds",
+            "Sample arrival -> track-state update, per resolved window",
+            buckets=tuple(latency_buckets_s or DEFAULT_LATENCY_BUCKETS_S),
+            labelnames=lab)
+
+
+class StreamTenant:
+    """One fiber: source -> ring -> windower -> (serve) -> track book."""
+
+    def __init__(self, name: str, source, *, window, stride_time: int = 0,
+                 stride_channels: int = 0, ring_samples: int = 16384,
+                 weight: float = 1.0, chunk_samples: int = 0,
+                 open_windows: int = 3, close_windows: int = 3,
+                 min_event_prob: float = 0.9, merge_bins: float = 2.0,
+                 distance_ewma: float = 0.3, n_distance_bins: int = 16,
+                 track_ids=None):
+        if weight <= 0:
+            raise ValueError(f"tenant {name}: weight must be > 0")
+        self.name = name
+        self.source = source
+        self.weight = float(weight)
+        self.feed = FiberFeed(source.channels, ring_samples)
+        self.windower = LiveWindower(self.feed, window,
+                                     stride_time=stride_time,
+                                     stride_channels=stride_channels)
+        self.book = TrackBook(name, self.windower.tile_origins,
+                              int(window[0]),
+                              n_distance_bins=n_distance_bins,
+                              merge_bins=merge_bins,
+                              open_windows=open_windows,
+                              close_windows=close_windows,
+                              min_event_prob=min_event_prob,
+                              distance_ewma=distance_ewma, ids=track_ids)
+        self.chunk_samples = int(chunk_samples) or \
+            self.windower.stride_time
+        # Filled in by StreamLoop from the weights of the whole tenant set.
+        self.quota = 1
+        self.max_outstanding = 4
+        self.deadline_s: Optional[float] = None
+        # Counters (under the loop lock).
+        self.outstanding = 0
+        self.submitted = 0
+        self.resolved = 0
+        self.shed = 0
+        self.serve_refused = 0
+        self.rejected = 0
+        self.latencies: deque = deque(maxlen=100_000)
+
+    def p99_latency_s(self) -> float:
+        if not self.latencies:
+            return 0.0
+        xs = sorted(self.latencies)
+        return xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+
+
+class StreamLoop:
+    """Pump N tenants into one serve loop and fuse the answers into
+    tracks.  ``run_cycle`` is the whole steady state, callable directly
+    with an explicit ``now`` (deterministic tests / the soak);
+    ``start``/``begin_drain``/``drain`` wrap it in a pump thread for
+    production."""
+
+    def __init__(self, serve, tenants: Sequence[StreamTenant], *,
+                 cycle_budget: int = 64, outstanding_factor: int = 4,
+                 max_wait_s: float = 0.005, clock=time.monotonic,
+                 events_path: Optional[str] = None,
+                 events_ring: int = 1024,
+                 metrics: Optional[StreamMetrics] = None):
+        if not tenants:
+            raise ValueError("a stream loop needs at least one tenant")
+        if cycle_budget < len(tenants):
+            raise ValueError(f"cycle_budget {cycle_budget} < "
+                             f"{len(tenants)} tenants — every tenant "
+                             f"needs at least one slot")
+        self.serve = serve
+        self.tenants = list(tenants)
+        self.clock = clock
+        self.max_wait_s = float(max_wait_s)
+        self.metrics = metrics or StreamMetrics()
+        total_w = sum(t.weight for t in self.tenants)
+        for t in self.tenants:
+            t.quota = max(1, int(cycle_budget * t.weight / total_w))
+            t.max_outstanding = t.quota * max(1, int(outstanding_factor))
+            # Heavier tenants carry earlier deadlines into the serve
+            # queue's min-heap — the per-tenant deadline tag.
+            t.deadline_s = self.max_wait_s / t.weight
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=int(events_ring))
+        self._events_f = open(events_path, "a", encoding="utf-8") \
+            if events_path else None
+        self._pump: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.cycles = 0
+
+    # -- steady state --------------------------------------------------------
+    def run_cycle(self, now: Optional[float] = None) -> dict:
+        """One pump iteration over every tenant: poll the source, cut
+        windows, gate + submit.  Returns per-cycle counts."""
+        now = self.clock() if now is None else now
+        submitted = shed = 0
+        for t in self.tenants:
+            chunk = t.source.poll(t.chunk_samples)
+            if chunk is not None and chunk.size:
+                t.feed.append(chunk, now=now)
+            sent_this_cycle = 0
+            for wdw in t.windower.cut():
+                with self._lock:
+                    over = (sent_this_cycle >= t.quota
+                            or t.outstanding >= t.max_outstanding)
+                    if over:
+                        t.shed += 1
+                    else:
+                        t.outstanding += 1
+                        t.submitted += 1
+                if over:
+                    self.metrics.shed.inc(labels=(t.name,))
+                    shed += 1
+                    continue
+                sent_this_cycle += 1
+                submitted += 1
+                self.metrics.windows.inc(labels=(t.name,))
+                fut = self.serve.submit_async(wdw.x[..., 0],
+                                              max_wait_s=t.deadline_s,
+                                              want_log_probs=True)
+                fut.add_done_callback(
+                    lambda f, t=t, wdw=wdw: self._on_result(t, wdw, f))
+        self.cycles += 1
+        return {"submitted": submitted, "shed": shed}
+
+    def _on_result(self, tenant: StreamTenant, wdw, fut) -> None:
+        now = self.clock()
+        try:
+            res = fut.result()
+        except Exception:  # noqa: BLE001 — a dropped future stays counted
+            res = None
+        with self._lock:
+            tenant.outstanding -= 1
+            tenant.resolved += 1
+            if res is None:
+                tenant.serve_refused += 1
+                self.metrics.serve_refusals.inc(labels=(tenant.name,))
+                return
+            if res.error == "nonfinite":
+                tenant.rejected += 1
+                self.metrics.rejected.inc(labels=(tenant.name,))
+            elif not res.ok:
+                tenant.serve_refused += 1
+                self.metrics.serve_refusals.inc(labels=(tenant.name,))
+            event = distance = -1
+            prob = 0.0
+            if res.ok:
+                event = int(res.predictions.get("event", -1))
+                distance = int(res.predictions.get("distance", -1))
+                lp = (res.log_probs or {}).get("log_probs_event")
+                prob = float(np.exp(max(lp))) if lp else 1.0
+            d = WindowDecode(t_origin=wdw.t_origin, t_end=wdw.t_end,
+                             ok=bool(res.ok), event=event,
+                             distance=distance, event_prob=prob)
+            records = tenant.book.update(wdw.tile, d, now)
+            lat = max(0.0, now - wdw.arrival_s)
+            tenant.latencies.append(lat)
+            self.metrics.latency.observe(lat, (tenant.name,))
+            for rec in records:
+                if rec["kind"] == "open":
+                    self.metrics.track_opens.inc(labels=(tenant.name,))
+                elif rec["kind"] == "close":
+                    self.metrics.track_closes.inc(labels=(tenant.name,))
+                self._events.append(rec)
+                if self._events_f is not None:
+                    self._events_f.write(json.dumps(rec) + "\n")
+            if records and self._events_f is not None:
+                self._events_f.flush()
+
+    # -- pump thread ---------------------------------------------------------
+    def start(self, poll_s: float = 0.002) -> "StreamLoop":
+        def pump():
+            while not self._stop.is_set():
+                self.run_cycle()
+                self._stop.wait(poll_s)
+        self._pump = threading.Thread(target=pump, daemon=True,
+                                      name="dasmtl-stream-pump")
+        self._pump.start()
+        return self
+
+    def begin_drain(self) -> None:
+        self._stop.set()
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop pumping and wait for every submitted window to resolve."""
+        self.begin_drain()
+        if self._pump is not None:
+            self._pump.join(timeout=timeout)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if all(t.outstanding == 0 for t in self.tenants):
+                    return True
+            time.sleep(0.005)
+        return False
+
+    def close(self) -> None:
+        self.begin_drain()
+        if self._events_f is not None:
+            self._events_f.close()
+            self._events_f = None
+        for t in self.tenants:
+            try:
+                t.source.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+    # -- views ---------------------------------------------------------------
+    def events(self, n: int = 100,
+               kind: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            recs = list(self._events)
+        if kind:
+            recs = [r for r in recs if r["kind"] == kind]
+        return recs[-int(n):]
+
+    def stats(self) -> dict:
+        with self._lock:
+            tenants = {
+                t.name: {
+                    "weight": t.weight,
+                    "quota": t.quota,
+                    "max_outstanding": t.max_outstanding,
+                    "submitted": t.submitted,
+                    "resolved": t.resolved,
+                    "outstanding": t.outstanding,
+                    "shed": t.shed,
+                    "serve_refused": t.serve_refused,
+                    "rejected": t.rejected,
+                    "ring_overrun_windows": t.windower.overrun_windows,
+                    "tiles": t.windower.n_tiles,
+                    "open_tracks": t.book.open_track_count,
+                    "track_opens": t.book.opens,
+                    "track_closes": t.book.closes,
+                    "p99_latency_ms": round(t.p99_latency_s() * 1e3, 3),
+                } for t in self.tenants}
+        return {"cycles": self.cycles, "tenants": tenants,
+                "events_held": len(self._events)}
+
+    def metrics_text(self) -> str:
+        """The full ``GET /metrics`` exposition: serve families (which
+        already include the process-wide default registry) followed by
+        the ``dasmtl_stream_*`` families, gauges refreshed here at
+        scrape time."""
+        with self._lock:
+            for t in self.tenants:
+                self.metrics.open_tracks.set(t.book.open_track_count,
+                                             (t.name,))
+                self.metrics.tile_occupancy.set(
+                    t.book.open_tile_count / t.windower.n_tiles,
+                    (t.name,))
+                self.metrics.overrun.set_total(
+                    t.windower.overrun_windows, (t.name,))
+        return self.serve.metrics_text() + self.metrics.registry.render()
+
+
+# -- HTTP front end ------------------------------------------------------------
+
+def make_stream_http_server(stream: StreamLoop, host: str = "127.0.0.1",
+                            port: int = 0) -> ThreadingHTTPServer:
+    """The stream front end: ``GET /events`` (the track-record view),
+    ``/healthz``, ``/stats``, ``/metrics`` (serve + stream families)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *_a):  # keep CI logs quiet
+            pass
+
+        def _send(self, code: int, body: bytes,
+                  content_type: str = "application/json") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — http.server convention
+            url = urlparse(self.path)
+            try:
+                if url.path == "/events":
+                    q = parse_qs(url.query)
+                    n = int(q.get("n", ["100"])[0])
+                    kind = q.get("kind", [None])[0]
+                    body = json.dumps(stream.events(n=n, kind=kind)
+                                      ).encode()
+                    self._send(200, body)
+                elif url.path == "/healthz":
+                    payload = stream.serve.healthz()
+                    payload["stream"] = {"cycles": stream.cycles,
+                                         "tenants": len(stream.tenants)}
+                    self._send(200, json.dumps(payload).encode())
+                elif url.path == "/stats":
+                    self._send(200, json.dumps(stream.stats()).encode())
+                elif url.path == "/metrics":
+                    self._send(200, stream.metrics_text().encode(),
+                               "text/plain; version=0.0.4")
+                else:
+                    self._send(404, json.dumps(
+                        {"error": f"no route {url.path}"}).encode())
+            except Exception as exc:  # noqa: BLE001 — answer, don't die
+                self._send(500, json.dumps(
+                    {"error": f"{type(exc).__name__}: {exc}"}).encode())
+
+    return ThreadingHTTPServer((host, int(port)), Handler)
+
+
+# -- CLI -----------------------------------------------------------------------
+
+def serve_main(argv=None) -> int:
+    """``dasmtl stream serve`` — continuous inference over live fibers."""
+    from dasmtl.config import Config
+
+    d = Config()
+    p = argparse.ArgumentParser(
+        prog="dasmtl stream serve",
+        description="continuous multi-fiber streaming inference: live "
+                    "ingestion -> spatial tiles -> the serve data plane "
+                    "-> event tracks (docs/STREAMING.md)")
+    src = p.add_argument_group("model source (exactly one)")
+    src.add_argument("--exported", type=str, default=None,
+                     help="serve a self-contained StableHLO artifact")
+    src.add_argument("--model_path", type=str, default=None,
+                     help="checkpoint directory to restore weights from")
+    src.add_argument("--fresh_init", action="store_true",
+                     help="seed-deterministic fresh-init weights (the "
+                          "bench/demo path when no trained weights exist)")
+    p.add_argument("--model", type=str, default="MTL")
+    p.add_argument("--window", type=str, default=None, metavar="HxW",
+                   help="window shape, e.g. 100x250 (default: the config "
+                        "geometry; also the spatial tile height)")
+    p.add_argument("--buckets", type=str,
+                   default=",".join(str(b) for b in d.serve_buckets),
+                   help="batch-shape ladder compiled at warmup")
+    fib = p.add_argument_group("fibers (repeatable; at least one source)")
+    fib.add_argument("--synthetic", type=int, default=0, metavar="N",
+                     help="N synthetic demo fibers (deterministic "
+                          "background + planted events)")
+    fib.add_argument("--tail", action="append", default=[],
+                     metavar="PATH",
+                     help="tail a growing raw float32 file (one frame = "
+                          "--channels values); one fiber per flag")
+    fib.add_argument("--connect", action="append", default=[],
+                     metavar="HOST:PORT",
+                     help="TCP source, same framing; one fiber per flag")
+    fib.add_argument("--channels", type=int, default=0,
+                     help="channels per fiber (default: the window "
+                          "height — a single spatial tile)")
+    fib.add_argument("--weights", type=str, default=None,
+                     help="comma-separated per-fiber weights (fairness "
+                          "shares + deadline scaling; default all 1)")
+    srv = p.add_argument_group("serve loop (dasmtl/serve/)")
+    srv.add_argument("--max_wait_ms", type=float,
+                     default=d.serve_max_wait_ms,
+                     help="micro-batching deadline for weight-1.0 "
+                          "tenants (scaled by 1/weight per tenant)")
+    srv.add_argument("--queue_depth", type=int, default=d.serve_queue_depth)
+    srv.add_argument("--inflight", type=int, default=d.serve_inflight)
+    srv.add_argument("--devices", type=int, default=d.serve_devices)
+    srv.add_argument("--precision", type=str, default=d.serve_precision,
+                     choices=["f32", "bf16", "int8"])
+    st = p.add_argument_group("stream (stream_* config block, "
+                              "docs/STREAMING.md)")
+    st.add_argument("--stride_time", type=int, default=d.stream_stride_time,
+                    help="temporal stride in samples (0 = window width)")
+    st.add_argument("--stride_channels", type=int,
+                    default=d.stream_stride_channels,
+                    help="spatial tile stride in channels (0 = window "
+                         "height, non-overlapping tiles)")
+    st.add_argument("--ring_samples", type=int, default=d.stream_ring_samples)
+    st.add_argument("--chunk_samples", type=int,
+                    default=d.stream_chunk_samples,
+                    help="samples polled per fiber per pump cycle "
+                         "(0 = one temporal stride)")
+    st.add_argument("--cycle_budget", type=int, default=d.stream_cycle_budget,
+                    help="total windows all tenants may submit per pump "
+                         "cycle, split by weight (the fairness gate)")
+    st.add_argument("--open_windows", type=int, default=d.stream_open_windows)
+    st.add_argument("--close_windows", type=int,
+                    default=d.stream_close_windows)
+    st.add_argument("--min_event_prob", type=float,
+                    default=d.stream_min_event_prob)
+    st.add_argument("--track_merge_bins", type=float,
+                    default=d.stream_track_merge_bins)
+    st.add_argument("--distance_ewma", type=float,
+                    default=d.stream_distance_ewma)
+    st.add_argument("--events_path", type=str, default=d.stream_events_path,
+                    help="append emitted track records here as JSONL")
+    st.add_argument("--events_ring", type=int, default=d.stream_events_ring)
+    st.add_argument("--poll_ms", type=float, default=d.stream_poll_ms,
+                    help="pump cycle cadence")
+    p.add_argument("--host", type=str, default=d.serve_host)
+    p.add_argument("--port", type=int, default=d.serve_port)
+    p.add_argument("--port_file", type=str, default=None, metavar="PATH")
+    p.add_argument("--device", type=str, default="auto",
+                   choices=["tpu", "cpu", "auto"])
+    p.add_argument("--selftest", action="store_true",
+                   help="run the in-process streaming soak (synthetic "
+                        "fibers, one overdriven; fairness / hysteresis / "
+                        "latency / recompile invariants) and exit 0/1 — "
+                        "no network fibers, CI-safe on CPU")
+    p.add_argument("--selftest_fibers", type=int, default=3)
+    p.add_argument("--selftest_cycles", type=int, default=140)
+    p.add_argument("--selftest_devices", type=int, default=1,
+                   help="executor-pool size for the selftest (use "
+                        "XLA_FLAGS=--xla_force_host_platform_device_"
+                        "count=N for N virtual CPU devices)")
+    args = p.parse_args(argv)
+
+    from dasmtl.utils.platform import apply_device
+
+    apply_device(args.device)
+
+    if args.selftest:
+        from dasmtl.stream.selftest import (run_selftest,
+                                            write_stream_job_summary)
+
+        report = run_selftest(fibers=args.selftest_fibers,
+                              cycles=args.selftest_cycles,
+                              devices=args.selftest_devices,
+                              inflight=args.inflight)
+        write_stream_job_summary(report)
+        return 0 if report["passed"] else 1
+
+    n_sources = sum(1 for v in (args.exported, args.model_path,
+                                args.fresh_init) if v)
+    if n_sources != 1:
+        p.error("exactly one of --exported / --model_path / "
+                "--fresh_init is required (or --selftest)")
+    try:
+        buckets = tuple(int(b) for b in args.buckets.split(",") if b)
+    except ValueError:
+        p.error(f"--buckets must be comma-separated ints, "
+                f"got {args.buckets!r}")
+    window = None
+    if args.window:
+        try:
+            h, w = args.window.lower().split("x")
+            window = (int(h), int(w))
+        except ValueError:
+            p.error(f"--window must look like 100x250, got {args.window!r}")
+
+    from dasmtl.serve.executor import ExecutorPool
+    from dasmtl.serve.server import ServeLoop, install_signal_handlers
+
+    if args.exported:
+        pool = ExecutorPool.from_exported(args.exported, buckets,
+                                          expected_hw=window,
+                                          devices=args.devices,
+                                          precision=args.precision)
+    else:
+        pool = ExecutorPool.from_checkpoint(args.model, args.model_path,
+                                            buckets, input_hw=window,
+                                            devices=args.devices,
+                                            precision=args.precision)
+    window = pool.input_hw
+    channels = args.channels or window[0]
+
+    # Assemble the fiber set (synthetic first, then tails, then sockets).
+    from dasmtl.stream.feed import (FileTailSource, PlantedEvent,
+                                    SocketSource, SyntheticSource)
+
+    sources = []
+    for i in range(args.synthetic):
+        # A repeating demo pattern: one event of each type per fiber.
+        sources.append(SyntheticSource(
+            channels, seed=i,
+            events=(PlantedEvent(4000, 2048, 0, channels // 3),
+                    PlantedEvent(12000, 2048, 1, (2 * channels) // 3))))
+    for path in args.tail:
+        sources.append(FileTailSource(path, channels))
+    for spec in args.connect:
+        host, _, port = spec.rpartition(":")
+        sources.append(SocketSource(host or "127.0.0.1", int(port),
+                                    channels))
+    if not sources:
+        p.error("no fibers: pass --synthetic N, --tail PATH, or "
+                "--connect HOST:PORT")
+    weights = [1.0] * len(sources)
+    if args.weights:
+        try:
+            weights = [float(x) for x in args.weights.split(",")]
+        except ValueError:
+            p.error(f"--weights must be comma-separated floats, "
+                    f"got {args.weights!r}")
+        if len(weights) != len(sources):
+            p.error(f"--weights names {len(weights)} fibers, "
+                    f"{len(sources)} configured")
+
+    tenants = [StreamTenant(
+        f"f{i}", src, window=window, stride_time=args.stride_time,
+        stride_channels=args.stride_channels,
+        ring_samples=args.ring_samples, weight=wt,
+        chunk_samples=args.chunk_samples,
+        open_windows=args.open_windows, close_windows=args.close_windows,
+        min_event_prob=args.min_event_prob,
+        merge_bins=args.track_merge_bins,
+        distance_ewma=args.distance_ewma)
+        for i, (src, wt) in enumerate(zip(sources, weights))]
+
+    loop = ServeLoop(pool, buckets=buckets,
+                     max_wait_s=args.max_wait_ms / 1e3,
+                     queue_depth=args.queue_depth, inflight=args.inflight)
+    stream = StreamLoop(loop, tenants, cycle_budget=args.cycle_budget,
+                        max_wait_s=args.max_wait_ms / 1e3,
+                        events_path=args.events_path,
+                        events_ring=args.events_ring)
+    httpd = make_stream_http_server(stream, args.host, args.port)
+    host, port = httpd.server_address[:2]
+    if args.port_file:
+        with open(args.port_file, "w", encoding="utf-8") as f:
+            f.write(f"{port}\n")
+    http_t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    http_t.start()
+    print(f"warming {len(buckets)} bucket(s) {list(buckets)} on "
+          f"{window[0]}x{window[1]} windows across "
+          f"{len(pool.executors)} device(s); liveness already up on "
+          f"http://{host}:{port} ...", file=sys.stderr)
+    loop.start()
+    n_tiles = tenants[0].windower.n_tiles
+    print(f"streaming {len(tenants)} fiber(s) x {n_tiles} tile(s) "
+          f"into {pool.source} on http://{host}:{port} "
+          f"(GET /events, /healthz, /stats, /metrics); SIGTERM drains",
+          file=sys.stderr)
+    stop = threading.Event()
+    install_signal_handlers(loop, on_drain=lambda _s: stop.set())
+    stream.start(poll_s=args.poll_ms / 1e3)
+    stop.wait()
+    stream_drained = stream.drain(timeout=30.0)
+    serve_drained = loop.drain(timeout=60.0)
+    httpd.shutdown()
+    http_t.join(timeout=10.0)
+    stream.close()
+    loop.close()
+    stats = stream.stats()
+    total_sub = sum(t["submitted"] for t in stats["tenants"].values())
+    total_shed = sum(t["shed"] for t in stats["tenants"].values())
+    print(f"drained={'clean' if stream_drained and serve_drained else 'TIMEOUT'} "
+          f"cycles={stats['cycles']} submitted={total_sub} "
+          f"shed={total_shed}", file=sys.stderr)
+    return 0 if stream_drained and serve_drained else 1

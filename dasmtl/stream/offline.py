@@ -396,8 +396,9 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    # Direct file execution (`python dasmtl/stream.py`) puts dasmtl/ on
-    # sys.path, not the repo root — add the root so `import dasmtl` works.
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
+    # Direct file execution (`python dasmtl/stream/offline.py`) puts
+    # dasmtl/stream/ on sys.path, not the repo root — add the root so
+    # `import dasmtl` works.
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))))
     sys.exit(main())

@@ -1,0 +1,425 @@
+"""Streaming soak selftest: M synthetic fibers, one overdriven, through
+the REAL pipeline — ``SyntheticSource -> FiberFeed -> LiveWindower ->
+ServeLoop (MicroBatcher / StagingBuffers / ExecutorPool) -> TrackBook``
+— asserting the invariants the streaming tier exists to provide:
+
+1. **Fairness** — the overdriven fiber sheds ITS OWN windows at the
+   per-tenant gate (``shed > 0``) while every neighbor sheds nothing and
+   is never refused by the serve tier; per tenant,
+   ``submitted == resolved`` after drain (no drops).
+2. **Bounded latency** — each neighbor's p99 sample-arrival -> track
+   update latency stays under a coarse CI-safe bound while the noisy
+   neighbor saturates.
+3. **Hysteresis correctness** — every planted event is recovered as
+   exactly ONE closed track of the right type, position, and span: the
+   tile-overlap event merges across tiles into a single track; the
+   2-window blip debounces away; the NaN-poisoned windows are rejected
+   by the serve tier's SAN202 path (``rejected > 0``) WITHOUT splitting
+   the open track they land inside.
+4. **Zero post-warmup recompiles** on every pool device — the unbounded
+   stream rides the warmed bucket ladder (the counter is
+   :mod:`dasmtl.analysis.guards`', via the real executors).
+5. **Observability** — ``GET /metrics`` scraped twice mid-soak over a
+   real HTTP front end parses, carries every ``dasmtl_stream_*`` AND
+   ``dasmtl_serve_*`` required family, and never regresses a counter;
+   ``GET /events`` returns well-formed track records; the JSONL sink
+   holds exactly the emitted opens/closes.
+
+The detector is an **analytic oracle**, not a trained model: per-window
+RMS over ``n_distance_bins`` channel groups — argmax is the distance
+bin, and two RMS thresholds separate background / striking / excavating
+(the :data:`~dasmtl.stream.feed.EVENT_AMPLITUDE` convention).  It is
+deliberately simple enough to predict exactly, yet runs jitted through a
+real :class:`~dasmtl.serve.InferExecutor` per device, so the recompile /
+batching / rejection machinery under test is the production one.
+
+Run via ``python -m dasmtl.stream serve --selftest`` (the CI stream job,
+on 1 and 2 virtual CPU devices) or from tests/test_stream_live.py.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import tempfile
+import threading
+import time
+import urllib.request
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from dasmtl.stream.feed import PlantedEvent, SyntheticSource
+from dasmtl.stream.live import (REQUIRED_STREAM_METRIC_FAMILIES,
+                                StreamLoop, StreamTenant,
+                                make_stream_http_server)
+
+#: Oracle RMS thresholds: below the first is background, between is
+#: striking (A=8 -> window RMS ~5.7), above is excavating (A=16 -> ~11.4).
+ORACLE_RMS_BACKGROUND = 2.5
+ORACLE_RMS_TYPE = 8.0
+
+#: Soak geometry: 16 distance bins of 4 channels over a 64-channel tile.
+N_DISTANCE_BINS = 16
+
+
+def _oracle_infer_fn():
+    """The analytic detector, shaped exactly like a fused serve forward:
+    ``(b, h, w, 1) f32`` in; int decodes + ``bad_rows`` + per-head
+    log-probs out, all on device."""
+    import jax
+    import jax.numpy as jnp
+
+    def infer(x):
+        s = x[..., 0]
+        g = s.reshape(s.shape[0], N_DISTANCE_BINS, -1)
+        rms = jnp.sqrt(jnp.mean(jnp.square(g), axis=-1))
+        peak = jnp.max(rms, axis=-1)
+        distance = jnp.argmax(rms, axis=-1).astype(jnp.int32)
+        # Margin of the event head: 0 (background -> prob 0.5 each side),
+        # +6 (striking, prob ~0.9975) or -6 (excavating).  NaN input
+        # falls through both comparisons to a FINITE logit pair — the
+        # rejection must come from bad_rows (the SAN202 path), not from
+        # NaN leaking into the decode.
+        margin = jnp.where(peak < ORACLE_RMS_BACKGROUND, 0.0,
+                           jnp.where(peak < ORACLE_RMS_TYPE, 6.0, -6.0))
+        ev_logits = jnp.stack([margin, -margin], axis=-1) / 2.0
+        return {
+            "event": jnp.argmax(ev_logits, axis=-1).astype(jnp.int32),
+            "distance": distance,
+            "bad_rows": ~jnp.isfinite(peak),
+            "log_probs_event": jax.nn.log_softmax(ev_logits, axis=-1),
+            "log_probs_distance": jax.nn.log_softmax(rms, axis=-1),
+        }
+
+    return infer
+
+
+def _oracle_pool(input_hw: Tuple[int, int], buckets, devices: int):
+    """One warmed :class:`InferExecutor` per pool device, all running
+    the oracle — the real executors, placement, guards, and ladder."""
+    from dasmtl.serve.executor import ExecutorPool, InferExecutor
+
+    devs = ExecutorPool._pool_devices(devices)
+    fn = _oracle_infer_fn()
+    return ExecutorPool([
+        InferExecutor(fn, input_hw, buckets,
+                      source="oracle:analytic-rms", placement=d)
+        for d in devs])
+
+
+def run_selftest(*, fibers: int = 3, cycles: int = 140, devices: int = 1,
+                 inflight: int = 2, say=print) -> dict:
+    """Run the soak and return a report dict (``passed``, ``failures``,
+    per-tenant stats).  ``fibers >= 3``: fiber 0 and 1 carry the planted
+    ground truth, the LAST fiber is overdriven (4x the chunk rate),
+    extras in between are plain background neighbors."""
+    fibers = max(3, int(fibers))
+    window = (64, 64)
+    buckets = (1, 2, 4, 8)
+    channels = 160          # 3 tiles at origins 0 / 48 / 96 (stride 48)
+    stride_time = 32
+    chunk = 64              # neighbors: 2 window rows x 3 tiles per cycle
+    over_chunk = 256        # overdriven: 8 rows x 3 tiles per cycle
+    cycle_budget = 16 * fibers  # equal weights -> quota 16 each
+    dur = 512
+
+    from dasmtl.serve.server import ServeLoop
+
+    pool = _oracle_pool(window, buckets, devices)
+    say(f"[stream-selftest] warming oracle pool: buckets {list(buckets)} "
+        f"x {len(pool.executors)} device(s) ...")
+    loop = ServeLoop(pool, buckets=buckets, max_wait_s=0.002,
+                     queue_depth=256, inflight=inflight)
+    loop.start()
+    say(f"[stream-selftest] warmup {loop.stats()['warmup_s']:.2f}s; "
+        f"soaking {fibers} fibers x 3 tiles for {cycles} cycles "
+        f"(last fiber overdriven {over_chunk}/{chunk} samples/cycle)")
+
+    # Planted ground truth (all onsets stride-aligned; centers pick the
+    # tile: [0,64) / [48,112) / [96,160)).  f0 exercises single-tile
+    # tracks of both types plus the tile-overlap merge; f1 exercises the
+    # NaN-through-open-track and blip-debounce legs in tile 0.
+    f0_events = (PlantedEvent(1216, dur, 0, 72),    # striking, tile 1
+                 PlantedEvent(3200, dur, 1, 128),   # excavating, tile 2
+                 PlantedEvent(5216, dur, 0, 100))   # striking, tiles 1+2
+    f1_events = (PlantedEvent(1600, dur, 1, 32),    # excavating, tile 0
+                 PlantedEvent(3616, dur, 0, 32),    # striking + NaN inside
+                 PlantedEvent(5600, 32, 0, 72))     # 2-window blip, tile 1
+    f1_nan = (3800, 3801)  # inside the striking event's span, tile 0
+    sources = [SyntheticSource(channels, seed=0, events=f0_events),
+               SyntheticSource(channels, seed=1, events=f1_events,
+                               nan_samples=f1_nan, nan_channel=40)]
+    for i in range(2, fibers - 1):
+        sources.append(SyntheticSource(channels, seed=i))
+    sources.append(SyntheticSource(channels, seed=fibers - 1))
+
+    events_path = os.path.join(tempfile.mkdtemp(prefix="dasmtl-stream-"),
+                               "events.jsonl")
+    ids = itertools.count(1)
+    tenants = [StreamTenant(f"f{i}", src, window=window,
+                            stride_time=stride_time, stride_channels=48,
+                            ring_samples=4096,
+                            chunk_samples=(over_chunk if i == fibers - 1
+                                           else chunk),
+                            n_distance_bins=N_DISTANCE_BINS,
+                            track_ids=ids)
+               for i, src in enumerate(sources)]
+    over = tenants[-1]
+    neighbors = tenants[:-1]
+    stream = StreamLoop(loop, tenants, cycle_budget=cycle_budget,
+                        max_wait_s=0.002, events_path=events_path)
+
+    httpd = make_stream_http_server(stream, "127.0.0.1", 0)
+    http_thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    http_thread.start()
+    host, port = httpd.server_address[:2]
+
+    failures: List[str] = []
+    scrapes: List[str] = []
+
+    def scrape() -> None:
+        try:
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics", timeout=10.0) as r:
+                scrapes.append(r.read().decode("utf-8"))
+        except Exception as exc:  # noqa: BLE001 — a failed scrape IS a finding
+            failures.append(f"/metrics scrape failed: "
+                            f"{type(exc).__name__}: {exc}")
+
+    events_body: Optional[list] = None
+    try:
+        for c in range(cycles):
+            stream.run_cycle()
+            # Pace the pump to the data plane so neighbors never pile
+            # outstanding work toward their caps: the ONLY shedding left
+            # is the overdriven tenant's per-cycle quota — deterministic,
+            # machine-speed independent.
+            deadline = time.monotonic() + 2.0
+            while (any(t.outstanding > 4 for t in tenants)
+                   and time.monotonic() < deadline):
+                time.sleep(0.001)
+            if c in (cycles // 3, (2 * cycles) // 3):
+                scrape()
+        try:
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/events?n=50", timeout=10.0) as r:
+                events_body = json.loads(r.read().decode("utf-8"))
+        except Exception as exc:  # noqa: BLE001
+            failures.append(f"GET /events failed: "
+                            f"{type(exc).__name__}: {exc}")
+        stream_drained = stream.drain(timeout=60.0)
+        serve_drained = loop.drain(timeout=60.0)
+    finally:
+        httpd.shutdown()
+        http_thread.join(timeout=10.0)
+        stream.close()
+        loop.close()
+
+    # -- 1. fairness ---------------------------------------------------------
+    if not stream_drained:
+        failures.append("stream drain timed out — windows never resolved")
+    if not serve_drained:
+        failures.append("serve drain timed out")
+    for t in tenants:
+        if t.submitted != t.resolved:
+            failures.append(f"{t.name}: submitted {t.submitted} != "
+                            f"resolved {t.resolved} — windows dropped")
+    if over.shed == 0:
+        failures.append(f"overdriven {over.name} never shed — the "
+                        f"fairness gate did not engage")
+    for t in neighbors:
+        if t.shed:
+            failures.append(f"neighbor {t.name} shed {t.shed} window(s) "
+                            f"— the overdriven fiber stole its share")
+        if t.serve_refused:
+            failures.append(f"neighbor {t.name}: {t.serve_refused} "
+                            f"serve-tier refusal(s) — saturation leaked "
+                            f"past the tenancy gate")
+        if t.windower.overrun_windows:
+            failures.append(f"neighbor {t.name}: ring overran "
+                            f"{t.windower.overrun_windows} window(s)")
+
+    # -- 2. bounded latency --------------------------------------------------
+    for t in neighbors:
+        p99 = t.p99_latency_s()
+        if p99 > 5.0:
+            failures.append(f"{t.name}: p99 sample->event latency "
+                            f"{p99:.2f}s > 5.0s bound")
+
+    # -- 3. hysteresis correctness vs planted ground truth -------------------
+    def check_tracks(t: StreamTenant, expected, label: str) -> None:
+        closed = sorted(t.book.closed_tracks, key=lambda tr: tr.onset_sample)
+        if t.book.open_track_count:
+            failures.append(f"{label}: {t.book.open_track_count} track(s) "
+                            f"still open after the events ended")
+        if len(closed) != len(expected):
+            failures.append(
+                f"{label}: {len(closed)} closed track(s) != "
+                f"{len(expected)} planted event(s) — "
+                + "; ".join(f"type {tr.event} onset {tr.onset_sample} "
+                            f"pos {tr.fiber_pos:.0f} tiles {sorted(tr.tiles)}"
+                            for tr in closed))
+            return
+        for tr, ev in zip(closed, expected):
+            if tr.event != ev.event:
+                failures.append(f"{label}: track at {tr.onset_sample} "
+                                f"decoded type {tr.event}, planted "
+                                f"{ev.event}")
+            if abs(tr.onset_sample - ev.onset) > 6 * stride_time:
+                failures.append(f"{label}: onset {tr.onset_sample} off "
+                                f"planted {ev.onset} by > "
+                                f"{6 * stride_time}")
+            if abs(tr.fiber_pos - ev.center_channel) > 8:
+                failures.append(f"{label}: fiber_pos {tr.fiber_pos:.1f} "
+                                f"off planted center {ev.center_channel} "
+                                f"by > 8 channels")
+            if not (ev.duration - 64 <= tr.end_sample - tr.onset_sample
+                    <= ev.duration + 128):
+                failures.append(f"{label}: span [{tr.onset_sample}, "
+                                f"{tr.end_sample}) inconsistent with "
+                                f"planted duration {ev.duration}")
+
+    f0, f1 = tenants[0], tenants[1]
+    check_tracks(f0, f0_events, "f0")
+    if len(f0.book.closed_tracks) == 3:
+        merged = sorted(f0.book.closed_tracks,
+                        key=lambda tr: tr.onset_sample)[2]
+        if sorted(merged.tiles) != [1, 2]:
+            failures.append(f"f0: tile-overlap event recovered on tiles "
+                            f"{sorted(merged.tiles)}, expected the "
+                            f"cross-tile merge to span [1, 2]")
+    if f0.book.opens != 3:
+        failures.append(f"f0: {f0.book.opens} opens for 3 planted events "
+                        f"— the overlap event double-opened or flapped")
+    # f1's blip must NOT appear: exactly the two real events close.
+    check_tracks(f1, f1_events[:2], "f1")
+    if f1.rejected != 2:
+        failures.append(f"f1: {f1.rejected} nonfinite rejection(s), "
+                        f"expected exactly 2 (the planted NaN samples "
+                        f"poison two windows of tile 0)")
+    for t in neighbors[2:]:
+        if t.book.opens:
+            failures.append(f"background neighbor {t.name} opened "
+                            f"{t.book.opens} phantom track(s)")
+
+    # -- 4. zero post-warmup recompiles per device ---------------------------
+    stats = loop.stats()
+    per_device = stats["executor"].get("per_device", [])
+    per_device_compiles = [
+        {"placement": p.get("placement"),
+         "warmup_compiles": p.get("warmup_compiles", 0),
+         "post_warmup_compiles": p.get("post_warmup_compiles", 0)}
+        for p in per_device]
+    for p in per_device_compiles:
+        if p["post_warmup_compiles"]:
+            failures.append(
+                f"device {p['placement']}: {p['post_warmup_compiles']} "
+                f"post-warmup recompile(s) — a stream shape escaped the "
+                f"warmed bucket ladder")
+
+    # -- 5. observability ----------------------------------------------------
+    scrape_report = None
+    if len(scrapes) == 2:
+        from dasmtl.obs.registry import (monotone_regressions,
+                                         parse_exposition)
+        from dasmtl.serve.selftest import REQUIRED_METRIC_FAMILIES
+
+        parsed = []
+        for i, text in enumerate(scrapes):
+            try:
+                parsed.append(parse_exposition(text))
+            except ValueError as exc:
+                failures.append(f"/metrics scrape {i} not well-formed: "
+                                f"{exc}")
+        if len(parsed) == 2:
+            for fam in (REQUIRED_STREAM_METRIC_FAMILIES
+                        + REQUIRED_METRIC_FAMILIES):
+                if fam not in parsed[1]:
+                    failures.append(f"/metrics missing required family "
+                                    f"{fam}")
+            regressions = monotone_regressions(parsed[0], parsed[1])
+            for r in regressions:
+                failures.append(f"counter decreased between scrapes: {r}")
+            scrape_report = {"scrapes": 2, "families": len(parsed[1]),
+                             "monotone_ok": not regressions}
+    if events_body is not None:
+        kinds = {r.get("kind") for r in events_body}
+        if not {"open", "close"} <= kinds:
+            failures.append(f"GET /events carries kinds {sorted(kinds)} "
+                            f"— expected open AND close records")
+        for r in events_body[:3]:
+            missing = {"track_id", "fiber", "event_name", "onset_sample",
+                       "fiber_pos", "confidence"} - set(r)
+            if missing:
+                failures.append(f"/events record missing keys {missing}")
+    total_opens = sum(t.book.opens for t in tenants)
+    total_closes = sum(t.book.closes for t in tenants)
+    with open(events_path, encoding="utf-8") as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    jsonl_opens = sum(1 for r in recs if r["kind"] == "open")
+    jsonl_closes = sum(1 for r in recs if r["kind"] == "close")
+    if (jsonl_opens, jsonl_closes) != (total_opens, total_closes):
+        failures.append(f"JSONL sink holds {jsonl_opens} opens / "
+                        f"{jsonl_closes} closes; books counted "
+                        f"{total_opens} / {total_closes}")
+
+    tstats = stream.stats()["tenants"]
+    report = {
+        "passed": not failures,
+        "failures": failures,
+        "fibers": fibers,
+        "cycles": cycles,
+        "devices": len(per_device_compiles) or 1,
+        "warmup_s": stats.get("warmup_s"),
+        "per_device_compiles": per_device_compiles,
+        "tenants": tstats,
+        "tracks_closed": total_closes,
+        "overdriven_shed": over.shed,
+        "rejected": f1.rejected,
+        "metrics_scrape": scrape_report,
+        "events_jsonl": events_path,
+    }
+    say(f"[stream-selftest] {sum(t['submitted'] for t in tstats.values())} "
+        f"windows over {cycles} cycles; overdriven shed {over.shed}; "
+        f"{total_closes} tracks closed ({f1.rejected} NaN rejections "
+        f"absorbed); neighbor p99 "
+        f"{max(t.p99_latency_s() for t in neighbors) * 1e3:.0f}ms; "
+        f"post-warmup recompiles "
+        f"{sum(p['post_warmup_compiles'] for p in per_device_compiles)} "
+        f"across {report['devices']} device(s)")
+    for f in failures:
+        say(f"[stream-selftest] FAIL: {f}")
+    say(f"[stream-selftest] {'PASSED' if report['passed'] else 'FAILED'}")
+    return report
+
+
+def write_stream_job_summary(report: dict,
+                             path: Optional[str] = None) -> None:
+    """Append a markdown summary to CI's ``$GITHUB_STEP_SUMMARY``."""
+    path = path or os.environ.get("GITHUB_STEP_SUMMARY")
+    if not path:
+        return
+    lines = [
+        f"### stream soak ({report['fibers']} fibers, "
+        f"{report['devices']} device(s))",
+        "",
+        f"- passed: **{report['passed']}**",
+        f"- warmup: **{report['warmup_s']:.2f}s**"
+        if report.get("warmup_s") is not None else "- warmup: n/a",
+        f"- tracks closed: **{report['tracks_closed']}**; overdriven "
+        f"shed **{report['overdriven_shed']}**; NaN rejections "
+        f"**{report['rejected']}**",
+        "",
+        "| fiber | submitted | shed | rejected | tracks | p99 (ms) |",
+        "|---|---|---|---|---|---|",
+    ]
+    for name, t in report.get("tenants", {}).items():
+        lines.append(f"| {name} | {t['submitted']} | {t['shed']} "
+                     f"| {t['rejected']} | {t['track_closes']} "
+                     f"| {t['p99_latency_ms']} |")
+    for f in report.get("failures", []):
+        lines.append(f"- FAIL: {f}")
+    with open(path, "a", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")

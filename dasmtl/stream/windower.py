@@ -1,0 +1,114 @@
+"""Sliding temporal windows x spatial tiles off a live ring buffer.
+
+The offline sweep lays a static grid over a finite record
+(:mod:`dasmtl.data.windowing`); a live fiber has no end, but the same
+static-shape discipline still rules: every window the stream ever emits
+is the SAME ``(h, w)`` shape, so the serve pool's bucket ladder compiles
+once at warmup and the whole unbounded stream rides zero post-warmup
+recompiles.
+
+- **Spatial tiles** reuse the offline planner verbatim: the tile origins
+  are :func:`~dasmtl.data.windowing.plan_windows` over a ``(channels, w)``
+  pseudo-record — same clamped-tail convention, so the last tile overlaps
+  its neighbor to cover the fiber edge with real data instead of padding.
+- **Temporal windows** slide by ``stride_time``; a window is cut only
+  once fully arrived (no padding, no ragged shapes).  When the cutter
+  falls behind the ring (the feed outpaced consumption), it *skips
+  forward* to the oldest still-retained origin and counts the lost
+  windows in ``overrun_windows`` — loss is explicit, never a silent read
+  of overwritten samples.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from dasmtl.data.windowing import plan_windows
+from dasmtl.stream.feed import FiberFeed
+
+
+@dataclasses.dataclass(frozen=True)
+class CutWindow:
+    """One model-ready window: ``x`` is ``(h, w, 1) float32``; ``tile``
+    indexes the spatial tile ladder (``c_origin`` its channel origin);
+    ``t_origin``/``t_end`` are absolute sample indices; ``arrival_s`` is
+    the feed clock reading when the window's last sample landed (the
+    anchor of the sample->event latency histogram)."""
+
+    x: np.ndarray
+    tile: int
+    c_origin: int
+    t_origin: int
+    t_end: int
+    arrival_s: float
+
+
+class LiveWindower:
+    """Cut static-shape windows off a :class:`FiberFeed` as samples land."""
+
+    def __init__(self, feed: FiberFeed, window: Tuple[int, int], *,
+                 stride_time: int = 0, stride_channels: int = 0):
+        h, w = int(window[0]), int(window[1])
+        if feed.channels < h:
+            raise ValueError(f"fiber has {feed.channels} channels < "
+                             f"window height {h} — zero-padding a live "
+                             f"fiber is never right; pick a window that "
+                             f"fits")
+        if feed.ring_samples < w:
+            raise ValueError(f"ring of {feed.ring_samples} samples cannot "
+                             f"hold a {w}-sample window")
+        self.feed = feed
+        self.window = (h, w)
+        self.stride_time = int(stride_time) or w
+        self.stride_channels = int(stride_channels) or h
+        # The offline planner, reused for the spatial axis only: one
+        # "temporal" position (record width == window width) leaves
+        # exactly the clamped-tail tile origins.
+        plan = plan_windows((feed.channels, w), window=(h, w),
+                            stride=(self.stride_channels, w))
+        self.tile_origins = tuple(plan.origin(i)[0]
+                                  for i in range(plan.n_windows))
+        self.n_tiles = len(self.tile_origins)
+        self._next_t = 0  # absolute t_origin of the next uncut window row
+        self.overrun_windows = 0
+        self.cut_windows = 0
+
+    def ready_rows(self) -> int:
+        """Window rows fully arrived but not yet cut."""
+        h, w = self.window
+        if self.feed.total < self._next_t + w:
+            return 0
+        return (self.feed.total - w - self._next_t) \
+            // self.stride_time + 1
+
+    def cut(self, max_windows: Optional[int] = None) -> List[CutWindow]:
+        """All currently cuttable windows (oldest first), tile-major
+        within each time row.  Bounded by ``max_windows`` when given."""
+        h, w = self.window
+        out: List[CutWindow] = []
+        while self._next_t + w <= self.feed.total:
+            if max_windows is not None and len(out) >= max_windows:
+                break
+            if self._next_t < self.feed.oldest:
+                # Overrun: the ring dropped samples this row needed.
+                # Skip to the first origin whose window is fully retained.
+                behind = self.feed.oldest - self._next_t
+                skipped = math.ceil(behind / self.stride_time)
+                self.overrun_windows += skipped * self.n_tiles
+                self._next_t += skipped * self.stride_time
+                continue
+            block = self.feed.view(self._next_t, w)  # (channels, w)
+            arrival = self.feed.arrival_time(self._next_t + w - 1)
+            for tile, c0 in enumerate(self.tile_origins):
+                out.append(CutWindow(
+                    x=np.ascontiguousarray(
+                        block[c0:c0 + h, :, None], dtype=np.float32),
+                    tile=tile, c_origin=c0, t_origin=self._next_t,
+                    t_end=self._next_t + w, arrival_s=arrival))
+            self.cut_windows += self.n_tiles
+            self._next_t += self.stride_time
+        return out

@@ -154,6 +154,26 @@ def collect() -> dict:
     }
     info["artifact_registry"] = _registry_summary(d.serve_registry_dir)
 
+    # Live streaming tier (dasmtl/stream/, docs/STREAMING.md): the
+    # resolved `dasmtl stream serve` config — windowing geometry, the
+    # tenancy fairness gate, and the track state machine's thresholds.
+    info["stream"] = {
+        "stride_time": d.stream_stride_time or "window",
+        "stride_channels": d.stream_stride_channels or "window",
+        "ring_samples": d.stream_ring_samples,
+        "chunk_samples": d.stream_chunk_samples or "stride",
+        "cycle_budget": d.stream_cycle_budget,
+        "max_wait_ms": d.stream_max_wait_ms,
+        "poll_ms": d.stream_poll_ms,
+        "open_windows": d.stream_open_windows,
+        "close_windows": d.stream_close_windows,
+        "min_event_prob": d.stream_min_event_prob,
+        "track_merge_bins": d.stream_track_merge_bins,
+        "distance_ewma": d.stream_distance_ewma,
+        "events_ring": d.stream_events_ring,
+        "events_path": d.stream_events_path or "none",
+    }
+
     # Unified telemetry layer (dasmtl/obs/, docs/OBSERVABILITY.md): the
     # resolved obs config — heartbeat cadence, latency buckets, trace
     # ring, SLO/profiler knobs.
@@ -346,6 +366,9 @@ def main(argv=None) -> int:
     print("  router defaults: " + ", ".join(
         f"{k}={v}" for k, v in info["router_defaults"].items())
         + " (dasmtl-router; docs/SERVING.md 'Router tier')")
+    print("  stream: " + ", ".join(
+        f"{k}={v}" for k, v in info["stream"].items())
+        + " (dasmtl stream serve; docs/STREAMING.md)")
     reg = info.get("artifact_registry", {})
     if reg.get("status") == "ok":
         vs = ", ".join(

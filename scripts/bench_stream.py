@@ -3,8 +3,18 @@ host path (per-batch window assembly + H2D) vs device-resident path
 (record in HBM, windows sliced in-graph) — the measurement behind
 ``stream.py --resident``.
 
+``--soak`` benches the LIVE tier instead (dasmtl/stream/live.py,
+docs/STREAMING.md): a sustained-rate soak of N synthetic fibers through
+the oracle-backed serve plane at 1x and 2x offered load, recording
+windows/s per device, p99 sample->event latency, and the per-fiber shed
+rate — at 1x every fiber fits its fairness quota (shed 0), at 2x every
+fiber exceeds it and sheds its own excess.  The report lands in
+``BENCH_stream.json`` alongside the repo's other ``BENCH_*.json``
+snapshots.
+
 Run:  python scripts/bench_stream.py [--time_samples 120000] [--batch 256]
-Emits one JSON line per path on stdout.
+      python scripts/bench_stream.py --soak [--soak_cycles 120]
+Emits one JSON line per path/leg on stdout.
 """
 
 from __future__ import annotations
@@ -71,6 +81,107 @@ def latency(iters: int = 200) -> int:
     return 0
 
 
+def soak(cycles: int = 120, fibers: int = 3, devices: int = 1,
+         out: str = "BENCH_stream.json") -> int:
+    """Sustained-rate soak of the live tier at 1x and 2x offered load.
+
+    Geometry mirrors the stream selftest (64x64 windows, 3 tiles of a
+    160-channel fiber, stride 32, oracle detector through real
+    executors).  The fairness quota is sized to the 1x rate: the 2x leg
+    oversubscribes EVERY fiber, so its shed rate is the per-tenant gate
+    working as designed — windows/s per device stays the honest number
+    because shed windows never reach the serve plane."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from dasmtl.serve.server import ServeLoop
+    from dasmtl.stream.feed import SyntheticSource
+    from dasmtl.stream.live import StreamLoop, StreamTenant
+    from dasmtl.stream.selftest import _oracle_pool
+    from dasmtl.utils.platform import normalize_backend
+
+    backend = normalize_backend(jax.default_backend())
+    window, buckets, channels = (64, 64), (1, 2, 4, 8), 160
+    base_chunk = 64  # 2 window rows x 3 tiles = 6 windows/fiber/cycle
+    report = {"backend": backend, "devices": devices, "fibers": fibers,
+              "cycles": cycles, "window": "64x64", "tiles": 3,
+              "legs": {}}
+    for load_x in (1, 2):
+        pool = _oracle_pool(window, buckets, devices)
+        loop = ServeLoop(pool, buckets=buckets, max_wait_s=0.002,
+                         queue_depth=256, inflight=2)
+        loop.start()
+        tenants = [StreamTenant(f"f{i}",
+                                SyntheticSource(channels, seed=i),
+                                window=window, stride_time=32,
+                                stride_channels=48, ring_samples=4096,
+                                chunk_samples=base_chunk * load_x)
+                   for i in range(fibers)]
+        # Quota sized to the 1x rate: 8 submit slots per fiber per cycle
+        # against 6 offered at 1x (headroom, shed 0) and 12 at 2x
+        # (oversubscribed, each fiber sheds its own excess).
+        stream = StreamLoop(loop, tenants, cycle_budget=8 * fibers,
+                            max_wait_s=0.002)
+        t0 = _time.perf_counter()
+        for _ in range(cycles):
+            stream.run_cycle()
+            deadline = _time.monotonic() + 2.0
+            while (any(t.outstanding > 4 for t in tenants)
+                   and _time.monotonic() < deadline):
+                _time.sleep(0.0005)
+        stream.drain(timeout=60.0)
+        elapsed = _time.perf_counter() - t0
+        loop.drain(timeout=60.0)
+        stream.close()
+        loop.close()
+        resolved = sum(t.resolved for t in tenants)
+        shed = sum(t.shed for t in tenants)
+        p99s = [t.p99_latency_s() * 1e3 for t in tenants]
+        leg = {
+            "metric": f"stream_soak_windows_per_s_per_device_x{load_x}",
+            "value": round(resolved / elapsed / devices, 2),
+            "unit": "windows/s/device",
+            "offered_load_x": load_x,
+            "windows_resolved": resolved,
+            "windows_shed": shed,
+            "shed_rate": round(shed / max(1, resolved + shed), 4),
+            "per_fiber_shed_rate": [
+                round(t.shed / max(1, t.submitted + t.shed), 4)
+                for t in tenants],
+            "p99_sample_to_event_ms": round(float(np.max(p99s)), 2),
+            "per_fiber_p99_ms": [round(p, 2) for p in p99s],
+            "elapsed_s": round(elapsed, 3),
+            "post_warmup_recompiles": sum(
+                e.post_warmup_compiles for e in pool.executors),
+        }
+        report["legs"][f"x{load_x}"] = leg
+        print(json.dumps(leg))
+        print(f"soak x{load_x}: {leg['value']:,.0f} windows/s/device, "
+              f"shed rate {leg['shed_rate']:.1%}, p99 "
+              f"{leg['p99_sample_to_event_ms']:.0f}ms", file=sys.stderr)
+    rc = 0
+    if report["legs"]["x1"]["windows_shed"]:
+        print("FAIL: 1x load shed windows — quota headroom gone",
+              file=sys.stderr)
+        rc = 1
+    if not report["legs"]["x2"]["windows_shed"]:
+        print("FAIL: 2x load never shed — the gate is not engaging",
+              file=sys.stderr)
+        rc = 1
+    if any(leg["post_warmup_recompiles"]
+           for leg in report["legs"].values()):
+        print("FAIL: post-warmup recompile during soak", file=sys.stderr)
+        rc = 1
+    if out:
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {out}", file=sys.stderr)
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--time_samples", type=int, default=120_000,
@@ -82,11 +193,23 @@ def main() -> int:
     ap.add_argument("--latency", action="store_true",
                     help="measure batch-1/8 per-dispatch latency (p50/p99) "
                          "instead of throughput")
+    ap.add_argument("--soak", action="store_true",
+                    help="sustained-rate soak of the LIVE tier at 1x/2x "
+                         "offered load: windows/s per device, p99 "
+                         "sample->event latency, per-fiber shed rate; "
+                         "report lands in --out")
+    ap.add_argument("--soak_cycles", type=int, default=120)
+    ap.add_argument("--soak_devices", type=int, default=1)
+    ap.add_argument("--out", type=str, default="BENCH_stream.json",
+                    help="soak report path ('' = stdout lines only)")
     args = ap.parse_args()
 
     # stream_predict builds fresh jitted closures per call, so the warm-up
     # call can only warm the *persistent* compilation cache — enable it.
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dasmtl_jax_cache")
+    if args.soak:
+        return soak(cycles=args.soak_cycles, devices=args.soak_devices,
+                    out=args.out)
     if args.latency:
         return latency()
 

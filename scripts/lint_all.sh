@@ -95,4 +95,15 @@ else
     echo "== obs smoke skipped (DASMTL_LINT_SKIP_OBS set)"
 fi
 
+# Streaming soak: the live tier's selftest — planted events through the
+# oracle-backed serve plane, fairness isolation, track recovery, 0
+# post-warmup recompiles (dasmtl/stream/, docs/STREAMING.md).  CI's
+# stream job runs this on 1 and 2 virtual devices plus the bench soak.
+if [ "${DASMTL_LINT_SKIP_STREAM:-}" = "" ]; then
+    echo "== dasmtl stream serve --selftest"
+    python -m dasmtl.stream serve --selftest || rc=1
+else
+    echo "== stream soak selftest skipped (DASMTL_LINT_SKIP_STREAM set)"
+fi
+
 exit $rc

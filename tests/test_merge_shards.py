@@ -1,6 +1,8 @@
-"""Unit tests for scripts/merge_stream_shards.py: shard discovery, ordering,
-and the incomplete/mixed-shard-set refusals (multi-host streaming writes one
-``<base>.p<i>.csv`` per process — dasmtl/stream.py)."""
+"""Unit tests for the shard merge tool (dasmtl/stream/merge.py; the
+``scripts/merge_stream_shards.py`` shim re-exports it): shard discovery,
+ordering, header-only trailing shards, and the incomplete/mixed-shard-set
+refusals (multi-host streaming writes one ``<base>.p<i>.csv`` per process
+— dasmtl/stream/offline.py)."""
 
 import csv
 import os
@@ -81,3 +83,36 @@ def test_merge_rejects_header_mismatch(tmp_path):
 def test_merge_requires_some_shards(tmp_path):
     with pytest.raises(FileNotFoundError):
         merge_shards(str(tmp_path / "nothing.csv"))
+
+
+def test_merge_header_only_trailing_shards(tmp_path):
+    # Multi-host lockstep batching (shard_windows + the trailing
+    # all-padding batches of _batch_ranges): a host whose ENTIRE share
+    # was padding writes a header-only shard.  Those must merge cleanly
+    # — they are a correct run's output, not a truncated file.
+    base = str(tmp_path / "pred.csv")
+    _write_shard(str(tmp_path / "pred.p0.csv"), [1, 0, 2])
+    _write_shard(str(tmp_path / "pred.p1.csv"), [])
+    _write_shard(str(tmp_path / "pred.p2.csv"), [])
+    assert merge_shards(base, expect_shards=3) == 3
+    with open(base) as f:
+        got = [int(r["window_index"]) for r in csv.DictReader(f)]
+    assert got == [0, 1, 2]
+    # A header-only shard still participates in the header-agreement
+    # check: a mismatched header on an empty shard is a mixed run.
+    with open(str(tmp_path / "pred.p2.csv"), "w", newline="") as f:
+        csv.DictWriter(f, fieldnames=["window_index", "other"]).writeheader()
+    with pytest.raises(ValueError, match="header"):
+        merge_shards(base)
+
+
+def test_script_shim_reexports_package_module():
+    # The documented `python scripts/merge_stream_shards.py` invocation
+    # must stay the SAME code as the package module, not a fork.
+    import dasmtl.stream.merge as pkg
+
+    import merge_stream_shards as shim
+
+    assert shim.merge_shards is pkg.merge_shards
+    assert shim.find_shards is pkg.find_shards
+    assert shim.main is pkg.main

@@ -1,0 +1,227 @@
+"""Live-ingestion tier units (dasmtl/stream/feed.py, windower.py) plus a
+small end-to-end StreamLoop pass over the oracle-backed serve plane:
+ring-buffer absolute addressing, overrun accounting, static-shape window
+cutting against the offline tile convention, synthetic-source
+determinism, and one planted event flowing ingest -> serve -> track."""
+
+import numpy as np
+import pytest
+
+from dasmtl.stream.feed import (EVENT_SPAN_CHANNELS, FiberFeed,
+                                PlantedEvent, SyntheticSource)
+from dasmtl.stream.windower import LiveWindower
+
+
+# -- FiberFeed -----------------------------------------------------------------
+
+def _chunk(c0, n, channels=4):
+    """(channels, n) chunk whose row 0 holds absolute sample indices."""
+    x = np.zeros((channels, n), np.float32)
+    x[0] = np.arange(c0, c0 + n)
+    return x
+
+
+def test_feed_absolute_addressing_and_wraparound():
+    f = FiberFeed(4, ring_samples=10)
+    assert f.append(_chunk(0, 6)) == 6
+    f.append(_chunk(6, 6))                 # wraps: 12 > ring of 10
+    assert f.total == 12
+    assert f.oldest == 2
+    got = f.view(2, 10)
+    assert got.shape == (4, 10)
+    assert got[0].tolist() == list(range(2, 12))
+    # A view spanning the physical wrap seam is still contiguous data.
+    assert f.view(8, 4)[0].tolist() == [8, 9, 10, 11]
+
+
+def test_feed_view_refuses_overwritten_and_future_samples():
+    f = FiberFeed(4, ring_samples=8)
+    f.append(_chunk(0, 12))
+    with pytest.raises(IndexError, match="overwritten"):
+        f.view(3, 4)                       # oldest is 4
+    with pytest.raises(IndexError, match="not yet appended"):
+        f.view(10, 4)                      # reaches past total=12
+    assert f.view(4, 8)[0].tolist() == list(range(4, 12))
+
+
+def test_feed_oversized_chunk_keeps_newest_tail():
+    f = FiberFeed(4, ring_samples=8)
+    f.append(_chunk(0, 3))
+    f.append(_chunk(3, 20))                # 20 > ring: only tail survives
+    assert f.total == 23
+    assert f.oldest == 15
+    assert f.view(15, 8)[0].tolist() == list(range(15, 23))
+
+
+def test_feed_arrival_time_tracks_the_covering_append():
+    f = FiberFeed(2, ring_samples=100)
+    f.append(_chunk(0, 10, 2), now=1.0)
+    f.append(_chunk(10, 10, 2), now=2.5)
+    assert f.arrival_time(0) == 1.0
+    assert f.arrival_time(9) == 1.0
+    assert f.arrival_time(10) == 2.5
+    assert f.arrival_time(19) == 2.5
+
+
+def test_feed_rejects_bad_chunk_shapes():
+    f = FiberFeed(4, ring_samples=8)
+    with pytest.raises(ValueError, match="chunk shape"):
+        f.append(np.zeros((3, 5), np.float32))
+    assert f.append(np.zeros((4, 0), np.float32)) == 0
+
+
+# -- LiveWindower --------------------------------------------------------------
+
+def test_windower_tiles_match_offline_planner_convention():
+    from dasmtl.data.windowing import plan_windows
+    feed = FiberFeed(160, ring_samples=4096)
+    wdw = LiveWindower(feed, (64, 64), stride_channels=48)
+    plan = plan_windows((160, 64), window=(64, 64), stride=(48, 64))
+    assert wdw.tile_origins == tuple(
+        plan.origin(i)[0] for i in range(plan.n_windows))
+    # Clamped tail: the last tile ends exactly at the fiber edge.
+    assert wdw.tile_origins == (0, 48, 96)
+    assert wdw.tile_origins[-1] + 64 == 160
+
+
+def test_windower_cuts_only_fully_arrived_static_shapes():
+    feed = FiberFeed(160, ring_samples=4096)
+    wdw = LiveWindower(feed, (64, 64), stride_time=32, stride_channels=48)
+    feed.append(np.zeros((160, 63), np.float32))
+    assert wdw.ready_rows() == 0
+    assert wdw.cut() == []
+    feed.append(np.zeros((160, 33), np.float32), now=7.0)  # total 96
+    assert wdw.ready_rows() == 2                           # t=0 and t=32
+    cuts = wdw.cut()
+    assert len(cuts) == 2 * 3
+    assert all(c.x.shape == (64, 64, 1) for c in cuts)
+    assert all(c.x.dtype == np.float32 for c in cuts)
+    assert [(c.t_origin, c.tile) for c in cuts[:4]] == [
+        (0, 0), (0, 1), (0, 2), (32, 0)]
+    assert all(c.arrival_s == 7.0 for c in cuts)           # last sample's
+    assert wdw.cut() == []                                 # nothing new
+    assert wdw.cut_windows == 6
+
+
+def test_windower_window_content_matches_feed():
+    feed = FiberFeed(160, ring_samples=4096)
+    rng = np.random.default_rng(0)
+    feed.append(rng.normal(size=(160, 64)).astype(np.float32))
+    wdw = LiveWindower(feed, (64, 64), stride_channels=48)
+    cuts = wdw.cut()
+    block = feed.view(0, 64)
+    for c in cuts:
+        np.testing.assert_array_equal(
+            c.x[..., 0], block[c.c_origin:c.c_origin + 64])
+
+
+def test_windower_overrun_skips_forward_and_counts_loss():
+    feed = FiberFeed(160, ring_samples=128)
+    wdw = LiveWindower(feed, (64, 64), stride_time=32, stride_channels=48)
+    feed.append(np.zeros((160, 320), np.float32))  # ring keeps [192, 320)
+    cuts = wdw.cut()
+    # Rows 0..160 lost (origins below oldest=192): 6 rows x 3 tiles.
+    assert wdw.overrun_windows == 6 * 3
+    assert [c.t_origin for c in cuts[::3]] == [192, 224, 256]
+    assert wdw.cut_windows == 9
+    # After the skip the cutter is realigned: appends resume cleanly.
+    feed.append(np.zeros((160, 32), np.float32))
+    assert [c.t_origin for c in wdw.cut()[::3]] == [288]
+    assert wdw.overrun_windows == 18
+
+
+def test_windower_max_windows_bound_resumes_where_it_left():
+    feed = FiberFeed(160, ring_samples=4096)
+    wdw = LiveWindower(feed, (64, 64), stride_time=32, stride_channels=48)
+    feed.append(np.zeros((160, 160), np.float32))
+    first = wdw.cut(max_windows=4)
+    # Bounded cuts stop at row granularity boundaries mid-stream but
+    # never drop: the remainder arrives on the next call.
+    rest = wdw.cut()
+    assert len(first) + len(rest) == 4 * 3
+    origins = [(c.t_origin, c.tile) for c in first + rest]
+    assert origins == [(t, k) for t in (0, 32, 64, 96) for k in range(3)]
+
+
+def test_windower_rejects_impossible_geometry():
+    with pytest.raises(ValueError, match="channels"):
+        LiveWindower(FiberFeed(32, 4096), (64, 64))
+    with pytest.raises(ValueError, match="ring"):
+        LiveWindower(FiberFeed(160, 32), (64, 64))
+
+
+# -- SyntheticSource -----------------------------------------------------------
+
+def test_synthetic_source_is_deterministic_per_seed():
+    a = SyntheticSource(16, seed=3)
+    b = SyntheticSource(16, seed=3)
+    for _ in range(3):
+        np.testing.assert_array_equal(a.poll(40), b.poll(40))
+    assert not np.array_equal(
+        SyntheticSource(16, seed=3).poll(40),
+        SyntheticSource(16, seed=4).poll(40))
+
+
+def test_synthetic_source_plants_events_and_nans():
+    ev = PlantedEvent(onset=50, duration=100, event=1, center_channel=8)
+    src = SyntheticSource(16, seed=0, events=(ev,),
+                          nan_samples=(60,), nan_channel=2)
+    x = src.poll(200)
+    assert x.shape == (16, 200)
+    c0 = 8 - EVENT_SPAN_CHANNELS // 2
+    on = x[c0:c0 + EVENT_SPAN_CHANNELS, 50:150]
+    off = x[c0:c0 + EVENT_SPAN_CHANNELS, 150:]
+    assert np.sqrt(np.nanmean(on ** 2)) > 3 * np.sqrt(np.mean(off ** 2))
+    assert np.isnan(x[2, 60])
+    assert np.isnan(x).sum() == 1
+    # The stream position carries across polls: no re-planting.
+    assert not np.isnan(src.poll(200)).any()
+
+
+# -- one event end to end through the live loop --------------------------------
+
+def test_stream_loop_end_to_end_single_fiber():
+    from dasmtl.serve.server import ServeLoop
+    from dasmtl.stream.live import StreamLoop, StreamTenant
+    from dasmtl.stream.selftest import _oracle_pool
+
+    import time as _time
+
+    pool = _oracle_pool((64, 64), (1, 2), 1)
+    serve = ServeLoop(pool, buckets=(1, 2), max_wait_s=0.002,
+                      queue_depth=64, inflight=2)
+    serve.start()
+    try:
+        # One tile (64-channel fiber), one striking event spanning whole
+        # channel groups so the oracle's RMS thresholds read it cleanly.
+        ev = PlantedEvent(onset=320, duration=512, event=0,
+                          center_channel=32)
+        tenant = StreamTenant(
+            "f0", SyntheticSource(64, seed=1, events=(ev,)),
+            window=(64, 64), stride_time=32, ring_samples=2048,
+            chunk_samples=64)
+        stream = StreamLoop(serve, [tenant], cycle_budget=8,
+                            max_wait_s=0.01)
+        for _ in range(30):
+            stream.run_cycle()
+            deadline = _time.monotonic() + 2.0
+            while tenant.outstanding and _time.monotonic() < deadline:
+                _time.sleep(0.001)
+        assert stream.drain(timeout=30.0)
+        assert tenant.resolved == tenant.submitted > 0
+        assert tenant.shed == 0 and tenant.rejected == 0
+        assert tenant.book.opens == 1 and tenant.book.closes == 1
+        (track,) = tenant.book.closed_tracks
+        assert track.event == 0
+        assert abs(track.onset_sample - ev.onset) <= 3 * 32
+        assert abs(track.fiber_pos - ev.center_channel) <= 8
+        kinds = {e["kind"] for e in stream.events(100)}
+        assert {"open", "close"} <= kinds
+        text = stream.metrics_text()
+        assert "dasmtl_stream_windows_total" in text
+        assert "dasmtl_stream_track_opens_total" in text
+        assert sum(e.post_warmup_compiles for e in pool.executors) == 0
+    finally:
+        stream.close()
+        serve.drain(timeout=10.0)
+        serve.close()

@@ -1,0 +1,86 @@
+"""Package-hygiene pins for dasmtl/stream: the offline import surface
+must stay light (no dasmtl.serve, no jax at import time — the lazy
+``_LIVE_EXPORTS`` indirection in dasmtl/stream/__init__.py), the
+pre-package public API must keep resolving, and both documented script
+entrypoints (root ``stream.py``, ``python -m dasmtl.stream``) must keep
+working."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(code):
+    return subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, capture_output=True,
+        text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def test_import_stream_does_not_load_serve_or_jax():
+    # The offline sweep (and anything that just wants stream_predict /
+    # the track state machine) must not pay serve-plane import cost —
+    # and must not risk a circular import through dasmtl.serve, which
+    # itself is reachable from dasmtl.stream.live.
+    r = _run(
+        "import sys\n"
+        "import dasmtl.stream\n"
+        "loaded = [m for m in sys.modules\n"
+        "          if m.startswith('dasmtl.serve') or m == 'jax']\n"
+        "assert not loaded, f'import dasmtl.stream pulled {loaded}'\n"
+        "print('clean')\n")
+    assert r.returncode == 0, r.stderr
+    assert "clean" in r.stdout
+
+
+def test_lazy_live_exports_resolve():
+    r = _run(
+        "import dasmtl.stream as s\n"
+        "assert s.StreamLoop.__module__ == 'dasmtl.stream.live'\n"
+        "assert s.StreamTenant.__module__ == 'dasmtl.stream.live'\n"
+        "assert callable(s.serve_main) and callable(s.run_selftest)\n"
+        "print('resolved')\n")
+    assert r.returncode == 0, r.stderr
+
+
+def test_pre_package_public_api_still_imports():
+    # tests/test_stream.py and downstream callers used these names off
+    # the old single-module dasmtl/stream.py.
+    from dasmtl.stream import (EVENT_NAMES, main, shard_csv_path,
+                               stream_predict)
+
+    assert EVENT_NAMES == ("striking", "excavating")
+    assert callable(stream_predict) and callable(main)
+    assert shard_csv_path("a/b.csv", 2, 4).endswith("b.p2.csv")
+    assert shard_csv_path("a/b.csv", 0, 1) == "a/b.csv"
+
+
+def test_unknown_attribute_raises_attribute_error():
+    import dasmtl.stream as s
+
+    with pytest.raises(AttributeError, match="no attribute"):
+        s.does_not_exist
+
+
+def test_root_shim_and_module_main_help():
+    # Root stream.py forwards to the offline CLI; `-m dasmtl.stream`
+    # dispatches `serve` to the live tier and everything else offline.
+    r = _run("import stream; assert callable(stream.main)\n"
+             "print('shim ok')")
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run(
+        [sys.executable, "-m", "dasmtl.stream", "--help"], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    assert "--record" in r.stdout
+    r = subprocess.run(
+        [sys.executable, "-m", "dasmtl.stream", "serve", "--help"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    assert "--synthetic" in r.stdout and "--selftest" in r.stdout

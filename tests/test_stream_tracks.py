@@ -1,0 +1,219 @@
+"""Fake-clock unit tests for the event-track state machine
+(dasmtl/stream/tracks.py): hysteresis thresholds, blip debounce,
+rejected-window neutrality, cross-tile track continuity, and the
+emitted record schema.  No threads, no jax — every update carries an
+explicit ``now``."""
+
+import itertools
+
+from dasmtl.stream.tracks import TrackBook, TrackFuser, WindowDecode
+
+W = 64          # window width (samples) — t_end = t_origin + W
+STRIDE = 32
+
+
+def D(i, event=0, prob=0.99, ok=True, distance=5):
+    """Decode of the i-th window row."""
+    return WindowDecode(t_origin=i * STRIDE, t_end=i * STRIDE + W,
+                        ok=ok, event=event, distance=distance,
+                        event_prob=prob)
+
+
+def NEG(i):
+    return D(i, event=0, prob=0.5)
+
+
+def REJ(i):
+    return D(i, ok=False)
+
+
+# -- TrackFuser: per-tile hysteresis ------------------------------------------
+
+def test_open_requires_exactly_open_windows_positives():
+    f = TrackFuser(open_windows=3)
+    assert f.update(D(0)) == []
+    assert f.update(D(1)) == []
+    sigs = f.update(D(2))
+    assert [s[0] for s in sigs] == ["open"]
+    assert [p.t_origin for p in sigs[0][1]] == [0, STRIDE, 2 * STRIDE]
+    assert f.open
+
+
+def test_blip_debounces_away():
+    f = TrackFuser(open_windows=3)
+    f.update(D(0))
+    f.update(D(1))
+    assert f.update(NEG(2)) == []     # 2 positives < 3: the blip dies
+    assert not f.open
+    # ...and the pending run really was cleared, not paused.
+    f.update(D(3))
+    f.update(D(4))
+    assert [s[0] for s in f.update(D(5))] == ["open"]
+
+
+def test_close_requires_exactly_close_windows_negatives():
+    f = TrackFuser(open_windows=1, close_windows=3)
+    f.update(D(0))
+    assert f.open
+    f.update(NEG(1))
+    f.update(NEG(2))
+    assert f.open
+    assert [s[0] for s in f.update(NEG(3))] == ["close"]
+    assert not f.open
+
+
+def test_positive_resets_close_count():
+    f = TrackFuser(open_windows=1, close_windows=2)
+    f.update(D(0))
+    f.update(NEG(1))
+    assert [s[0] for s in f.update(D(2))] == ["extend"]  # neg count reset
+    f.update(NEG(3))
+    assert f.open
+    assert [s[0] for s in f.update(NEG(4))] == ["close"]
+
+
+def test_rejected_windows_are_neutral_everywhere():
+    # Mid-debounce: a rejected window neither extends nor restarts the run.
+    f = TrackFuser(open_windows=3)
+    f.update(D(0))
+    f.update(REJ(1))
+    f.update(D(2))
+    assert [s[0] for s in f.update(D(3))] == ["open"]
+    # Open: rejected windows do not count toward close — a NaN-poisoned
+    # stretch inside a real event cannot split its track.
+    f2 = TrackFuser(open_windows=1, close_windows=2)
+    f2.update(D(0))
+    for i in range(1, 6):
+        assert f2.update(REJ(i)) == []
+    assert f2.open
+
+
+def test_type_flip_restarts_debounce():
+    f = TrackFuser(open_windows=2)
+    f.update(D(0, event=0))
+    sigs = f.update(D(1, event=1))    # flip: the striking run is stale
+    assert sigs == []
+    sigs = f.update(D(2, event=1))
+    assert [s[0] for s in sigs] == ["open"]
+    assert all(p.event == 1 for p in sigs[0][1])
+
+
+def test_confident_other_type_counts_toward_close():
+    f = TrackFuser(open_windows=2, close_windows=2)
+    f.update(D(0, event=0))
+    f.update(D(1, event=0))
+    assert f.open
+    f.update(D(2, event=1))           # evidence the striking event ended
+    sigs = f.update(D(3, event=1))
+    assert [s[0] for s in sigs] == ["close"]
+
+
+def test_low_probability_is_negative():
+    f = TrackFuser(open_windows=1, min_event_prob=0.9)
+    assert f.update(D(0, prob=0.89)) == []
+    assert not f.open
+
+
+# -- TrackBook: identity, geometry, cross-tile merge --------------------------
+
+def _book(**kw):
+    # Two overlapping tiles of a 112-channel fiber: origins 0 and 48,
+    # window height 64, 16 distance bins of 4 channels.
+    kw.setdefault("open_windows", 2)
+    kw.setdefault("close_windows", 2)
+    return TrackBook("f0", (0, 48), 64, n_distance_bins=16, **kw)
+
+
+def test_fiber_pos_geometry():
+    b = _book()
+    assert b.fiber_pos(0, 0) == 2.0       # bin centers span the window
+    assert b.fiber_pos(0, 15) == 62.0
+    assert b.fiber_pos(1, 0) == 50.0      # offset by the tile origin
+
+
+def test_open_update_close_records_and_schema():
+    b = _book()
+    assert b.update(0, D(0, distance=5), now=1.0) == []
+    recs = b.update(0, D(1, distance=5), now=2.0)
+    assert [r["kind"] for r in recs] == ["open"]
+    opened = recs[0]
+    for key in ("track_id", "fiber", "event", "event_name", "tiles",
+                "onset_sample", "end_sample", "duration_samples",
+                "n_windows", "distance_bin", "fiber_pos", "confidence",
+                "t"):
+        assert key in opened
+    assert opened["fiber"] == "f0"
+    assert opened["event_name"] == "striking"
+    assert opened["onset_sample"] == 0     # first pending window's origin
+    assert opened["fiber_pos"] == 22.0     # bin 5 of tile 0
+    recs = b.update(0, D(2, distance=5), now=3.0)
+    assert [r["kind"] for r in recs] == ["update"]
+    b.update(0, NEG(3), now=4.0)
+    recs = b.update(0, NEG(4), now=5.0)
+    assert [r["kind"] for r in recs] == ["close"]
+    assert recs[0]["end_sample"] == 2 * STRIDE + W
+    assert b.opens == b.closes == 1
+    assert b.open_track_count == 0
+    assert len(b.closed_tracks) == 1
+
+
+def test_cross_tile_merge_is_one_track():
+    b = _book()
+    # The same physical event at fiber channel ~50: tile 0 sees it in
+    # bin 12 (pos 50), tile 1 in bin 0 (pos 50).
+    b.update(0, D(0, distance=12), now=1.0)
+    opened = b.update(0, D(1, distance=12), now=2.0)
+    assert opened[0]["kind"] == "open"
+    tid = opened[0]["track_id"]
+    b.update(1, D(1, distance=0), now=2.1)
+    recs = b.update(1, D(2, distance=0), now=3.0)
+    # The tile-1 opening merges into the open track: an update, NOT a
+    # second open.
+    assert [r["kind"] for r in recs] == ["update"]
+    assert recs[0]["track_id"] == tid
+    assert recs[0]["tiles"] == [0, 1]
+    assert b.opens == 1
+    assert b.open_track_count == 1
+    assert b.open_tile_count == 2
+    # Tile 0 closes first: the track survives on tile 1, no close record.
+    b.update(0, NEG(3), now=4.0)
+    assert all(r["kind"] != "close"
+               for r in b.update(0, NEG(4), now=5.0))
+    assert b.open_track_count == 1
+    # Only when the LAST member tile closes does the track close, once.
+    b.update(1, NEG(5), now=6.0)
+    recs = b.update(1, NEG(6), now=7.0)
+    assert [r["kind"] for r in recs] == ["close"]
+    assert b.closes == 1
+    assert len(b.closed_tracks) == 1
+
+
+def test_distant_same_type_event_is_a_second_track():
+    b = _book(merge_bins=2.0)
+    b.update(0, D(0, distance=2), now=1.0)       # pos 10 in tile 0
+    b.update(0, D(1, distance=2), now=2.0)
+    b.update(1, D(1, distance=10), now=2.1)      # pos 90 in tile 1
+    recs = b.update(1, D(2, distance=10), now=3.0)
+    assert [r["kind"] for r in recs] == ["open"]  # beyond merge tolerance
+    assert b.opens == 2
+    assert b.open_track_count == 2
+
+
+def test_different_type_adjacent_never_merges():
+    b = _book()
+    b.update(0, D(0, event=0, distance=12), now=1.0)
+    b.update(0, D(1, event=0, distance=12), now=2.0)
+    b.update(1, D(1, event=1, distance=0), now=2.1)
+    recs = b.update(1, D(2, event=1, distance=0), now=3.0)
+    assert [r["kind"] for r in recs] == ["open"]
+    assert b.opens == 2
+
+
+def test_shared_id_counter_spans_books():
+    ids = itertools.count(7)
+    b1 = TrackBook("f0", (0,), 64, open_windows=1, ids=ids)
+    b2 = TrackBook("f1", (0,), 64, open_windows=1, ids=ids)
+    r1 = b1.update(0, D(0), now=1.0)
+    r2 = b2.update(0, D(0), now=1.0)
+    assert r1[0]["track_id"] == 7
+    assert r2[0]["track_id"] == 8
