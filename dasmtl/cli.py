@@ -110,9 +110,12 @@ def sanitize_main(argv=None) -> int:
 def obs_main(argv=None) -> int:
     """``dasmtl-obs`` — the unified telemetry layer's CLI
     (dasmtl/obs/; docs/OBSERVABILITY.md): ``dump`` span records or
-    /metrics text from a live server, ``capture``/``analyze`` jax
-    profiler traces (the old scripts/capture_trace.py and
-    scripts/analyze_trace.py, importable)."""
+    /metrics text from a live server, ``join`` router + replica /trace
+    dumps into end-to-end chains per trace ID, ``check`` two saved
+    expositions for counter regressions, ``selftest`` the alert
+    engine + sinks, ``capture``/``analyze`` jax profiler traces (the
+    old scripts/capture_trace.py and scripts/analyze_trace.py,
+    importable)."""
     argv = list(sys.argv[1:] if argv is None else argv)
     from dasmtl.obs.__main__ import main
 
@@ -152,7 +155,8 @@ _SUBCOMMANDS = {
     "audit": (audit_main, "compile-time HLO/cost auditor (dasmtl-audit)"),
     "sanitize": (sanitize_main,
                  "runtime SPMD sanitizer suite (dasmtl-sanitize)"),
-    "obs": (obs_main, "telemetry: trace dump / profiler capture+analyze "
+    "obs": (obs_main, "telemetry: trace dump/join, exposition check, "
+                      "alert selftest, profiler capture+analyze "
                       "(dasmtl-obs)"),
 }
 

@@ -275,6 +275,21 @@ class Config:
     obs_profile_dir: str = "artifacts/obs_profiles"
     obs_profile_cooldown_s: float = 300.0  # min seconds between captures
     obs_profile_duration_s: float = 2.0  # seconds each capture records
+    # Metrics history (dasmtl/obs/history.py): snapshots kept in the
+    # bounded ring behind GET /query on the serve/router/stream front
+    # ends (0 disables /query), and the sampling cadence.
+    obs_history: int = 256
+    obs_history_interval_s: float = 5.0
+    # Alert engine (dasmtl/obs/alerts.py): whether training arms the
+    # default heartbeat anomaly rules (MFU >30% below the run median,
+    # samples/s stall) when the heartbeat is on; evaluation cadence for
+    # front ends that tick the engine in-loop; and the optional webhook
+    # sink ("" = JSONL/stderr sinks only) with its bounded retry policy.
+    obs_alerts: bool = True
+    obs_alerts_interval_s: float = 1.0
+    obs_alerts_webhook: str = ""
+    obs_alerts_webhook_retries: int = 3
+    obs_alerts_webhook_backoff_s: float = 0.25
 
     # ---- misc ----
     seed: int = 1
@@ -420,6 +435,17 @@ class Config:
             raise ValueError("obs_profile_cooldown_s must be >= 0")
         if self.obs_profile_duration_s <= 0:
             raise ValueError("obs_profile_duration_s must be > 0")
+        if self.obs_history < 0:
+            raise ValueError("obs_history must be >= 0 (0 disables "
+                             "/query)")
+        if self.obs_history_interval_s <= 0:
+            raise ValueError("obs_history_interval_s must be > 0")
+        if self.obs_alerts_interval_s <= 0:
+            raise ValueError("obs_alerts_interval_s must be > 0")
+        if self.obs_alerts_webhook_retries < 0:
+            raise ValueError("obs_alerts_webhook_retries must be >= 0")
+        if self.obs_alerts_webhook_backoff_s < 0:
+            raise ValueError("obs_alerts_webhook_backoff_s must be >= 0")
 
     @property
     def decay_at_epoch0(self) -> bool:
@@ -841,6 +867,32 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--obs_profile_duration_s", type=float,
                    default=d.obs_profile_duration_s,
                    help="seconds each profiler capture records")
+    p.add_argument("--obs_history", type=int, default=d.obs_history,
+                   help="metrics-history snapshots kept behind "
+                        "GET /query (0 disables /query)")
+    p.add_argument("--obs_history_interval_s", type=float,
+                   default=d.obs_history_interval_s,
+                   help="metrics-history sampling cadence in seconds")
+    p.add_argument("--obs_alerts", action=argparse.BooleanOptionalAction,
+                   default=d.obs_alerts,
+                   help="arm the default train heartbeat anomaly rules "
+                        "(MFU drop vs run median, samples/s stall) "
+                        "through the alert engine when the heartbeat "
+                        "is on")
+    p.add_argument("--obs_alerts_interval_s", type=float,
+                   default=d.obs_alerts_interval_s,
+                   help="alert engine evaluation cadence in seconds")
+    p.add_argument("--obs_alerts_webhook", type=str,
+                   default=d.obs_alerts_webhook,
+                   help="webhook URL alert events POST to ('' = JSONL/"
+                        "stderr sinks only)")
+    p.add_argument("--obs_alerts_webhook_retries", type=int,
+                   default=d.obs_alerts_webhook_retries,
+                   help="bounded webhook delivery retries per event")
+    p.add_argument("--obs_alerts_webhook_backoff_s", type=float,
+                   default=d.obs_alerts_webhook_backoff_s,
+                   help="initial webhook retry backoff (doubles per "
+                        "attempt)")
 
 
 def _resolve_compat(ns: argparse.Namespace) -> dict:

@@ -21,14 +21,34 @@ instead of one-shot bench numbers:
   p99 breach), rate-limited so an incident produces one trace, not a
   disk full of them; plus the capture/analyze CLIs the old
   ``scripts/capture_trace.py`` / ``scripts/analyze_trace.py`` now shim.
+- :mod:`dasmtl.obs.alerts` — the fleet alert engine: declarative
+  threshold / rate / multi-window burn-rate rules over any registry or
+  scraped exposition, deduped firing/resolved state machines per
+  labelset, JSONL / stderr / webhook sinks, the stream tier's direct
+  track-event feed, and the shipped train-heartbeat anomaly rules.
+- :mod:`dasmtl.obs.history` — a bounded time-series ring over scrape
+  snapshots, served as ``GET /query?family=&since=`` on the serve,
+  router, and stream front ends; the alert engine's rate rules read it.
 
-Catalog of every exported metric family, the span model and the heartbeat
-schema: docs/OBSERVABILITY.md.
+Cross-tier tracing: the router mints a trace ID, forwards it as the
+``X-Dasmtl-Trace`` header (retries included), replicas adopt and echo
+it, and ``dasmtl obs join`` stitches the ``/trace`` dumps into one
+end-to-end chain per request.
+
+Catalog of every exported metric family, the span model, the rule
+schema and the heartbeat schema: docs/OBSERVABILITY.md.
 """
 
+from dasmtl.obs.alerts import (AlertEngine, AlertRule, HeartbeatWatch,
+                               JsonlSink, StderrSink, WebhookSink,
+                               default_heartbeat_rules)
+from dasmtl.obs.history import (HistorySampler, MetricsHistory,
+                                handle_query)
 from dasmtl.obs.registry import (MetricsRegistry, default_registry,
                                  parse_exposition, render_prometheus)
-from dasmtl.obs.trace import SPAN_STAGES, TraceRing, mint_trace_id
+from dasmtl.obs.trace import (ALL_SPAN_STAGES, ROUTER_SPAN_STAGES,
+                              SPAN_STAGES, TraceRing, join_chains,
+                              mint_trace_id)
 
 __all__ = [
     "MetricsRegistry",
@@ -37,5 +57,18 @@ __all__ = [
     "render_prometheus",
     "TraceRing",
     "SPAN_STAGES",
+    "ROUTER_SPAN_STAGES",
+    "ALL_SPAN_STAGES",
+    "join_chains",
     "mint_trace_id",
+    "AlertEngine",
+    "AlertRule",
+    "HeartbeatWatch",
+    "JsonlSink",
+    "StderrSink",
+    "WebhookSink",
+    "default_heartbeat_rules",
+    "MetricsHistory",
+    "HistorySampler",
+    "handle_query",
 ]

@@ -10,6 +10,13 @@ Subcommands:
 - ``analyze`` — summarize a captured trace (the old
   ``scripts/analyze_trace.py``, same flags; exit 2 with a message when
   this jax build ships no xplane reader).
+- ``join``    — stitch router + replica ``/trace`` JSONL dumps (files or
+  live URLs) into one end-to-end span chain per trace ID
+  (router_recv -> place -> submit -> ... -> resolve).
+- ``check``   — ``monotone_regressions`` between two saved expositions;
+  exit nonzero on any regression (CI scrape diffing).
+- ``selftest``— the CI-gated alert-engine selftest (seeded SLO breach +
+  planted track event -> exactly the expected alert set).
 
 docs/OBSERVABILITY.md documents the span model and metric catalog.
 """
@@ -17,6 +24,7 @@ docs/OBSERVABILITY.md documents the span model and metric catalog.
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 
@@ -51,6 +59,113 @@ def _dump_main(argv=None) -> int:
     return 0
 
 
+def _read_spans(src: str, timeout: float) -> list:
+    """Span dicts from a JSONL file, ``-`` (stdin), or a live base URL
+    (its ``/trace`` endpoint)."""
+    if src.startswith("http://") or src.startswith("https://"):
+        import urllib.request
+
+        url = src.rstrip("/")
+        if not url.endswith("/trace"):
+            url += "/trace"
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            text = resp.read().decode("utf-8")
+    elif src == "-":
+        text = sys.stdin.read()
+    else:
+        with open(src, encoding="utf-8") as fh:
+            text = fh.read()
+    return [json.loads(line) for line in text.splitlines() if line.strip()]
+
+
+def _join_main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dasmtl obs join",
+        description="stitch router + replica /trace dumps into one "
+                    "end-to-end span chain per trace ID")
+    ap.add_argument("sources", nargs="+",
+                    help="span JSONL files, '-' for stdin, or live base "
+                         "URLs (their /trace is fetched)")
+    ap.add_argument("--trace", type=str, default=None,
+                    help="only this trace ID")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object per trace instead of the "
+                         "human chain view")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+
+    from dasmtl.obs.trace import join_chains
+
+    spans = []
+    for src in args.sources:
+        try:
+            spans.extend(_read_spans(src, args.timeout))
+        except (OSError, ValueError) as exc:
+            print(f"dasmtl obs join: cannot read {src}: {exc}",
+                  file=sys.stderr)
+            return 1
+    chains = join_chains(spans)
+    if args.trace is not None:
+        if args.trace not in chains:
+            print(f"dasmtl obs join: trace {args.trace!r} not found "
+                  f"({len(chains)} traces in dump)", file=sys.stderr)
+            return 1
+        chains = {args.trace: chains[args.trace]}
+    for trace_id in sorted(chains):
+        chain = chains[trace_id]
+        if args.json:
+            print(json.dumps({"trace_id": trace_id, "spans": chain}))
+            continue
+        outcome = next((s["outcome"] for s in reversed(chain)
+                        if s.get("outcome")), None)
+        print(f"trace {trace_id}: {len(chain)} spans, "
+              f"outcome={outcome or '?'}")
+        for s in chain:
+            where = s.get("device") or ""
+            extras = " ".join(x for x in (
+                f"bucket={s['bucket']}" if s.get("bucket") is not None
+                else "",
+                f"outcome={s['outcome']}" if s.get("outcome") else "",
+                where and f"at={where}") if x)
+            print(f"  {s['stage']:<14} start={s['start_s']:>12.6f}s "
+                  f"dur={s['duration_s'] * 1e3:9.3f}ms  {extras}")
+    return 0
+
+
+def _check_main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dasmtl obs check",
+        description="diff two saved Prometheus expositions; exit 1 when "
+                    "any counter/histogram sample regressed (CI scrape "
+                    "diffing)")
+    ap.add_argument("before", help="earlier exposition text file")
+    ap.add_argument("after", help="later exposition text file")
+    args = ap.parse_args(argv)
+
+    from dasmtl.obs.registry import monotone_regressions, parse_exposition
+
+    parsed = []
+    for path in (args.before, args.after):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                parsed.append(parse_exposition(fh.read()))
+        except (OSError, ValueError) as exc:
+            print(f"dasmtl obs check: cannot parse {path}: {exc}",
+                  file=sys.stderr)
+            return 2
+    regressions = monotone_regressions(parsed[0], parsed[1])
+    if regressions:
+        print(f"dasmtl obs check: {len(regressions)} monotonicity "
+              f"regression(s) {args.before} -> {args.after}:")
+        for line in regressions:
+            print(f"  {line}")
+        return 1
+    n = sum(len(f["samples"]) for f in parsed[0].values())
+    print(f"dasmtl obs check: OK — {n} samples, no counter went "
+          f"backwards")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     commands = {
@@ -59,6 +174,11 @@ def main(argv=None) -> int:
         "capture": (None, "capture a jax.profiler trace of the train "
                           "step"),
         "analyze": (None, "summarize a captured trace"),
+        "join": (_join_main, "stitch router + replica /trace dumps into "
+                             "end-to-end chains"),
+        "check": (_check_main, "diff two saved expositions; exit 1 on "
+                               "counter regressions"),
+        "selftest": (None, "alert-engine selftest (CI-gated)"),
     }
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: dasmtl obs <command> [args...]\n\ncommands:")
@@ -68,6 +188,14 @@ def main(argv=None) -> int:
     cmd = argv.pop(0)
     if cmd == "dump":
         return _dump_main(argv)
+    if cmd == "join":
+        return _join_main(argv)
+    if cmd == "check":
+        return _check_main(argv)
+    if cmd == "selftest":
+        from dasmtl.obs.alerts import run_alert_selftest
+
+        return run_alert_selftest()
     if cmd == "capture":
         from dasmtl.obs.profiler import capture_main
 

@@ -34,6 +34,24 @@ from typing import Iterable, List, Optional
 #: The canonical span chain of one served request, in pipeline order.
 SPAN_STAGES = ("submit", "queue", "form", "dispatch", "collect", "resolve")
 
+#: Router-tier stages, recorded by ``dasmtl/serve/router.py`` under the
+#: SAME trace ID the replica sees (the ``X-Dasmtl-Trace`` header):
+#: ``router_recv`` = request accepted at the router, ``place`` = replica
+#: chosen (``device`` carries the replica name), ``forward`` = one
+#: transport hop (one per attempt), ``retry`` = the decision to try
+#: another replica (``outcome`` carries the reason), ``router_resolve``
+#: = the answer returned to the client.
+ROUTER_SPAN_STAGES = ("router_recv", "place", "forward", "retry",
+                      "router_resolve")
+
+#: End-to-end stage order for joined chains: router tier first, then the
+#: replica pipeline.  Cross-process ``start_s`` values come from
+#: different monotonic clocks, so chains order stage-major (clock-free)
+#: and only break ties within one process by ``start_s``.
+ALL_SPAN_STAGES = (ROUTER_SPAN_STAGES[:4] + SPAN_STAGES
+                   + ROUTER_SPAN_STAGES[4:])
+_STAGE_ORDER = {s: i for i, s in enumerate(ALL_SPAN_STAGES)}
+
 #: Per-process prefix so IDs from different replicas never collide when
 #: trace dumps are merged (pid is enough — IDs only need uniqueness, not
 #: secrecy).
@@ -50,9 +68,9 @@ def make_span(trace_id: str, request_id: int, stage: str, start_s: float,
               duration_s: float, bucket: Optional[int] = None,
               device: Optional[str] = None,
               outcome: Optional[str] = None) -> dict:
-    if stage not in SPAN_STAGES:
+    if stage not in _STAGE_ORDER:
         raise ValueError(f"unknown span stage {stage!r} "
-                         f"(expected one of {SPAN_STAGES})")
+                         f"(expected one of {ALL_SPAN_STAGES})")
     return {"trace_id": trace_id, "request_id": int(request_id),
             "stage": stage, "start_s": round(float(start_s), 6),
             "duration_s": round(float(duration_s), 6),
@@ -101,10 +119,21 @@ class TraceRing:
     def chains(self) -> dict:
         """``{trace_id: [spans sorted by pipeline stage order]}`` — the
         view the propagation tests assert on."""
-        order = {s: i for i, s in enumerate(SPAN_STAGES)}
-        out: dict = {}
-        for span in self.snapshot():
-            out.setdefault(span["trace_id"], []).append(span)
-        for spans in out.values():
-            spans.sort(key=lambda s: (order[s["stage"]], s["start_s"]))
-        return out
+        return join_chains(self.snapshot())
+
+
+def join_chains(spans: Iterable[dict]) -> dict:
+    """Stitch spans — possibly from SEVERAL rings/processes (router +
+    replica ``/trace`` dumps) — into ``{trace_id: [spans in end-to-end
+    order]}``.  Ordering is stage-major over :data:`ALL_SPAN_STAGES`
+    (monotonic clocks don't align across processes), ``start_s``-minor
+    within a stage; spans with a stage this build doesn't know sort
+    last rather than raising, so newer dumps stay joinable."""
+    last = len(ALL_SPAN_STAGES)
+    out: dict = {}
+    for span in spans:
+        out.setdefault(span["trace_id"], []).append(span)
+    for chain in out.values():
+        chain.sort(key=lambda s: (_STAGE_ORDER.get(s["stage"], last),
+                                  s["start_s"]))
+    return out

@@ -127,6 +127,12 @@ def main(argv=None) -> int:
     obs.add_argument("--profile_duration_s", type=float,
                      default=d.obs_profile_duration_s,
                      help="seconds each capture records")
+    obs.add_argument("--history", type=int, default=d.obs_history,
+                     help="metrics-history snapshots kept behind "
+                          "GET /query (0 disables)")
+    obs.add_argument("--history_interval_s", type=float,
+                     default=d.obs_history_interval_s,
+                     help="seconds between history snapshots")
     p.add_argument("--parity-check", action="store_true",
                    dest="parity_check",
                    help="run the precision parity gate instead of "
@@ -289,11 +295,20 @@ def main(argv=None) -> int:
                      latency_buckets_s=latency_buckets_s,
                      slo_p99_ms=args.slo_p99_ms,
                      profiler=profiler)
+    history = sampler = None
+    if args.history > 0:
+        from dasmtl.obs.history import HistorySampler, MetricsHistory
+
+        history = MetricsHistory(args.history)
+        sampler = HistorySampler(history, loop.metrics_text,
+                                 interval_s=args.history_interval_s)
+        sampler.start()
     # Bind the front end BEFORE warmup: /healthz (liveness) answers while
     # buckets compile, /readyz stays 503 until warm — a router probing
     # readiness never routes traffic at a replica mid-compilation.
     httpd = make_http_server(loop, args.host, args.port,
-                             swap_builder=build_executor)
+                             swap_builder=build_executor,
+                             history=history)
     host, port = httpd.server_address[:2]
     if args.port_file:
         with open(args.port_file, "w", encoding="utf-8") as f:
@@ -312,7 +327,9 @@ def main(argv=None) -> int:
     loop.start()
     print(f"serving {executor.source} on http://{host}:{port} "
           f"(POST /infer, GET /healthz, GET /readyz, GET /stats, "
-          f"GET /metrics, GET /trace, POST /swap, POST /profile); warmup "
+          f"GET /metrics, GET /trace"
+          + (", GET /query" if history is not None else "")
+          + f", POST /swap, POST /profile); warmup "
           f"{loop.stats()['warmup_s']:.2f}s; in-flight window "
           f"{loop.inflight_window}; SIGTERM drains; SIGUSR2 profiles",
           file=sys.stderr)
@@ -323,6 +340,8 @@ def main(argv=None) -> int:
     install_signal_handlers(loop, on_drain=lambda _s: stop.set())
     stop.wait()
     drained = loop.drain(timeout=60.0)
+    if sampler is not None:
+        sampler.stop()
     httpd.shutdown()
     t.join(timeout=10.0)
     loop.close()

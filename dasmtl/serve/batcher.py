@@ -124,14 +124,21 @@ class MicroBatcher:
     # -- admission -----------------------------------------------------------
     def submit(self, x: np.ndarray, now: Optional[float] = None,
                max_wait_s: Optional[float] = None,
-               want_log_probs: bool = False) -> "Request":
+               want_log_probs: bool = False,
+               trace_id: Optional[str] = None) -> "Request":
         """Admit one window; the returned request's ``future`` resolves to
         a :class:`ServeResult`.  Refusals (shed / draining) resolve the
-        future before returning — the caller never distinguishes."""
+        future before returning — the caller never distinguishes.
+
+        ``trace_id``: an inbound cross-tier ID (the router's
+        ``X-Dasmtl-Trace`` header) is ADOPTED instead of minting, so one
+        ID names the request on every tier; refusal spans carry it too,
+        which is how a shed-then-retried hop stays attributable."""
         now = self.clock() if now is None else now
         wait = self.max_wait_s if max_wait_s is None else float(max_wait_s)
         self.metrics.observe_submit()
-        trace_id = mint_trace_id() if self.tracer is not None else ""
+        if not trace_id:
+            trace_id = mint_trace_id() if self.tracer is not None else ""
         with self._lock:
             req = Request(id=self._next_id, x=x, enqueue_t=now,
                           deadline_t=now + wait, trace_id=trace_id,

@@ -188,16 +188,20 @@ class HttpTransport:
 
     def request(self, address: str, method: str, path: str,
                 body: Optional[bytes] = None,
-                timeout_s: Optional[float] = None) -> tuple:
+                timeout_s: Optional[float] = None,
+                headers: Optional[dict] = None) -> tuple:
         """``(status, raw bytes)`` or :class:`TransportError`.  A 4xx/5xx
         with a body is an ANSWER (the replica contract speaks through
-        status+JSON), not a transport failure."""
+        status+JSON), not a transport failure.  ``headers`` ride on top
+        of the Content-Type default (the router's ``X-Dasmtl-Trace``)."""
         timeout_s = self.timeout_s if timeout_s is None else timeout_s
         conn = self._conn(address, timeout_s)
+        send_headers = ({"Content-Type": "application/json"}
+                        if body is not None else {})
+        if headers:
+            send_headers.update(headers)
         try:
-            conn.request(method, path, body=body,
-                         headers={"Content-Type": "application/json"}
-                         if body is not None else {})
+            conn.request(method, path, body=body, headers=send_headers)
             resp = conn.getresponse()
             return resp.status, resp.read()
         except Exception as exc:  # noqa: BLE001 — normalize every failure
@@ -219,12 +223,16 @@ class HttpTransport:
 
     # -- the calls the router makes ------------------------------------------
     def infer(self, address: str, body: bytes,
-              timeout_s: Optional[float] = None) -> tuple:
+              timeout_s: Optional[float] = None,
+              headers: Optional[dict] = None) -> tuple:
         """``(status, raw response bytes)``.  Raw on purpose: the router's
         hot path forwards a success verbatim (status code 200 already
         says "ok") — parsing + re-serializing every answer on a host the
-        replicas share would tax the very compute being routed to."""
-        return self.request(address, "POST", "/infer", body, timeout_s)
+        replicas share would tax the very compute being routed to.
+        ``headers`` carries the trace header on every hop, retries
+        included — header-only, so the zero-parse path stays zero-parse."""
+        return self.request(address, "POST", "/infer", body, timeout_s,
+                            headers)
 
     def infer_json(self, address: str, body: bytes,
                    timeout_s: Optional[float] = None) -> tuple:

@@ -41,15 +41,17 @@ blue/green rollout".
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence
-from urllib.parse import urlsplit
+from urllib.parse import parse_qs, urlsplit
 
 from dasmtl.obs.registry import (MetricsRegistry, escape_label_value,
                                  parse_exposition, render_prometheus)
+from dasmtl.obs.trace import TraceRing, make_span, mint_trace_id
 from dasmtl.serve.replica import HttpTransport, ReplicaHandle, TransportError
 
 #: Outcomes the router's own requests_total counter distinguishes (the
@@ -145,12 +147,21 @@ class Router:
                  transport=None, retry_budget: int = 1,
                  request_timeout_s: float = 30.0,
                  probe_tick_s: float = 0.05,
-                 clock=time.monotonic):
+                 clock=time.monotonic, trace_ring: int = 4096,
+                 history=None):
         self.core = RouterCore(replicas, retry_budget=retry_budget)
         self.transport = transport or HttpTransport(request_timeout_s)
         self.request_timeout_s = float(request_timeout_s)
         self.probe_tick_s = float(probe_tick_s)
         self.clock = clock
+        # Cross-tier tracing: router-stage spans under the SAME trace ID
+        # the replica adopts from the X-Dasmtl-Trace header, dumped via
+        # GET /trace and stitched by `dasmtl obs join`.  trace_ring=0
+        # disables span RECORDING; the ID still mints and forwards.
+        self.tracer = TraceRing(trace_ring) if trace_ring else None
+        #: Optional MetricsHistory behind GET /query (set by main()/tests).
+        self.history = history
+        self._req_ids = itertools.count()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
@@ -244,7 +255,8 @@ class Router:
             return {"ok": False, "error": "error",
                     "detail": "replica answered non-JSON"}
 
-    def handle_infer(self, body: bytes) -> tuple:
+    def handle_infer(self, body: bytes,
+                     trace_id: Optional[str] = None) -> tuple:
         """Forward one ``POST /infer`` body; returns ``(status, reply)``
         where ``reply`` is raw bytes (the zero-parse passthrough of a
         clean success — on a shared-core host every router cycle is
@@ -252,34 +264,72 @@ class Router:
         (refusal, retry, no replica).  Placement + the bounded retry
         policy of the module docstring; every terminal outcome is
         structured (the router never converts a replica answer into a
-        hang or a bare 500)."""
+        hang or a bare 500).
+
+        ``body`` is the buffered request bytes, forwarded VERBATIM on
+        every hop — a retried request is byte-identical to the first
+        attempt.  ``trace_id`` (the inbound ``X-Dasmtl-Trace``, or
+        minted here) rides as a header on every hop too — headers only,
+        so the zero-parse 200 path stays zero-parse — and names the
+        router-stage spans recorded into :attr:`tracer`."""
+        trace_id = trace_id or mint_trace_id()
+        rid = next(self._req_ids)
+        t0 = self.clock()
+        spans: List[dict] = []
+        tracing = self.tracer is not None
+        if tracing:
+            spans.append(make_span(trace_id, rid, "router_recv", t0, 0.0))
+        hop_headers = {"X-Dasmtl-Trace": trace_id}
+
+        def finish(status, reply, outcome):
+            self._m_requests.inc(1, (outcome,))
+            if tracing:
+                spans.append(make_span(trace_id, rid, "router_resolve",
+                                       t0, self.clock() - t0,
+                                       outcome=outcome))
+                self.tracer.add(spans)
+            return status, reply
+
         tried: list = []
         retries = 0
         last = None
         while True:
+            t_pick = self.clock()
             with self._lock:
                 replica = self.core.pick(exclude=tried)
                 if replica is not None:
                     replica.on_send()
+            if tracing and replica is not None:
+                spans.append(make_span(trace_id, rid, "place", t_pick,
+                                       self.clock() - t_pick,
+                                       device=replica.name))
             if replica is None:
                 if last is not None:
                     status, payload, outcome = last
                     payload = dict(self._payload_of(payload))
                     payload["router"] = {"retries": retries,
-                                         "exhausted": True}
-                    self._m_requests.inc(1, (outcome,))
-                    return status, payload
-                self._m_requests.inc(1, ("no_replica",))
-                return 503, {"ok": False, "error": "no_replica",
-                             "detail": "no replica in rotation — replicas "
-                                       "warming, draining, or down "
-                                       "(GET /stats lists them)",
-                             "router": {"retries": retries}}
+                                         "exhausted": True,
+                                         "trace_id": trace_id}
+                    return finish(status, payload, outcome)
+                return finish(503, {
+                    "ok": False, "error": "no_replica",
+                    "detail": "no replica in rotation — replicas "
+                              "warming, draining, or down "
+                              "(GET /stats lists them)",
+                    "router": {"retries": retries,
+                               "trace_id": trace_id}}, "no_replica")
+            t_fwd = self.clock()
             try:
                 status, raw = self.transport.infer(
-                    replica.address, body, self.request_timeout_s)
+                    replica.address, body, self.request_timeout_s,
+                    headers=hop_headers)
             except TransportError as exc:
                 now = self.clock()
+                if tracing:
+                    spans.append(make_span(trace_id, rid, "forward",
+                                           t_fwd, now - t_fwd,
+                                           device=replica.name,
+                                           outcome="unreachable"))
                 with self._lock:
                     replica.on_done()
                     replica.evict(now, str(exc))
@@ -291,21 +341,29 @@ class Router:
                 if retries < self.core.retry_budget:
                     retries += 1
                     self._m_retries.inc(1, ("unreachable",))
+                    if tracing:
+                        spans.append(make_span(trace_id, rid, "retry",
+                                               self.clock(), 0.0,
+                                               outcome="unreachable"))
                     continue
                 status, payload, outcome = last
                 payload = dict(payload)
                 payload["router"] = {"retries": retries,
-                                     "exhausted": True}
-                self._m_requests.inc(1, (outcome,))
-                return status, payload
+                                     "exhausted": True,
+                                     "trace_id": trace_id}
+                return finish(status, payload, outcome)
             with self._lock:
                 replica.on_done()
+            if tracing:
+                spans.append(make_span(trace_id, rid, "forward", t_fwd,
+                                       self.clock() - t_fwd,
+                                       device=replica.name,
+                                       outcome=f"http_{status}"))
             if status == 200 and retries == 0:
                 # The hot path: a clean success passes through verbatim
                 # (no JSON parse, no re-serialize — the status code
                 # already carries the outcome).
-                self._m_requests.inc(1, ("ok",))
-                return status, raw
+                return finish(status, raw, "ok")
             payload = self._payload_of(raw)
             error = payload.get("error")
             exhausted = False
@@ -322,18 +380,22 @@ class Router:
                 if retries < self.core.retry_budget:
                     retries += 1
                     self._m_retries.inc(1, (error,))
+                    if tracing:
+                        spans.append(make_span(trace_id, rid, "retry",
+                                               self.clock(), 0.0,
+                                               outcome=error))
                     continue
                 exhausted = True
             outcome = ("ok" if payload.get("ok")
                        else (error if error in ROUTER_OUTCOMES
                              else "error"))
-            self._m_requests.inc(1, (outcome,))
             payload = dict(payload)
             payload["router"] = {"replica": replica.name,
-                                 "retries": retries}
+                                 "retries": retries,
+                                 "trace_id": trace_id}
             if exhausted:
                 payload["router"]["exhausted"] = True
-            return status, payload
+            return finish(status, payload, outcome)
 
     # -- blue/green rollout --------------------------------------------------
     def rollout(self, version=None, policy: str = "drain",
@@ -500,17 +562,35 @@ def _make_router_handler(router: Router):
         def log_message(self, *args) -> None:  # quiet by default
             pass
 
-        def _reply(self, code: int, payload: dict) -> None:
+        def _reply(self, code: int, payload: dict,
+                   headers: Optional[dict] = None) -> None:
             body = json.dumps(payload).encode()
-            self._reply_raw(code, body, "application/json")
+            self._reply_raw(code, body, "application/json", headers)
 
         def _reply_raw(self, code: int, body: bytes,
-                       content_type: str) -> None:
+                       content_type: str,
+                       headers: Optional[dict] = None) -> None:
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
+
+        def _read_exact(self) -> bytes:
+            """Buffer the request body ONCE, exactly Content-Length
+            bytes (a socket stream may short-read) — the same bytes
+            object is then reused verbatim across every retry hop."""
+            n = int(self.headers.get("Content-Length", 0))
+            chunks = []
+            while n > 0:
+                chunk = self.rfile.read(n)
+                if not chunk:
+                    break
+                chunks.append(chunk)
+                n -= len(chunk)
+            return b"".join(chunks)
 
         def do_GET(self) -> None:  # noqa: N802 — http.server API shape
             url = urlsplit(self.path)
@@ -526,6 +606,22 @@ def _make_router_handler(router: Router):
             elif url.path == "/metrics":
                 self._reply_raw(200, router.metrics_text().encode(),
                                 "text/plain; version=0.0.4; charset=utf-8")
+            elif url.path == "/trace":
+                if router.tracer is None:
+                    self._reply(404, {"error": "tracing disabled "
+                                               "(trace_ring=0)"})
+                    return
+                n = parse_qs(url.query).get("n", [None])[0]
+                body = router.tracer.to_jsonl(int(n) if n else None)
+                self._reply_raw(200, body.encode(),
+                                "application/x-ndjson")
+            elif url.path == "/query":
+                from dasmtl.obs.history import handle_query
+
+                params = {k: v[0] for k, v in
+                          parse_qs(url.query).items()}
+                code, payload = handle_query(router.history, params)
+                self._reply(code, payload)
             else:
                 self._reply(404, {"error": f"unknown path {url.path}"})
 
@@ -547,13 +643,17 @@ def _make_router_handler(router: Router):
             if self.path != "/infer":
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
-            n = int(self.headers.get("Content-Length", 0))
-            body = self.rfile.read(n)
-            status, reply = router.handle_infer(body)
+            body = self._read_exact()
+            # Mint (or adopt an inbound) trace ID and echo it on the
+            # response — headers only, so the 200 path stays zero-parse.
+            trace_id = (self.headers.get("X-Dasmtl-Trace")
+                        or mint_trace_id())
+            echo = {"X-Dasmtl-Trace": trace_id}
+            status, reply = router.handle_infer(body, trace_id=trace_id)
             if isinstance(reply, (bytes, bytearray)):
-                self._reply_raw(status, reply, "application/json")
+                self._reply_raw(status, reply, "application/json", echo)
             else:
-                self._reply(status, reply)
+                self._reply(status, reply, echo)
 
     return Handler
 
@@ -608,6 +708,18 @@ def main(argv=None) -> int:
                         "swapping; 'hot' swaps in place (the in-process "
                         "flip is atomic either way)")
     p.add_argument("--request_timeout_s", type=float, default=30.0)
+    p.add_argument("--trace_ring", type=int, default=d.obs_trace_ring,
+                   help="router-stage span ring capacity behind "
+                        "GET /trace (0 disables span recording; the "
+                        "X-Dasmtl-Trace header mints/forwards either "
+                        "way)")
+    p.add_argument("--history", type=int, default=d.obs_history,
+                   help="metrics-history snapshots kept behind "
+                        "GET /query (0 disables /query)")
+    p.add_argument("--history_interval_s", type=float,
+                   default=d.obs_history_interval_s,
+                   help="history sampling cadence over the aggregated "
+                        "tier scrape")
     spawn = p.add_argument_group("spawned-replica model source "
                                  "(with --spawn)")
     spawn.add_argument("--fresh_init", action="store_true")
@@ -691,13 +803,23 @@ def main(argv=None) -> int:
             for i, a in enumerate(addrs)]
 
     router = Router(handles, retry_budget=args.retry_budget,
-                    request_timeout_s=args.request_timeout_s).start()
+                    request_timeout_s=args.request_timeout_s,
+                    trace_ring=args.trace_ring).start()
+    sampler = None
+    if args.history > 0:
+        from dasmtl.obs.history import HistorySampler, MetricsHistory
+
+        router.history = MetricsHistory(args.history)
+        sampler = HistorySampler(router.history, router.metrics_text,
+                                 interval_s=args.history_interval_s
+                                 ).start()
     httpd = make_router_http_server(router, args.host, args.port)
     host, port = httpd.server_address[:2]
     print(f"routing {len(handles)} replica(s) on http://{host}:{port} "
           f"(POST /infer, GET /healthz, GET /readyz, GET /stats, "
-          f"GET /metrics, POST /rollout); retry budget "
-          f"{args.retry_budget}; SIGTERM stops", file=sys.stderr)
+          f"GET /metrics, GET /trace, GET /query, POST /rollout); "
+          f"retry budget {args.retry_budget}; SIGTERM stops",
+          file=sys.stderr)
 
     import signal as _signal
 
@@ -713,6 +835,8 @@ def main(argv=None) -> int:
     stop.wait()
     httpd.shutdown()
     t.join(timeout=10.0)
+    if sampler is not None:
+        sampler.stop()
     router.close()
     for pr in procs:
         pr.close()

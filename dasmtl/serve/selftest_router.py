@@ -43,6 +43,7 @@ from typing import Optional
 
 import numpy as np
 
+from dasmtl.obs.trace import join_chains, mint_trace_id
 from dasmtl.serve.replica import (HttpTransport, ReplicaHandle,
                                   ReplicaProcess, TransportError)
 from dasmtl.serve.router import Router, make_router_http_server
@@ -72,6 +73,123 @@ def _drain(sem: threading.Semaphore, k: int, what: str,
             raise TimeoutError(f"load stalled while waiting for {what}")
 
 
+def _fetch_spans(transport: HttpTransport, address: str) -> list:
+    """Parse one tier's ``GET /trace`` JSONL dump into span dicts."""
+    status, raw = transport.request(address, "GET", "/trace?n=4096",
+                                    timeout_s=10.0)
+    if status != 200:
+        raise TransportError(f"GET {address}/trace: HTTP {status}")
+    return [json.loads(line) for line in raw.decode().splitlines() if line]
+
+
+def _check_trace_propagation(transport: HttpTransport, router_addr: str,
+                             replica_addrs: list, bodies: list,
+                             say) -> dict:
+    """The ISSUE 12 acceptance leg: ONE trace ID must span router ->
+    replica in the joined ``/trace`` dumps, for (a) a sampled request
+    whose ID the CLIENT minted (the ``X-Dasmtl-Trace`` header adopted on
+    every tier) and (b) a request that was genuinely shed and retried
+    (both hops under the same ID — the retry stays attributable)."""
+    failures: list = []
+
+    # (a) Burst until a request reports retries >= 1: concurrent
+    # one-shots overrun the small replica watermark, one replica sheds,
+    # the router retries the SAME bytes on the other.
+    retried_id: Optional[str] = None
+    rounds = 0
+    while retried_id is None and rounds < 25:
+        rounds += 1
+        results: list = []
+        res_lock = threading.Lock()
+
+        def one_shot(k: int) -> None:
+            try:
+                _s, payload = transport.infer_json(
+                    router_addr, bodies[k % len(bodies)], timeout_s=120.0)
+            except TransportError:
+                return
+            with res_lock:
+                results.append(payload)
+
+        burst = [threading.Thread(target=one_shot, args=(k,), daemon=True)
+                 for k in range(12)]
+        for t in burst:
+            t.start()
+        for t in burst:
+            t.join(timeout=120.0)
+        for payload in results:
+            router_info = payload.get("router", {})
+            if router_info.get("retries", 0) >= 1 \
+                    and router_info.get("trace_id"):
+                retried_id = router_info["trace_id"]
+                break
+    if retried_id is None:
+        failures.append(f"no shed-then-retried request after {rounds} "
+                        f"burst rounds — cannot prove retry-hop trace "
+                        f"propagation")
+    # (b) A sampled request with a client-minted trace ID on the header —
+    # sent LAST so the sustained background load cannot evict its spans
+    # from the bounded rings before the dumps below are fetched.
+    sampled_id = f"client-{mint_trace_id()}"
+    status = 0
+    for _ in range(10):   # background load may legitimately shed a try
+        status, _raw = transport.request(
+            router_addr, "POST", "/infer", bodies[0],
+            headers={"X-Dasmtl-Trace": sampled_id}, timeout_s=120.0)
+        if status == 200:
+            break
+        time.sleep(0.05)
+    if status != 200:
+        failures.append(f"sampled traced request -> HTTP {status}")
+    say(f"[router-selftest] trace leg: sampled={sampled_id} "
+        f"retried={retried_id} (after {rounds} burst round(s))")
+
+    # Join the router's dump with every replica's dump: ONE chain per ID.
+    spans = _fetch_spans(transport, router_addr)
+    for rep_addr in replica_addrs:
+        spans.extend(_fetch_spans(transport, rep_addr))
+    chains = join_chains(spans)
+
+    sampled = chains.get(sampled_id, [])
+    sampled_stages = [s["stage"] for s in sampled]
+    if not sampled:
+        failures.append(f"sampled trace {sampled_id} missing from the "
+                        f"joined dumps")
+    else:
+        if sampled_stages[0] != "router_recv" \
+                or sampled_stages[-1] != "router_resolve":
+            failures.append(f"sampled chain not router-bracketed: "
+                            f"{sampled_stages}")
+        if "submit" not in sampled_stages:
+            failures.append(f"sampled trace {sampled_id} never reached a "
+                            f"replica ring — header not adopted? "
+                            f"stages: {sampled_stages}")
+
+    retried_stages: list = []
+    if retried_id is not None:
+        retried = chains.get(retried_id, [])
+        retried_stages = [s["stage"] for s in retried]
+        if "retry" not in retried_stages:
+            failures.append(f"retried trace {retried_id} has no retry "
+                            f"span: {retried_stages}")
+        if retried_stages.count("forward") < 2:
+            failures.append(f"retried trace {retried_id} shows "
+                            f"{retried_stages.count('forward')} forward "
+                            f"hop(s), expected >= 2")
+        # The shed replica AND the retry target both recorded submit
+        # spans under the one ID — the cross-process join in action.
+        if retried_stages.count("submit") < 2:
+            failures.append(f"retried trace {retried_id} shows "
+                            f"{retried_stages.count('submit')} replica "
+                            f"submit span(s), expected >= 2 (shedder + "
+                            f"retry target): {retried_stages}")
+
+    return {"failures": failures, "sampled_trace_id": sampled_id,
+            "sampled_stages": sampled_stages, "retried_trace_id": retried_id,
+            "retried_stages": retried_stages, "burst_rounds": rounds,
+            "spans_joined": len(spans), "chains": len(chains)}
+
+
 def run_router_selftest(*, requests: int = 400, clients: int = 8,
                         retry_budget: int = 1,
                         verbose: bool = True) -> dict:
@@ -80,11 +198,16 @@ def run_router_selftest(*, requests: int = 400, clients: int = 8,
     the kill); the total served is whatever sustained load produced —
     the point is that events happen UNDER load, not a fixed count."""
     say = print if verbose else (lambda *_a, **_k: None)
+    # Small replica queues make backpressure REAL under this load: the
+    # trace-propagation leg below needs an actual shed-then-retried
+    # request, and sheds must be reproducible, not a CI coin flip.
     serve_args = ["--fresh_init", "--device", "cpu",
                   "--window", f"{_HW[0]}x{_HW[1]}",
-                  "--buckets", _BUCKETS, "--max_wait_ms", "2"]
+                  "--buckets", _BUCKETS, "--max_wait_ms", "2",
+                  "--queue_depth", "8", "--watermark", "4"]
     failures: list = []
     outcomes: list = []
+    trace_report: dict = {}
     out_lock = threading.Lock()
     completed = threading.Semaphore(0)
     stop = threading.Event()
@@ -158,6 +281,12 @@ def run_router_selftest(*, requests: int = 400, clients: int = 8,
         # traffic through the incoming executor, not just its warmup.
         mid = max(50, requests // 4)
         _drain(completed, mid, "post-rollout load")
+
+        # -- cross-tier trace propagation (both replicas still alive, so
+        # their /trace rings are scrapeable) --------------------------------
+        trace_report = _check_trace_propagation(
+            transport, addr, [r.address for r in replicas], bodies, say)
+        failures.extend(trace_report.pop("failures"))
 
         say(f"[router-selftest] SIGKILL replica {replicas[1].name} "
             f"(pid {replicas[1].proc.pid}) mid-load ...")
@@ -257,6 +386,7 @@ def run_router_selftest(*, requests: int = 400, clients: int = 8,
             "warmup_s": (surv_stats or {}).get("warmup_s"),
         },
         "replicas": router_stats["replicas"],
+        "trace": trace_report,
     }
     say(f"[router-selftest] {n} answered ({by_outcome}); retries "
         f"{total_retries} (max/request {max_retries}); evictions "
@@ -287,6 +417,11 @@ def write_router_job_summary(report: dict,
         f"(budget {report['retry_budget']}); evictions "
         f"{report['evictions']}",
         f"- rollout: {report.get('rollout', {}).get('state')}",
+        f"- trace propagation: sampled="
+        f"{report.get('trace', {}).get('sampled_trace_id')}, "
+        f"shed-then-retried="
+        f"{report.get('trace', {}).get('retried_trace_id')} "
+        f"({report.get('trace', {}).get('spans_joined')} spans joined)",
     ]
     with open(path, "a", encoding="utf-8") as f:
         f.write("\n".join(lines) + "\n")

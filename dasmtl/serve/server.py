@@ -318,14 +318,18 @@ class ServeLoop:
 
     # -- request surface -----------------------------------------------------
     def submit_async(self, x: np.ndarray, max_wait_s: Optional[float] = None,
-                     want_log_probs: bool = False):
+                     want_log_probs: bool = False,
+                     trace_id: Optional[str] = None):
         """Admit one ``(h, w)`` window; returns a Future[ServeResult].
         ``want_log_probs`` asks for the per-head log-probabilities of this
         window in the answer (pulled across D2H only on request — the
-        steady-state transfer is int predictions + a bool mask)."""
+        steady-state transfer is int predictions + a bool mask).
+        ``trace_id`` adopts an inbound cross-tier ID (the router's
+        ``X-Dasmtl-Trace``) instead of minting one."""
         req = self.batcher.submit(np.asarray(x, np.float32),
                                   max_wait_s=max_wait_s,
-                                  want_log_probs=want_log_probs)
+                                  want_log_probs=want_log_probs,
+                                  trace_id=trace_id)
         if req.wake_dispatcher:
             with self._cv:
                 self._cv.notify_all()
@@ -333,10 +337,11 @@ class ServeLoop:
 
     def submit(self, x: np.ndarray, timeout: Optional[float] = 30.0,
                max_wait_s: Optional[float] = None,
-               want_log_probs: bool = False) -> ServeResult:
+               want_log_probs: bool = False,
+               trace_id: Optional[str] = None) -> ServeResult:
         return self.submit_async(x, max_wait_s=max_wait_s,
-                                 want_log_probs=want_log_probs
-                                 ).result(timeout)
+                                 want_log_probs=want_log_probs,
+                                 trace_id=trace_id).result(timeout)
 
     # -- stage 1: dispatcher -------------------------------------------------
     def _dispatch_loop(self) -> None:
@@ -647,11 +652,12 @@ def install_signal_handlers(loop: ServeLoop,
 
 
 def _make_handler(loop: ServeLoop, request_timeout_s: float,
-                  swap_builder=None):
+                  swap_builder=None, history=None):
     """Handler class closed over the loop (BaseHTTPRequestHandler is
     instantiated per connection by the server, so state rides the class).
     ``swap_builder(version) -> executor`` arms ``POST /swap`` — the
-    replica half of the router tier's blue/green rollout."""
+    replica half of the router tier's blue/green rollout.  ``history``
+    (a :class:`dasmtl.obs.history.MetricsHistory`) arms ``GET /query``."""
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -659,15 +665,19 @@ def _make_handler(loop: ServeLoop, request_timeout_s: float,
         def log_message(self, *args) -> None:  # quiet by default
             pass
 
-        def _reply(self, code: int, payload: dict) -> None:
+        def _reply(self, code: int, payload: dict,
+                   headers: Optional[dict] = None) -> None:
             body = json.dumps(payload).encode()
-            self._reply_raw(code, body, "application/json")
+            self._reply_raw(code, body, "application/json", headers)
 
         def _reply_raw(self, code: int, body: bytes,
-                       content_type: str) -> None:
+                       content_type: str,
+                       headers: Optional[dict] = None) -> None:
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -701,6 +711,15 @@ def _make_handler(loop: ServeLoop, request_timeout_s: float,
                 body = loop.tracer.to_jsonl(int(n) if n else None)
                 self._reply_raw(200, body.encode(),
                                 "application/x-ndjson")
+            elif url.path == "/query":
+                # Metrics history (dasmtl/obs/history.py): the shared
+                # GET /query?family=&since= semantics on every front end.
+                from dasmtl.obs.history import handle_query
+
+                params = {k: v[0] for k, v in
+                          parse_qs(url.query).items()}
+                code, payload = handle_query(history, params)
+                self._reply(code, payload)
             else:
                 self._reply(404, {"error": f"unknown path {url.path}"})
 
@@ -754,6 +773,12 @@ def _make_handler(loop: ServeLoop, request_timeout_s: float,
             if self.path != "/infer":
                 self._reply(404, {"error": f"unknown path {self.path}"})
                 return
+            # Cross-tier tracing: adopt the router's X-Dasmtl-Trace and
+            # echo it on EVERY outcome, so the chain survives refusals
+            # and errors too (docs/OBSERVABILITY.md "Trace header").
+            inbound_trace = self.headers.get("X-Dasmtl-Trace") or None
+            echo = ({"X-Dasmtl-Trace": inbound_trace}
+                    if inbound_trace else None)
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(n))
@@ -762,7 +787,8 @@ def _make_handler(loop: ServeLoop, request_timeout_s: float,
             except (ValueError, KeyError, json.JSONDecodeError) as exc:
                 self._reply(400, {"ok": False, "error": "bad_request",
                                   "detail": f"expected JSON "
-                                            f'{{"x": [[...]]}}: {exc}'})
+                                            f'{{"x": [[...]]}}: {exc}'},
+                            echo)
                 return
             h, w = loop.executor.input_hw
             if x.shape == (h, w, 1):
@@ -771,15 +797,17 @@ def _make_handler(loop: ServeLoop, request_timeout_s: float,
                 self._reply(400, {
                     "ok": False, "error": "bad_request",
                     "detail": f"window must be {h}x{w}, got "
-                              f"{list(x.shape)}"})
+                              f"{list(x.shape)}"}, echo)
                 return
             try:
                 res = loop.submit(x, timeout=request_timeout_s,
-                                  want_log_probs=want_log_probs)
+                                  want_log_probs=want_log_probs,
+                                  trace_id=inbound_trace)
             except FuturesTimeoutError:
                 self._reply(504, {"ok": False, "error": "timeout",
                                   "detail": f"no response within "
-                                            f"{request_timeout_s}s"})
+                                            f"{request_timeout_s}s"},
+                            echo)
                 return
             code = {None: 200, "shed": 503, "closed": 503,
                     "nonfinite": 422}.get(res.error, 500)
@@ -791,17 +819,20 @@ def _make_handler(loop: ServeLoop, request_timeout_s: float,
                 "bucket": res.bucket, "trace_id": res.trace_id}
             if res.log_probs is not None:
                 payload["log_probs"] = res.log_probs
-            self._reply(code, payload)
+            if echo is None and res.trace_id:
+                echo = {"X-Dasmtl-Trace": res.trace_id}
+            self._reply(code, payload, echo)
 
     return Handler
 
 
 def make_http_server(loop: ServeLoop, host: str = "127.0.0.1",
                      port: int = 0, request_timeout_s: float = 30.0,
-                     swap_builder=None) -> ThreadingHTTPServer:
+                     swap_builder=None, history=None) -> ThreadingHTTPServer:
     """Bind (port 0 = ephemeral; read ``server_address[1]``) but do not
     serve — callers run ``serve_forever`` and ``shutdown`` themselves.
-    ``swap_builder(version) -> executor`` arms ``POST /swap``."""
+    ``swap_builder(version) -> executor`` arms ``POST /swap``;
+    ``history`` (MetricsHistory) arms ``GET /query``."""
     return ThreadingHTTPServer((host, port),
                                _make_handler(loop, request_timeout_s,
-                                             swap_builder))
+                                             swap_builder, history))

@@ -40,6 +40,8 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from dasmtl.obs.alerts import AlertEngine, AlertRule
+from dasmtl.obs.history import MetricsHistory, handle_query
 from dasmtl.obs.registry import (DEFAULT_LATENCY_BUCKETS_S, MetricsRegistry)
 from dasmtl.stream.feed import FiberFeed
 from dasmtl.stream.tracks import TrackBook, WindowDecode
@@ -168,7 +170,10 @@ class StreamLoop:
                  max_wait_s: float = 0.005, clock=time.monotonic,
                  events_path: Optional[str] = None,
                  events_ring: int = 1024,
-                 metrics: Optional[StreamMetrics] = None):
+                 metrics: Optional[StreamMetrics] = None,
+                 alerts: Optional[AlertEngine] = None,
+                 alerts_interval_s: float = 1.0,
+                 history: Optional[MetricsHistory] = None):
         if not tenants:
             raise ValueError("a stream loop needs at least one tenant")
         if cycle_budget < len(tenants):
@@ -194,6 +199,13 @@ class StreamLoop:
         self._pump: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.cycles = 0
+        # Fleet observability (PR 12): the alert engine is fed DIRECTLY
+        # from this loop — track records become alert events in
+        # _on_result (no scrape in between), and rule evaluation rides
+        # the pump cycle via maybe_evaluate (no extra thread).
+        self.alerts = alerts
+        self.alerts_interval_s = float(alerts_interval_s)
+        self.history = history
 
     # -- steady state --------------------------------------------------------
     def run_cycle(self, now: Optional[float] = None) -> dict:
@@ -228,6 +240,8 @@ class StreamLoop:
                 fut.add_done_callback(
                     lambda f, t=t, wdw=wdw: self._on_result(t, wdw, f))
         self.cycles += 1
+        if self.alerts is not None:
+            self.alerts.maybe_evaluate(now, self.alerts_interval_s)
         return {"submitted": submitted, "shed": shed}
 
     def _on_result(self, tenant: StreamTenant, wdw, fut) -> None:
@@ -273,6 +287,25 @@ class StreamLoop:
                     self._events_f.write(json.dumps(rec) + "\n")
             if records and self._events_f is not None:
                 self._events_f.flush()
+        # Outside the loop lock: sink I/O (webhook POSTs) must never
+        # stall the pump.  Records are already debounced by the
+        # TrackFuser hysteresis; the dedupe key makes a replayed record
+        # deliver exactly once.
+        if self.alerts is not None:
+            for rec in records:
+                if rec["kind"] not in ("open", "close"):
+                    continue
+                self.alerts.emit_event(
+                    f"stream_track_{rec['kind']}",
+                    labels={"fiber": rec["fiber"],
+                            "type": rec["event_name"]},
+                    value=rec["confidence"],
+                    severity="page" if rec["kind"] == "open" else "info",
+                    dedupe_key=f"{rec['fiber']}:{rec['track_id']}:"
+                               f"{rec['kind']}",
+                    description=f"track {rec['track_id']} "
+                                f"{rec['kind']} at fiber_pos "
+                                f"{rec['fiber_pos']}")
 
     # -- pump thread ---------------------------------------------------------
     def start(self, poll_s: float = 0.002) -> "StreamLoop":
@@ -341,8 +374,11 @@ class StreamLoop:
                     "track_closes": t.book.closes,
                     "p99_latency_ms": round(t.p99_latency_s() * 1e3, 3),
                 } for t in self.tenants}
-        return {"cycles": self.cycles, "tenants": tenants,
-                "events_held": len(self._events)}
+        out = {"cycles": self.cycles, "tenants": tenants,
+               "events_held": len(self._events)}
+        if self.alerts is not None:
+            out["alerts"] = self.alerts.stats()
+        return out
 
     def metrics_text(self) -> str:
         """The full ``GET /metrics`` exposition: serve families (which
@@ -361,12 +397,31 @@ class StreamLoop:
         return self.serve.metrics_text() + self.metrics.registry.render()
 
 
+def default_stream_rules(*, shed_rate_per_s: float = 1.0,
+                         window_s: float = 5.0,
+                         long_window_s: float = 30.0
+                         ) -> "tuple[AlertRule, ...]":
+    """The shipped stream alerting default: a SUSTAINED per-fiber shed
+    burn (the fairness gate rejecting one fiber's own excess, breaching
+    in both the short and long window) pages on that fiber's label
+    only — a neighbor under its share never pages because of it."""
+    return (AlertRule(name="stream_shed_burn",
+                      family="dasmtl_stream_shed_total",
+                      kind="burn_rate", op=">", threshold=shed_rate_per_s,
+                      window_s=window_s, long_window_s=long_window_s,
+                      severity="page",
+                      description="sustained fairness-gate shedding on "
+                                  "this fiber"),)
+
+
 # -- HTTP front end ------------------------------------------------------------
 
 def make_stream_http_server(stream: StreamLoop, host: str = "127.0.0.1",
                             port: int = 0) -> ThreadingHTTPServer:
     """The stream front end: ``GET /events`` (the track-record view),
-    ``/healthz``, ``/stats``, ``/metrics`` (serve + stream families)."""
+    ``/healthz``, ``/stats``, ``/metrics`` (serve + stream families),
+    ``/query`` (metrics history, :func:`dasmtl.obs.history.handle_query`
+    semantics)."""
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *_a):  # keep CI logs quiet
@@ -400,6 +455,11 @@ def make_stream_http_server(stream: StreamLoop, host: str = "127.0.0.1",
                 elif url.path == "/metrics":
                     self._send(200, stream.metrics_text().encode(),
                                "text/plain; version=0.0.4")
+                elif url.path == "/query":
+                    q = {k: v[0] for k, v in
+                         parse_qs(url.query).items()}
+                    code, payload = handle_query(stream.history, q)
+                    self._send(code, json.dumps(payload).encode())
                 else:
                     self._send(404, json.dumps(
                         {"error": f"no route {url.path}"}).encode())
@@ -494,6 +554,34 @@ def serve_main(argv=None) -> int:
     st.add_argument("--events_ring", type=int, default=d.stream_events_ring)
     st.add_argument("--poll_ms", type=float, default=d.stream_poll_ms,
                     help="pump cycle cadence")
+    obs = p.add_argument_group("fleet observability (dasmtl/obs/, "
+                               "docs/OBSERVABILITY.md 'Fleet alerting')")
+    obs.add_argument("--history", type=int, default=d.obs_history,
+                     help="metrics-history snapshots kept behind "
+                          "GET /query (0 disables)")
+    obs.add_argument("--history_interval_s", type=float,
+                     default=d.obs_history_interval_s,
+                     help="seconds between history snapshots")
+    obs.add_argument("--alerts", action=argparse.BooleanOptionalAction,
+                     default=d.obs_alerts,
+                     help="evaluate the default stream alert rules and "
+                          "forward track open/close records as alert "
+                          "events")
+    obs.add_argument("--alerts_interval_s", type=float,
+                     default=d.obs_alerts_interval_s,
+                     help="rule-evaluation cadence (rides the pump "
+                          "cycle)")
+    obs.add_argument("--alerts_path", type=str, default="",
+                     metavar="PATH",
+                     help="append alert events here as JSONL")
+    obs.add_argument("--alerts_webhook", type=str,
+                     default=d.obs_alerts_webhook, metavar="URL",
+                     help="POST each alert event to this webhook "
+                          "(bounded retry + backoff)")
+    obs.add_argument("--alerts_webhook_retries", type=int,
+                     default=d.obs_alerts_webhook_retries)
+    obs.add_argument("--alerts_webhook_backoff_s", type=float,
+                     default=d.obs_alerts_webhook_backoff_s)
     p.add_argument("--host", type=str, default=d.serve_host)
     p.add_argument("--port", type=int, default=d.serve_port)
     p.add_argument("--port_file", type=str, default=None, metavar="PATH")
@@ -606,10 +694,39 @@ def serve_main(argv=None) -> int:
     loop = ServeLoop(pool, buckets=buckets,
                      max_wait_s=args.max_wait_ms / 1e3,
                      queue_depth=args.queue_depth, inflight=args.inflight)
+    history = MetricsHistory(args.history) if args.history > 0 else None
+    engine = None
+    if args.alerts:
+        from dasmtl.obs.alerts import JsonlSink, StderrSink, WebhookSink
+
+        sinks: list = [StderrSink()]
+        if args.alerts_path:
+            sinks.append(JsonlSink(args.alerts_path))
+        if args.alerts_webhook:
+            sinks.append(WebhookSink(
+                args.alerts_webhook,
+                retries=args.alerts_webhook_retries,
+                backoff_s=args.alerts_webhook_backoff_s))
+        engine = AlertEngine(default_stream_rules(), sinks,
+                             history=history)
     stream = StreamLoop(loop, tenants, cycle_budget=args.cycle_budget,
                         max_wait_s=args.max_wait_ms / 1e3,
                         events_path=args.events_path,
-                        events_ring=args.events_ring)
+                        events_ring=args.events_ring,
+                        alerts=engine,
+                        alerts_interval_s=args.alerts_interval_s,
+                        history=history)
+    if engine is not None:
+        engine.add_exposition(stream.metrics_text)
+    sampler = None
+    if history is not None and engine is None:
+        # With the alert engine on, every evaluation already records a
+        # snapshot; only an alert-less front end needs its own sampler.
+        from dasmtl.obs.history import HistorySampler
+
+        sampler = HistorySampler(history, stream.metrics_text,
+                                 interval_s=args.history_interval_s)
+        sampler.start()
     httpd = make_stream_http_server(stream, args.host, args.port)
     host, port = httpd.server_address[:2]
     if args.port_file:
@@ -625,14 +742,17 @@ def serve_main(argv=None) -> int:
     n_tiles = tenants[0].windower.n_tiles
     print(f"streaming {len(tenants)} fiber(s) x {n_tiles} tile(s) "
           f"into {pool.source} on http://{host}:{port} "
-          f"(GET /events, /healthz, /stats, /metrics); SIGTERM drains",
-          file=sys.stderr)
+          f"(GET /events, /healthz, /stats, /metrics, /query); "
+          f"alerts={'on' if engine is not None else 'off'}; "
+          f"SIGTERM drains", file=sys.stderr)
     stop = threading.Event()
     install_signal_handlers(loop, on_drain=lambda _s: stop.set())
     stream.start(poll_s=args.poll_ms / 1e3)
     stop.wait()
     stream_drained = stream.drain(timeout=30.0)
     serve_drained = loop.drain(timeout=60.0)
+    if sampler is not None:
+        sampler.stop()
     httpd.shutdown()
     http_t.join(timeout=10.0)
     stream.close()

@@ -24,6 +24,14 @@ ServeLoop (MicroBatcher / StagingBuffers / ExecutorPool) -> TrackBook``
    ``dasmtl_serve_*`` required family, and never regresses a counter;
    ``GET /events`` returns well-formed track records; the JSONL sink
    holds exactly the emitted opens/closes.
+6. **Alerting** — a live :class:`~dasmtl.obs.alerts.AlertEngine` rides
+   the soak with a JSONL sink AND a real localhost webhook receiver:
+   every planted ground-truth event produces exactly ONE track-open
+   alert at BOTH sinks (the blip and the background neighbors produce
+   none), and the overdriven fiber's sustained shedding fires the
+   ``stream_shed_burn`` burn-rate rule exactly once, on its own fiber
+   label ONLY.  ``GET /query`` serves the history the engine's
+   evaluations recorded.
 
 The detector is an **analytic oracle**, not a trained model: per-window
 RMS over ``n_distance_bins`` channel groups — argmax is the distance
@@ -46,13 +54,18 @@ import tempfile
 import threading
 import time
 import urllib.request
+from collections import Counter
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Tuple
 
 import numpy as np
 
+from dasmtl.obs.alerts import AlertEngine, JsonlSink, WebhookSink
+from dasmtl.obs.history import MetricsHistory
 from dasmtl.stream.feed import PlantedEvent, SyntheticSource
 from dasmtl.stream.live import (REQUIRED_STREAM_METRIC_FAMILIES,
                                 StreamLoop, StreamTenant,
+                                default_stream_rules,
                                 make_stream_http_server)
 
 #: Oracle RMS thresholds: below the first is background, between is
@@ -155,8 +168,9 @@ def run_selftest(*, fibers: int = 3, cycles: int = 140, devices: int = 1,
         sources.append(SyntheticSource(channels, seed=i))
     sources.append(SyntheticSource(channels, seed=fibers - 1))
 
-    events_path = os.path.join(tempfile.mkdtemp(prefix="dasmtl-stream-"),
-                               "events.jsonl")
+    workdir = tempfile.mkdtemp(prefix="dasmtl-stream-")
+    events_path = os.path.join(workdir, "events.jsonl")
+    alerts_path = os.path.join(workdir, "alerts.jsonl")
     ids = itertools.count(1)
     tenants = [StreamTenant(f"f{i}", src, window=window,
                             stride_time=stride_time, stride_channels=48,
@@ -168,8 +182,45 @@ def run_selftest(*, fibers: int = 3, cycles: int = 140, devices: int = 1,
                for i, src in enumerate(sources)]
     over = tenants[-1]
     neighbors = tenants[:-1]
+
+    # Alert leg: a REAL localhost webhook receiver (every event is an
+    # actual HTTP POST) next to a JSONL sink, and the shipped burn-rate
+    # rule.  The short window must exceed the worst-case pacing stall
+    # (the 2.0s drain deadline below) or a slow cycle empties it, the
+    # rate goes unobservable, and the alert flaps — the exactly-once
+    # assertions then fail on a slow machine rather than a real bug.
+    webhook_received: List[dict] = []
+
+    class _Hook(BaseHTTPRequestHandler):
+        def do_POST(self):  # noqa: N802 — http.server API
+            n = int(self.headers.get("Content-Length", 0))
+            webhook_received.append(
+                json.loads(self.rfile.read(n).decode("utf-8")))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *args):
+            pass
+
+    hookd = ThreadingHTTPServer(("127.0.0.1", 0), _Hook)
+    hook_thread = threading.Thread(target=hookd.serve_forever, daemon=True)
+    hook_thread.start()
+    hook_host, hook_port = hookd.server_address[:2]
+
+    jsonl_sink = JsonlSink(alerts_path)
+    hook_sink = WebhookSink(f"http://{hook_host}:{hook_port}/alert",
+                            retries=2, backoff_s=0.05)
+    history = MetricsHistory(512)
+    engine = AlertEngine(
+        default_stream_rules(shed_rate_per_s=5.0, window_s=2.5,
+                             long_window_s=7.5),
+        sinks=[jsonl_sink, hook_sink], history=history)
+
     stream = StreamLoop(loop, tenants, cycle_budget=cycle_budget,
-                        max_wait_s=0.002, events_path=events_path)
+                        max_wait_s=0.002, events_path=events_path,
+                        alerts=engine, alerts_interval_s=0.2,
+                        history=history)
+    engine.add_exposition(stream.metrics_text)
 
     httpd = make_stream_http_server(stream, "127.0.0.1", 0)
     http_thread = threading.Thread(target=httpd.serve_forever, daemon=True)
@@ -189,6 +240,7 @@ def run_selftest(*, fibers: int = 3, cycles: int = 140, devices: int = 1,
                             f"{type(exc).__name__}: {exc}")
 
     events_body: Optional[list] = None
+    query_body: Optional[dict] = None
     try:
         for c in range(cycles):
             stream.run_cycle()
@@ -209,13 +261,26 @@ def run_selftest(*, fibers: int = 3, cycles: int = 140, devices: int = 1,
         except Exception as exc:  # noqa: BLE001
             failures.append(f"GET /events failed: "
                             f"{type(exc).__name__}: {exc}")
+        try:
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/query"
+                    f"?family=dasmtl_stream_shed_total",
+                    timeout=10.0) as r:
+                query_body = json.loads(r.read().decode("utf-8"))
+        except Exception as exc:  # noqa: BLE001
+            query_body = None
+            failures.append(f"GET /query failed: "
+                            f"{type(exc).__name__}: {exc}")
         stream_drained = stream.drain(timeout=60.0)
         serve_drained = loop.drain(timeout=60.0)
     finally:
         httpd.shutdown()
         http_thread.join(timeout=10.0)
+        hookd.shutdown()
+        hook_thread.join(timeout=10.0)
         stream.close()
         loop.close()
+        jsonl_sink.close()
 
     # -- 1. fairness ---------------------------------------------------------
     if not stream_drained:
@@ -364,6 +429,57 @@ def run_selftest(*, fibers: int = 3, cycles: int = 140, devices: int = 1,
         failures.append(f"JSONL sink holds {jsonl_opens} opens / "
                         f"{jsonl_closes} closes; books counted "
                         f"{total_opens} / {total_closes}")
+    if query_body is not None:
+        pts = query_body.get("snapshots", 0)
+        fam = query_body.get("family")
+        if fam != "dasmtl_stream_shed_total" or not query_body.get("points"):
+            failures.append(f"/query returned family {fam!r} with "
+                            f"{pts} snapshot(s) and "
+                            f"{len(query_body.get('points') or [])} "
+                            f"point(s) — the engine's evaluations did "
+                            f"not record history")
+
+    # -- 6. alerting vs planted ground truth ---------------------------------
+    with open(alerts_path, encoding="utf-8") as f:
+        alert_events = [json.loads(line) for line in f if line.strip()]
+
+    def opens_at(sink_events, where: str) -> None:
+        got = Counter(e["labels"]["fiber"] for e in sink_events
+                      if e.get("rule") == "stream_track_open")
+        for t in tenants:
+            if got.get(t.name, 0) != t.book.opens:
+                failures.append(
+                    f"{where}: {got.get(t.name, 0)} track-open alert(s) "
+                    f"for {t.name}, book opened {t.book.opens} — planted "
+                    f"events must page exactly once per open")
+
+    opens_at(alert_events, "alerts JSONL sink")
+    opens_at(webhook_received, "webhook sink")
+    burn = [e for e in alert_events if e.get("rule") == "stream_shed_burn"]
+    burn_firing = [e for e in burn if e["kind"] == "firing"]
+    if len(burn_firing) != 1:
+        failures.append(f"{len(burn_firing)} stream_shed_burn firing "
+                        f"event(s), expected exactly 1 (sustained "
+                        f"shedding must page once, not flap)")
+    for e in burn:
+        if e["labels"].get("fiber") != over.name:
+            failures.append(f"stream_shed_burn {e['kind']} carries labels "
+                            f"{e['labels']} — only the overdriven "
+                            f"{over.name} may page for its own shedding")
+    estats = engine.stats()
+    if (jsonl_sink.emitted != estats["events_emitted"]
+            or hook_sink.delivered != estats["events_emitted"]
+            or hook_sink.failed or estats["sink_errors"]):
+        failures.append(
+            f"sink parity broke: engine emitted "
+            f"{estats['events_emitted']}, JSONL took "
+            f"{jsonl_sink.emitted}, webhook delivered "
+            f"{hook_sink.delivered} (failed {hook_sink.failed}, "
+            f"sink_errors {estats['sink_errors']})")
+    if len(webhook_received) != hook_sink.delivered:
+        failures.append(f"webhook receiver saw {len(webhook_received)} "
+                        f"POST(s) for {hook_sink.delivered} delivered — "
+                        f"duplicate or lost deliveries")
 
     tstats = stream.stats()["tenants"]
     report = {
@@ -380,6 +496,19 @@ def run_selftest(*, fibers: int = 3, cycles: int = 140, devices: int = 1,
         "rejected": f1.rejected,
         "metrics_scrape": scrape_report,
         "events_jsonl": events_path,
+        "alerts": {
+            "jsonl": alerts_path,
+            "events_emitted": estats["events_emitted"],
+            "events_deduped": estats["events_deduped"],
+            "evaluations": estats["evaluations"],
+            "track_open_alerts": sum(
+                1 for e in alert_events
+                if e.get("rule") == "stream_track_open"),
+            "burn_firing": len(burn_firing),
+            "webhook_delivered": hook_sink.delivered,
+            "webhook_failed": hook_sink.failed,
+            "history_snapshots": (query_body or {}).get("snapshots", 0),
+        },
     }
     say(f"[stream-selftest] {sum(t['submitted'] for t in tstats.values())} "
         f"windows over {cycles} cycles; overdriven shed {over.shed}; "
@@ -389,6 +518,13 @@ def run_selftest(*, fibers: int = 3, cycles: int = 140, devices: int = 1,
         f"post-warmup recompiles "
         f"{sum(p['post_warmup_compiles'] for p in per_device_compiles)} "
         f"across {report['devices']} device(s)")
+    say(f"[stream-selftest] alert leg: "
+        f"{report['alerts']['track_open_alerts']} track-open page(s) for "
+        f"{total_opens} open(s); burn-rate fired "
+        f"{report['alerts']['burn_firing']}x on {over.name}; webhook "
+        f"delivered {hook_sink.delivered}/{estats['events_emitted']} "
+        f"(failed {hook_sink.failed}); history snapshots "
+        f"{report['alerts']['history_snapshots']}")
     for f in failures:
         say(f"[stream-selftest] FAIL: {f}")
     say(f"[stream-selftest] {'PASSED' if report['passed'] else 'FAILED'}")
@@ -411,6 +547,12 @@ def write_stream_job_summary(report: dict,
         f"- tracks closed: **{report['tracks_closed']}**; overdriven "
         f"shed **{report['overdriven_shed']}**; NaN rejections "
         f"**{report['rejected']}**",
+        (f"- alerts: **{report['alerts']['track_open_alerts']}** "
+         f"track-open page(s), burn-rate fired "
+         f"**{report['alerts']['burn_firing']}**x, webhook delivered "
+         f"**{report['alerts']['webhook_delivered']}** "
+         f"(failed {report['alerts']['webhook_failed']})")
+        if report.get("alerts") else "- alerts: n/a",
         "",
         "| fiber | submitted | shed | rejected | tracks | p99 (ms) |",
         "|---|---|---|---|---|---|",

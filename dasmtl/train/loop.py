@@ -211,7 +211,11 @@ class Trainer:
         # Train heartbeat (dasmtl/obs/heartbeat.py), armed by fit() when
         # cfg.obs_heartbeat_s > 0: fed at metric-window flushes (already
         # host-synced there — the heartbeat never adds a device sync).
+        # When cfg.obs_alerts also holds, every emitted heartbeat runs
+        # through a HeartbeatWatch -> AlertEngine tick (MFU-drop and
+        # samples/s-stall rules vs the run's own median).
         self._heartbeat: Optional[Heartbeat] = None
+        self._hb_watch = None  # Optional[dasmtl.obs.alerts.HeartbeatWatch]
         self._hb_h2d_s = 0.0  # cumulative seconds spent in _place
         self._batch_sds = None  # first real batch's ShapeDtypeStructs
 
@@ -486,6 +490,24 @@ class Trainer:
         print(f"[heartbeat] armed: every {self.cfg.obs_heartbeat_s:g}s -> "
               f"{self._heartbeat.out_path} (MFU vs peak {peak:.3g} "
               f"FLOP/s, {peak_source}; docs/OBSERVABILITY.md)")
+        if self.cfg.obs_alerts:
+            from dasmtl.obs.alerts import (AlertEngine, HeartbeatWatch,
+                                           JsonlSink, WebhookSink,
+                                           default_heartbeat_rules)
+
+            alerts_path = os.path.join(self.metrics_dir, "alerts.jsonl")
+            sinks: list = [JsonlSink(alerts_path)]
+            if self.cfg.obs_alerts_webhook:
+                sinks.append(WebhookSink(
+                    self.cfg.obs_alerts_webhook,
+                    retries=self.cfg.obs_alerts_webhook_retries,
+                    backoff_s=self.cfg.obs_alerts_webhook_backoff_s))
+            self._hb_watch = HeartbeatWatch(
+                AlertEngine(default_heartbeat_rules(), sinks))
+            print(f"[heartbeat] anomaly rules armed: MFU drop >30% / "
+                  f"samples-per-s stall vs run median -> {alerts_path}"
+                  + (f" + webhook {self.cfg.obs_alerts_webhook}"
+                     if self.cfg.obs_alerts_webhook else ""))
 
     def _train_epoch_device(self, epoch: int, lr: float) -> None:
         """One epoch on the device-resident path: the training set lives in
@@ -679,8 +701,10 @@ class Trainer:
         if self._heartbeat is not None:
             # Fed here because the window was just host-synced above —
             # the heartbeat adds zero device syncs of its own.
-            self._heartbeat.observe(epoch=epoch, step=step_in_epoch,
-                                    samples=n, elapsed_s=elapsed)
+            hb_rec = self._heartbeat.observe(epoch=epoch, step=step_in_epoch,
+                                             samples=n, elapsed_s=elapsed)
+            if hb_rec is not None and self._hb_watch is not None:
+                self._hb_watch.observe(hb_rec)
 
     def fit(self) -> List[ValidationResult]:
         """Full training run: epochs 0..epoch_num-1 with periodic validation,
@@ -756,9 +780,11 @@ class Trainer:
             if self._heartbeat is not None:
                 # Flush pending accumulation: even a run shorter than the
                 # cadence leaves at least one heartbeat line.
-                self._heartbeat.finish(
+                hb_rec = self._heartbeat.finish(
                     epoch=int(jax.device_get(self.state.epoch)),
                     step=-1)
+                if hb_rec is not None and self._hb_watch is not None:
+                    self._hb_watch.observe(hb_rec)
             if handler_installed:
                 # A C-installed prior handler reads back as None and can't be
                 # re-installed from Python; fall back to the default action so

@@ -487,6 +487,14 @@ def main() -> int:
                          "(median of 3 alternating pairs) and records "
                          "the overhead; 'on'/'off' just pin the mode "
                          "for every leg")
+    ap.add_argument("--alerts", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="during the --obs A/B, the telemetry-on side "
+                         "ALSO runs a live AlertEngine (background "
+                         "cadence scraping the loop's exposition, "
+                         "burn-rate rule on the shed counter, JSONL "
+                         "sink) so the >= 0.97 budget covers alerting "
+                         "too, not just the registry mirror")
     ap.add_argument("--router", type=int, default=None, metavar="N",
                     help="bench the router tier instead: N real replica "
                          "processes behind a real dasmtl-router — "
@@ -527,6 +535,28 @@ def main() -> int:
             # bias cancels), and the reported ratio is the MEDIAN of
             # per-pair ratios.  "on" = full telemetry (registry mirror
             # + span tracing); "off" = the pre-obs bookkeeping only.
+            engine = None
+            if args.alerts:
+                # The "on" side carries a LIVE alert engine: background
+                # cadence, full exposition parse per tick, burn-rate
+                # state machines, JSONL sink — so the 0.97 budget is the
+                # whole fleet-observability stack, not just counters.
+                import tempfile
+
+                from dasmtl.obs.alerts import (AlertEngine, AlertRule,
+                                               JsonlSink)
+
+                engine = AlertEngine(
+                    (AlertRule(name="bench_shed_burn",
+                               family="dasmtl_serve_requests_total",
+                               labels={"outcome": "shed"},
+                               kind="burn_rate", op=">", threshold=1.0,
+                               window_s=1.0, long_window_s=5.0,
+                               severity="page"),),
+                    [JsonlSink(os.path.join(
+                        tempfile.mkdtemp(prefix="dasmtl-bench-"),
+                        "alerts.jsonl"))])
+                engine.add_exposition(loop.metrics_text)
             ab = {"on": [], "off": []}
             pair_ratios = []
             for rep in range(5):
@@ -534,8 +564,12 @@ def main() -> int:
                 pair = {}
                 for mode in order:
                     loop.set_obs(mode == "on")
+                    if engine is not None and mode == "on":
+                        engine.start(0.2)
                     outcomes, wall = closed_loop(loop, hw, args.requests,
                                                  args.clients, rng)
+                    if engine is not None and mode == "on":
+                        engine.stop()
                     ok = sum(1 for o in outcomes if o == "ok")
                     pair[mode] = ok / wall
                 ab["on"].append(round(pair["on"], 1))
@@ -548,9 +582,15 @@ def main() -> int:
                 "on_over_off": float(np.median(pair_ratios)),
                 "pair_ratios": pair_ratios,
                 "runs": ab,
-                "budget": "closed-loop req/s with full telemetry must "
-                          "stay within 3% of telemetry-off "
-                          "(median of paired on/off ratios)",
+                "alert_engine": (None if engine is None else {
+                    "evaluations": engine.evaluations,
+                    "source_errors": engine.source_errors,
+                    "events_emitted": engine.events_emitted,
+                }),
+                "budget": "closed-loop req/s with full telemetry (alert "
+                          "engine included when --alerts) must stay "
+                          "within 3% of telemetry-off (median of paired "
+                          "on/off ratios)",
             }
             print(json.dumps(telemetry))
             loop.set_obs(True)
@@ -664,6 +704,19 @@ def main() -> int:
                 f"telemetry overhead over budget: closed-loop req/s "
                 f"with obs on is {telemetry['on_over_off']:.3f}x of off "
                 f"(must be >= 0.97; runs {telemetry['runs']})")
+        if telemetry is not None and telemetry.get("alert_engine"):
+            ae = telemetry["alert_engine"]
+            if not ae["evaluations"]:
+                failures.append("alert engine never ticked during the "
+                                "obs A/B — the 0.97 budget measured "
+                                "nothing")
+            if ae["source_errors"]:
+                failures.append(f"alert engine hit {ae['source_errors']} "
+                                f"exposition scrape error(s)")
+            if ae["events_emitted"]:
+                failures.append(f"alert engine paged {ae['events_emitted']}"
+                                f"x at zero shed — rule or rate math is "
+                                f"wrong")
         for f_ in failures:
             print(f"SMOKE FAIL: {f_}", file=sys.stderr)
         return 1 if failures else 0

@@ -1,0 +1,536 @@
+"""Fleet observability tests (dasmtl/obs/alerts.py + history.py +
+cross-tier trace joining).
+
+Everything here runs on a fake clock — the alert state machines, burn-rate
+windows, history rates, and webhook backoff are all asserted
+deterministically; the only real I/O is a local webhook HTTP server that
+scripts its failures.
+"""
+
+import http.server
+import io
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from dasmtl.obs.alerts import (AlertEngine, AlertRule, HeartbeatWatch,
+                               JsonlSink, StderrSink, WebhookSink,
+                               default_heartbeat_rules)
+from dasmtl.obs.history import (HistorySampler, MetricsHistory, handle_query,
+                                render_sample_key)
+from dasmtl.obs.registry import MetricsRegistry
+from dasmtl.obs.trace import (ALL_SPAN_STAGES, ROUTER_SPAN_STAGES,
+                              SPAN_STAGES, join_chains, make_span)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# -- AlertRule validation -----------------------------------------------------
+
+
+def test_alert_rule_validation():
+    with pytest.raises(ValueError, match="name and a family"):
+        AlertRule(name="", family="f")
+    with pytest.raises(ValueError, match="unknown kind"):
+        AlertRule(name="r", family="f", kind="median")
+    with pytest.raises(ValueError, match="unknown op"):
+        AlertRule(name="r", family="f", op="!=")
+    with pytest.raises(ValueError, match="unknown severity"):
+        AlertRule(name="r", family="f", severity="fatal")
+    with pytest.raises(ValueError, match="long_window_s"):
+        AlertRule(name="r", family="f", kind="burn_rate",
+                  window_s=60.0, long_window_s=60.0)
+    # A labels dict normalizes to the canonical sorted tuple.
+    r = AlertRule(name="r", family="f", labels={"b": "2", "a": "1"})
+    assert r.labels == (("a", "1"), ("b", "2"))
+    assert r.matches(("f", (("a", "1"), ("b", "2"), ("c", "3"))))
+    assert not r.matches(("f", (("a", "1"),)))
+    assert not r.matches(("g", (("a", "1"), ("b", "2"))))
+
+
+def test_engine_rejects_duplicate_rule_names():
+    r = AlertRule(name="r", family="f")
+    with pytest.raises(ValueError, match="duplicate"):
+        AlertEngine([r, AlertRule(name="r", family="g")])
+    engine = AlertEngine([r])
+    with pytest.raises(ValueError, match="duplicate"):
+        engine.add_rule(AlertRule(name="r", family="g"))
+
+
+# -- threshold state machine on a fake clock ----------------------------------
+
+
+class ListSink:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event):
+        self.events.append(event)
+
+
+def make_engine(rules, clock=None, **kw):
+    clock = clock or FakeClock()
+    sink = ListSink()
+    engine = AlertEngine(rules, [sink], clock=clock, **kw)
+    return engine, sink, clock
+
+
+def test_threshold_fires_once_holds_then_resolves_once():
+    reg = MetricsRegistry()
+    g = reg.gauge("p99_ms", "latency")
+    rule = AlertRule(name="slo", family="p99_ms", op=">", threshold=50.0,
+                     for_s=2.0, severity="page")
+    engine, sink, clock = make_engine([rule])
+    engine.add_registry(reg)
+
+    g.set(10.0)
+    for _ in range(3):
+        engine.evaluate(clock.advance(1.0))
+    assert sink.events == []
+
+    g.set(120.0)                       # breach begins at t=4
+    assert engine.evaluate(clock.advance(1.0)) == []   # pending
+    assert engine.evaluate(clock.advance(1.0)) == []   # still < for_s
+    fired = engine.evaluate(clock.advance(1.0))        # held 2s -> fires
+    assert [e["kind"] for e in fired] == ["firing"]
+    assert fired[0]["rule"] == "slo" and fired[0]["value"] == 120.0
+    # Holding the breach must NOT re-fire.
+    for _ in range(5):
+        assert engine.evaluate(clock.advance(1.0)) == []
+    assert engine.firing() and engine.firing()[0]["rule"] == "slo"
+
+    g.set(12.0)
+    resolved = engine.evaluate(clock.advance(1.0))
+    assert [e["kind"] for e in resolved] == ["resolved"]
+    assert engine.firing() == []
+    # One firing + one resolved, ever.
+    assert [e["kind"] for e in sink.events] == ["firing", "resolved"]
+
+
+def test_blip_shorter_than_for_s_never_fires():
+    reg = MetricsRegistry()
+    g = reg.gauge("p99_ms", "latency")
+    rule = AlertRule(name="slo", family="p99_ms", op=">", threshold=50.0,
+                     for_s=3.0)
+    engine, sink, clock = make_engine([rule])
+    engine.add_registry(reg)
+    g.set(120.0)
+    engine.evaluate(clock.advance(1.0))    # pending
+    g.set(10.0)
+    engine.evaluate(clock.advance(1.0))    # back to ok, silently
+    g.set(120.0)
+    engine.evaluate(clock.advance(1.0))    # pending restarts from scratch
+    engine.evaluate(clock.advance(1.0))
+    assert sink.events == []               # 2s held < 3s for_s
+
+
+def test_per_labelset_state_machines_are_independent():
+    reg = MetricsRegistry()
+    g = reg.gauge("depth", "queue depth", labelnames=("fiber",))
+    rule = AlertRule(name="deep", family="depth", op=">=", threshold=5.0)
+    engine, sink, clock = make_engine([rule])
+    engine.add_registry(reg)
+    g.set(9.0, labels=("f2",))
+    g.set(1.0, labels=("f0",))
+    events = engine.evaluate(clock.advance(1.0))
+    assert len(events) == 1 and events[0]["labels"] == {"fiber": "f2"}
+    g.set(7.0, labels=("f0",))
+    events = engine.evaluate(clock.advance(1.0))
+    assert len(events) == 1 and events[0]["labels"] == {"fiber": "f0"}
+    assert {f["sample"] for f in engine.firing()} == \
+        {'depth{fiber="f0"}', 'depth{fiber="f2"}'}
+
+
+def test_vanished_sample_resolves_instead_of_sticking():
+    texts = {"body": 'vanish_g 99\n'}
+    rule = AlertRule(name="v", family="vanish_g", op=">", threshold=1.0)
+    engine, sink, clock = make_engine([rule])
+    engine.add_exposition(lambda: texts["body"])
+    fired = engine.evaluate(clock.advance(1.0))
+    assert [e["kind"] for e in fired] == ["firing"]
+    texts["body"] = ""                 # process restarted: sample gone
+    resolved = engine.evaluate(clock.advance(1.0))
+    assert [e["kind"] for e in resolved] == ["resolved"]
+    assert engine.firing() == []
+
+
+# -- burn-rate windows --------------------------------------------------------
+
+
+def burn_engine(clock):
+    reg = MetricsRegistry()
+    c = reg.counter("shed_total", "sheds", labelnames=("fiber",))
+    rule = AlertRule(name="burn", family="shed_total", kind="burn_rate",
+                     op=">", threshold=0.5, window_s=3.0,
+                     long_window_s=9.0)
+    engine, sink, _ = make_engine([rule], clock=clock)
+    engine.add_registry(reg)
+    return engine, sink, c
+
+
+def test_burn_rate_blip_breaches_short_window_but_never_pages():
+    """A blip that breaches the SHORT window but not the LONG one must
+    stay silent — the multi-window form exists precisely so it cannot
+    page."""
+    clock = FakeClock()
+    engine, sink, c = burn_engine(clock)
+    c.inc(0.0, labels=("f2",))
+    for _ in range(10):                # long quiet baseline
+        engine.evaluate(clock.advance(1.0))
+    c.inc(3.0, labels=("f2",))         # blip: short rate 1/s > 0.5,
+    for _ in range(10):                # long rate 3/9s = 0.33 < 0.5
+        engine.evaluate(clock.advance(1.0))
+    assert sink.events == []           # gated by the long window
+
+
+def test_sustained_burn_fires_on_the_burning_label_only():
+    clock = FakeClock()
+    engine, sink, c = burn_engine(clock)
+    c.inc(0.0, labels=("f0",))
+    c.inc(0.0, labels=("f2",))
+    for _ in range(12):                # f2 burns 5/s, f0 silent
+        c.inc(5.0, labels=("f2",))
+        engine.evaluate(clock.advance(1.0))
+    fired = [e for e in sink.events if e["kind"] == "firing"]
+    assert len(fired) == 1 and fired[0]["labels"] == {"fiber": "f2"}
+    for _ in range(12):                # burn stops -> resolves, once
+        engine.evaluate(clock.advance(1.0))
+    resolved = [e for e in sink.events if e["kind"] == "resolved"]
+    assert len(resolved) == 1 and resolved[0]["labels"] == {"fiber": "f2"}
+    assert len(sink.events) == 2
+
+
+# -- direct events + dedupe ---------------------------------------------------
+
+
+def test_emit_event_dedupes_by_key_with_bounded_memory():
+    engine, sink, clock = make_engine([], dedupe_capacity=2)
+    assert engine.emit_event("track_open", labels={"fiber": "f1"},
+                             dedupe_key="f1:7:open", now=1.0) is not None
+    assert engine.emit_event("track_open", dedupe_key="f1:7:open",
+                             now=2.0) is None
+    assert engine.events_deduped == 1
+    # Capacity 2: a third distinct key evicts the oldest, which then
+    # redelivers — bounded memory traded for at-least-once on overflow.
+    engine.emit_event("t", dedupe_key="k2", now=3.0)
+    engine.emit_event("t", dedupe_key="k3", now=4.0)
+    assert engine.emit_event("track_open", dedupe_key="f1:7:open",
+                             now=5.0) is not None
+    assert len(sink.events) == 4
+
+
+def test_sink_exception_is_counted_not_raised():
+    class BadSink:
+        def emit(self, event):
+            raise RuntimeError("boom")
+
+    clock = FakeClock()
+    engine = AlertEngine([], [BadSink()], clock=clock)
+    assert engine.emit_event("e", now=1.0) is not None
+    assert engine.sink_errors == 1
+
+
+# -- webhook sink retry/backoff -----------------------------------------------
+
+
+class ScriptedHook(http.server.BaseHTTPRequestHandler):
+    fail_budget = {"n": 0}
+    received = []
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        if ScriptedHook.fail_budget["n"] > 0:
+            ScriptedHook.fail_budget["n"] -= 1
+            self.send_response(503)
+            self.end_headers()
+            return
+        ScriptedHook.received.append(json.loads(body.decode()))
+        self.send_response(200)
+        self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def webhook_server():
+    ScriptedHook.fail_budget = {"n": 0}
+    ScriptedHook.received = []
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), ScriptedHook)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}/hook"
+    httpd.shutdown()
+    t.join(timeout=5)
+
+
+def test_webhook_retries_with_exponential_backoff(webhook_server):
+    ScriptedHook.fail_budget["n"] = 2
+    sleeps = []
+    sink = WebhookSink(webhook_server, retries=3, backoff_s=0.25,
+                       sleep=sleeps.append)
+    sink.emit({"kind": "firing", "rule": "slo"})
+    assert sink.delivered == 1 and sink.failed == 0
+    assert sink.attempts == 3                     # 2 failures + 1 success
+    assert sleeps == [0.25, 0.5]                  # doubling from backoff_s
+    assert ScriptedHook.received == [{"kind": "firing", "rule": "slo"}]
+
+
+def test_webhook_burns_budget_then_drops_without_raising():
+    sleeps = []
+    # A port nothing listens on: every attempt fails fast.
+    sink = WebhookSink("http://127.0.0.1:9/hook", retries=2,
+                       backoff_s=0.1, timeout_s=0.2, sleep=sleeps.append)
+    sink.emit({"kind": "firing"})                 # must NOT raise
+    assert sink.failed == 1 and sink.delivered == 0
+    assert sink.attempts == 3                     # 1 + retries
+    assert sleeps == [0.1, 0.2]                   # no sleep after the last
+
+
+def test_jsonl_sink_appends_one_line_per_event(tmp_path):
+    path = str(tmp_path / "alerts.jsonl")
+    sink = JsonlSink(path)
+    sink.emit({"kind": "firing", "rule": "a"})
+    sink.emit({"kind": "resolved", "rule": "a"})
+    sink.close()
+    lines = [json.loads(line) for line in open(path, encoding="utf-8")]
+    assert [e["kind"] for e in lines] == ["firing", "resolved"]
+
+
+def test_stderr_sink_prefixes_and_counts():
+    buf = io.StringIO()
+    sink = StderrSink(buf)
+    sink.emit({"kind": "firing"})
+    assert buf.getvalue().startswith("[alert] ") and sink.emitted == 1
+
+
+# -- metrics history ----------------------------------------------------------
+
+
+def test_history_ring_bounds_and_counts_evictions():
+    h = MetricsHistory(capacity=3)
+    for i in range(5):
+        h.record({"g": {("g", ()): float(i)}}, now=float(i))
+    assert len(h) == 3 and h.recorded == 5
+    assert [t for t, _ in h.snapshot()] == [2.0, 3.0, 4.0]
+    assert h.latest()[1]["g"][("g", ())] == 4.0
+
+
+def test_history_family_filter_drops_unlisted():
+    h = MetricsHistory(capacity=4, families=["keep"])
+    h.record({"keep": {("keep", ()): 1.0},
+              "drop": {("drop", ()): 2.0}}, now=0.0)
+    assert h.families() == ["keep"]
+
+
+def test_history_series_since_absolute_and_relative():
+    h = MetricsHistory(capacity=16)
+    for i in range(10):
+        h.record({"g": {("g", ()): float(i)}}, now=float(i))
+    assert len(h.series("g")) == 10
+    assert [t for t, _ in h.series("g", since=7.0)] == [7.0, 8.0, 9.0]
+    # Negative since: relative to the NEWEST snapshot (t=9).
+    assert [t for t, _ in h.series("g", since=-2.0)] == [7.0, 8.0, 9.0]
+    assert h.series("missing") == []
+
+
+def test_history_rate_window_and_counter_reset():
+    h = MetricsHistory(capacity=16)
+    key = ("c", (("fiber", "f2"),))
+    for i in range(6):
+        h.record({"c": {key: 10.0 * i}}, now=float(i))
+    assert h.rate("c", key, window_s=5.0, now=5.0) == pytest.approx(10.0)
+    assert h.rate("c", key, window_s=0.5, now=5.0) is None   # < 2 points
+    h.record({"c": {key: 0.0}}, now=6.0)                     # counter reset
+    assert h.rate("c", key, window_s=3.0, now=6.0) is None
+
+
+def test_handle_query_contract():
+    assert handle_query(None, {})[0] == 404
+    h = MetricsHistory(capacity=8)
+    h.record_text('reqs_total{outcome="ok"} 5\n', now=1.0)
+    h.record_text('reqs_total{outcome="ok"} 9\n', now=2.0)
+    code, payload = handle_query(h, {})
+    assert code == 200 and payload["families"] == ["reqs_total"]
+    assert payload["snapshots"] == 2 and payload["capacity"] == 8
+    code, payload = handle_query(h, {"family": "reqs_total",
+                                     "since": "nope"})
+    assert code == 400 and "since" in payload["error"]
+    code, payload = handle_query(h, {"family": "reqs_total",
+                                     "since": "1.5"})
+    assert code == 200 and len(payload["points"]) == 1
+    assert payload["points"][0]["samples"] == \
+        {'reqs_total{outcome="ok"}': 9.0}
+    code, payload = handle_query(h, {"family": "absent"})
+    assert code == 200 and payload["points"] == []
+
+
+def test_history_sampler_counts_scrape_failures():
+    clock = FakeClock()
+    h = MetricsHistory(capacity=4)
+    bodies = iter(["good_g 1\n", "not exposition {{{", "good_g 2\n"])
+    sampler = HistorySampler(h, lambda: next(bodies), clock=clock)
+    assert sampler.sample_once() is True
+    assert sampler.sample_once() is False
+    assert sampler.sample_once() is True
+    assert sampler.errors == 1 and len(h) == 2
+
+
+# -- cross-tier trace join ----------------------------------------------------
+
+
+def test_join_chains_orders_router_then_replica_stage_major():
+    """Spans from two processes whose monotonic clocks DISAGREE (the
+    replica's start_s values are tiny, the router's huge) must still join
+    in end-to-end pipeline order — that is what stage-major sorting is
+    for."""
+    tid = "abc-00000001"
+    router_spans = [
+        make_span(tid, 0, "router_resolve", 9000.0, 0.01, outcome="ok"),
+        make_span(tid, 0, "router_recv", 9000.0, 0.0),
+        make_span(tid, 0, "retry", 9000.4, 0.0, outcome="shed"),
+        make_span(tid, 0, "forward", 9000.1, 0.2, device="r0",
+                  outcome="http_503"),
+        make_span(tid, 0, "forward", 9000.5, 0.2, device="r1",
+                  outcome="http_200"),
+        make_span(tid, 0, "place", 9000.0, 0.0, device="r0"),
+        make_span(tid, 0, "place", 9000.4, 0.0, device="r1"),
+    ]
+    replica_spans = [
+        make_span(tid, 7, stage, 1.0 + i * 0.1, 0.05)
+        for i, stage in enumerate(SPAN_STAGES)
+    ]
+    other = make_span("zzz-0", 1, "submit", 5.0, 0.0, outcome="shed")
+    chains = join_chains(replica_spans + [other] + router_spans)
+    assert set(chains) == {tid, "zzz-0"}
+    stages = [s["stage"] for s in chains[tid]]
+    assert stages == ["router_recv", "place", "place", "forward",
+                      "forward", "retry", "submit", "queue", "form",
+                      "dispatch", "collect", "resolve", "router_resolve"]
+    # Within a repeated stage, start_s breaks the tie (r0 before r1).
+    forwards = [s for s in chains[tid] if s["stage"] == "forward"]
+    assert [f["device"] for f in forwards] == ["r0", "r1"]
+
+
+def test_join_chains_tolerates_unknown_stages():
+    spans = [make_span("t", 0, "router_recv", 0.0, 0.0)]
+    future = dict(spans[0], stage="teleport")     # a newer build's stage
+    chains = join_chains(spans + [future])
+    assert [s["stage"] for s in chains["t"]] == ["router_recv", "teleport"]
+
+
+def test_make_span_rejects_unknown_stage():
+    with pytest.raises(ValueError, match="unknown span stage"):
+        make_span("t", 0, "yolo", 0.0, 0.0)
+    assert ALL_SPAN_STAGES[0] == "router_recv"
+    assert ALL_SPAN_STAGES[-1] == "router_resolve"
+    assert set(ROUTER_SPAN_STAGES) | set(SPAN_STAGES) == set(ALL_SPAN_STAGES)
+
+
+# -- batcher trace-id adoption ------------------------------------------------
+
+
+def win():
+    return np.zeros((4, 8), np.float32)
+
+
+def make_batcher(**kw):
+    from dasmtl.obs.trace import TraceRing
+    from dasmtl.serve.batcher import MicroBatcher
+
+    kw.setdefault("buckets", (4,))
+    kw.setdefault("max_wait_s", 0.01)
+    kw.setdefault("queue_depth", 8)
+    kw.setdefault("watermark", 8)
+    kw.setdefault("clock", FakeClock())
+    kw.setdefault("tracer", TraceRing(64))
+    return MicroBatcher(**kw)
+
+
+def test_batcher_adopts_inbound_trace_id():
+    b = make_batcher()
+    req = b.submit(win(), trace_id="router-tid-1")
+    assert req.trace_id == "router-tid-1"
+    spans = b.tracer.snapshot()
+    assert spans and spans[0]["trace_id"] == "router-tid-1"
+    assert spans[0]["stage"] == "submit"
+
+
+def test_batcher_mints_when_no_inbound_id():
+    b = make_batcher()
+    req = b.submit(win())
+    assert req.trace_id                       # minted, non-empty
+    assert b.tracer.snapshot()[0]["trace_id"] == req.trace_id
+
+
+def test_refusal_span_carries_the_adopted_id():
+    b = make_batcher(queue_depth=2, watermark=1)
+    b.submit(win(), trace_id="keep-1")        # fills to the watermark
+    shed = b.submit(win(), trace_id="keep-2")
+    res = shed.future.result(timeout=1.0)
+    assert not res.ok and res.error == "shed"
+    assert res.trace_id == "keep-2"           # refusal stays attributable
+    shed_spans = [s for s in b.tracer.snapshot()
+                  if s["trace_id"] == "keep-2"]
+    assert [s["outcome"] for s in shed_spans] == ["shed"]
+
+
+# -- heartbeat anomaly defaults -----------------------------------------------
+
+
+def test_default_heartbeat_rules_shape():
+    rules = default_heartbeat_rules(mfu_drop=0.30, stall_ratio=0.20)
+    assert [r.name for r in rules] == ["train_mfu_drop",
+                                      "train_samples_stall"]
+    assert rules[0].threshold == pytest.approx(0.70)
+    assert rules[1].threshold == pytest.approx(0.20)
+    assert all(r.severity == "page" for r in rules)
+
+
+def test_heartbeat_watch_pins_until_min_records_then_pages_on_drop():
+    clock = FakeClock()
+    sink = ListSink()
+    engine = AlertEngine(default_heartbeat_rules(), [sink], clock=clock)
+    watch = HeartbeatWatch(engine, min_records=4)
+
+    def beat(mfu, sps):
+        return watch.observe({"mfu": mfu, "samples_per_s": sps},
+                             now=clock.advance(1.0))
+
+    # Cold start: 3 healthy beats, ratios pinned at 1.0 -> silence even
+    # though the history is too thin for a median to mean anything.
+    for _ in range(3):
+        assert beat(0.40, 1000.0) == []
+    for _ in range(5):                  # healthy steady state
+        assert beat(0.40, 1000.0) == []
+    events = beat(0.20, 1000.0)         # 50% MFU drop vs median 0.40
+    assert [e["rule"] for e in events] == ["train_mfu_drop"]
+    assert events[0]["kind"] == "firing"
+    events = beat(0.40, 150.0)          # sps at 15% of median -> stall
+    kinds = {(e["rule"], e["kind"]) for e in events}
+    assert ("train_samples_stall", "firing") in kinds
+    assert ("train_mfu_drop", "resolved") in kinds
+    events = beat(0.40, 1000.0)         # recovery
+    assert [(e["rule"], e["kind"]) for e in events] == \
+        [("train_samples_stall", "resolved")]
+    # NaN records are guarded, not crashed on.
+    assert beat(float("nan"), float("nan")) == []
+
+
+def test_heartbeat_watch_ignores_missing_fields():
+    engine = AlertEngine(default_heartbeat_rules(), [], clock=FakeClock())
+    watch = HeartbeatWatch(engine)
+    watch.observe({"step": 1}, now=1.0)   # no mfu/samples_per_s: no crash
+    assert engine.evaluations == 1

@@ -122,9 +122,11 @@ class ScriptedTransport:
     def __init__(self, behaviors):
         self.behaviors = dict(behaviors)
         self.calls = []
+        self.attempts = []  # (address, body, headers) per infer hop
 
-    def infer(self, address, body, timeout_s=None):
+    def infer(self, address, body, timeout_s=None, headers=None):
         self.calls.append(("infer", address))
+        self.attempts.append((address, body, dict(headers or {})))
         beh = self.behaviors[address]
         if isinstance(beh, Exception):
             raise beh
@@ -158,6 +160,50 @@ def test_single_bounded_retry_on_shed():
     assert len(infers) == 2 and infers[0][1] != infers[1][1]
     # Shedding is load, not death: the shedder stays in rotation.
     assert a.in_rotation and b.in_rotation
+
+
+def test_retried_request_is_byte_identical_and_carries_one_trace_id():
+    """The retry hop must replay the ORIGINAL buffered body (never
+    re-read / re-serialized) and every hop must carry the same
+    ``X-Dasmtl-Trace`` header — that one ID is what lets ``obs join``
+    stitch a shed-then-retried request across tiers."""
+    a, b = ready_handle(name="a"), ready_handle(name="b")
+    shed = (503, {"ok": False, "error": "shed", "detail": "watermark"})
+    ok = (200, {"ok": True, "predictions": {"event": 1}})
+    router = make_router([a, b], {a.address: shed, b.address: ok})
+    body = b'{"x": [1, 2, 3], "note": "exact bytes matter"}'
+    status, payload = router.handle_infer(body, trace_id="tid-42")
+    assert status == 200 and payload["router"]["retries"] == 1
+    attempts = router.transport.attempts
+    assert len(attempts) == 2
+    # Byte-identical replay on the retry hop.
+    assert attempts[0][1] == body and attempts[1][1] == body
+    # Same trace header on BOTH hops, including the retry.
+    assert [h.get("X-Dasmtl-Trace") for _, _, h in attempts] == \
+        ["tid-42", "tid-42"]
+    assert payload["router"]["trace_id"] == "tid-42"
+
+
+def test_router_mints_trace_id_and_records_span_chain():
+    from dasmtl.obs.trace import ROUTER_SPAN_STAGES, join_chains
+
+    a, b = ready_handle(name="a"), ready_handle(name="b")
+    shed = (503, {"ok": False, "error": "shed", "detail": "watermark"})
+    ok = (200, {"ok": True, "predictions": {"event": 1}})
+    router = make_router([a, b], {a.address: shed, b.address: ok})
+    status, _payload = router.handle_infer(b"{}")
+    assert status == 200
+    # No inbound ID: the router minted one and put it on the wire.
+    minted = router.transport.attempts[0][2]["X-Dasmtl-Trace"]
+    assert minted
+    chains = join_chains(router.tracer.snapshot())
+    assert list(chains) == [minted]
+    stages = [s["stage"] for s in chains[minted]]
+    # Stage-major order: recv, place+forward per hop, retry marker, resolve.
+    assert stages[0] == "router_recv" and stages[-1] == "router_resolve"
+    assert stages.count("retry") == 1 and stages.count("forward") == 2
+    assert all(s in ROUTER_SPAN_STAGES for s in stages)
+    assert chains[minted][-1]["outcome"] == "ok"
 
 
 def test_retry_budget_exhaustion_returns_the_shed_answer():
@@ -218,7 +264,7 @@ class RolloutTransport:
         self.generations = {}
         self.fail_at = fail_at
 
-    def infer(self, address, body, timeout_s=None):
+    def infer(self, address, body, timeout_s=None, headers=None):
         return (200, {"ok": True})
 
     def probe(self, address, timeout_s=None):
