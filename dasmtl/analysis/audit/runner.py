@@ -75,15 +75,20 @@ def run_audit(configs, *, kinds: Tuple[str, ...] = ("train", "eval"),
     (train/eval matrix cells AND serve-forward precision targets — the
     latter additionally run AUD108 when they carry int8 expectations)."""
     from dasmtl.analysis.audit.targets import (ServeAuditConfig,
+                                               StreamResidentAuditConfig,
                                                lower_config,
-                                               lower_serve_config)
+                                               lower_serve_config,
+                                               lower_stream_config)
 
     reports: List[TargetReport] = []
     findings: List[AuditFinding] = []
     for acfg in configs:
-        targets = (lower_serve_config(acfg)
-                   if isinstance(acfg, ServeAuditConfig)
-                   else lower_config(acfg, kinds=kinds))
+        if isinstance(acfg, StreamResidentAuditConfig):
+            targets = lower_stream_config(acfg)
+        elif isinstance(acfg, ServeAuditConfig):
+            targets = lower_serve_config(acfg)
+        else:
+            targets = lower_config(acfg, kinds=kinds)
         for tgt in targets:
             report, found = audit_target(
                 tgt.name, tgt.lowered, n_devices=tgt.n_devices,
@@ -214,11 +219,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.list_configs:
         from dasmtl.analysis.audit.targets import (PRESETS, full_matrix,
-                                                   serve_matrix)
+                                                   serve_matrix,
+                                                   stream_matrix)
 
         for c in full_matrix():
             print(c.name)
         for c in serve_matrix():
+            print(c.name)
+        for c in stream_matrix():
             print(c.name)
         for name, cfgs in sorted(PRESETS.items()):
             print(f"preset {name}: {', '.join(c.name for c in cfgs)}")

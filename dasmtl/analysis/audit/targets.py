@@ -91,16 +91,51 @@ def serve_matrix() -> List[ServeAuditConfig]:
             for p in SERVE_PRECISIONS]
 
 
+@dataclasses.dataclass(frozen=True)
+class StreamResidentAuditConfig:
+    """One fused resident-stream target: the program the live tier's
+    :class:`dasmtl.stream.resident.ResidentExecutor` dispatches — in-graph
+    window slicing over a device-resident fiber ring fused with the
+    precision forward and decode tail
+    (:func:`dasmtl.export.make_resident_serve_fn`).  Lowered with the ring
+    AND the precision pack as abstract arguments, so AUD101/AUD103 pin the
+    gather+forward+decode as one program per (precision, rung) and the
+    baseline catches a fusion break (e.g. the slice falling back to a
+    host-side gather) as a budget drift."""
+
+    model: str = "MTL"
+    precision: str = "f32"
+    k: int = 8  # windows per dispatch — the audited rung
+    ring_channels: int = 2 * INPUT_HEIGHT
+    ring_samples: int = 4 * INPUT_WIDTH
+
+    @property
+    def name(self) -> str:
+        return f"stream-{self.model}-{self.precision}-k{self.k}"
+
+    @property
+    def n_devices(self) -> int:
+        return 1
+
+
+def stream_matrix() -> List[StreamResidentAuditConfig]:
+    """The fused resident dispatch for every serving precision preset."""
+    return [StreamResidentAuditConfig(model="MTL", precision=p)
+            for p in SERVE_PRECISIONS]
+
+
 def _named(names: Tuple[str, ...]):
     by_name = {c.name: c for c in full_matrix()}
     by_name.update({c.name: c for c in serve_matrix()})
+    by_name.update({c.name: c for c in stream_matrix()})
     return [by_name[n] for n in names]
 
 
 #: quick: the one config exercising sharding + donation + budgets at once.
 #: ci: adds the 1-device contract, the bf16 discipline check, model B —
-#: and the three serve-forward precision targets (cheap: eval-sized
-#: programs, fast compiles, and they pin what production actually runs).
+#: the three serve-forward precision targets, and the fused resident
+#: stream dispatch per precision (cheap: eval-sized programs, fast
+#: compiles, and they pin what production actually runs).
 #: full: every cell, including the ~30 s Inception compiles — baseline
 #: regeneration and pre-release sweeps.
 PRESETS: Dict[str, list] = {
@@ -108,8 +143,10 @@ PRESETS: Dict[str, list] = {
     "ci": _named(("MTL-f32-dp1", "MTL-f32-dp2", "MTL-bf16-dp2",
                   "single_event-f32-dp1",
                   "serve-MTL-f32-b8", "serve-MTL-bf16-b8",
-                  "serve-MTL-int8-b8")),
-    "full": full_matrix() + serve_matrix(),
+                  "serve-MTL-int8-b8",
+                  "stream-MTL-f32-k8", "stream-MTL-bf16-k8",
+                  "stream-MTL-int8-k8")),
+    "full": full_matrix() + serve_matrix() + stream_matrix(),
 }
 
 
@@ -236,15 +273,71 @@ def lower_serve_config(scfg: ServeAuditConfig) -> List[LoweredTarget]:
         expect_int8=expect_int8)]
 
 
+def lower_stream_config(scfg: StreamResidentAuditConfig,
+                        ) -> List[LoweredTarget]:
+    """Lower one fused resident-stream dispatch.
+
+    The ring (``(channels, samples)`` in the precision's staging dtype),
+    the window origins (``(k, 2) int32``) and the precision pack are all
+    abstract ARGUMENTS — this is the executable the live lane reuses
+    across cycles, keyed only on shapes, with nothing baked in.  Kind is
+    ``serve``: like the serve-forward targets it never donates and never
+    communicates, and its FLOP/byte budgets land in the committed
+    baseline so a fusion regression shows up as drift."""
+    import jax
+
+    from dasmtl.export import make_resident_serve_fn
+    from dasmtl.models.precision import (abstract_precision_pack,
+                                         precision_forward,
+                                         staging_dtype_for)
+    from dasmtl.models.registry import get_model_spec
+
+    spec = get_model_spec(scfg.model)
+    pack_sds, meta = abstract_precision_pack(spec, scfg.precision)
+    fwd = precision_forward(spec, scfg.precision)
+    window = (INPUT_HEIGHT, INPUT_WIDTH)
+
+    def fused(pack, rec, origins):
+        return make_resident_serve_fn(
+            lambda xs: fwd(pack, xs), window)(rec, origins)
+
+    rec_sds = jax.ShapeDtypeStruct(
+        (scfg.ring_channels, scfg.ring_samples),
+        staging_dtype_for(scfg.precision))
+    origins_sds = jax.ShapeDtypeStruct((scfg.k, 2), jax.numpy.int32)
+    analytic = None
+    try:
+        from dasmtl.analysis.audit.analytic import analytic_flops_of
+
+        analytic = analytic_flops_of(fused, pack_sds, rec_sds, origins_sds)
+    except Exception:  # noqa: BLE001 — analytic count is best-effort
+        pass
+    expect_int8 = None
+    if scfg.precision == "int8":
+        expect_int8 = {
+            "dequantize": meta.n_kernels_quantized - meta.n_dense_native,
+            "native_dots": meta.n_dense_native,
+        }
+    return [LoweredTarget(
+        name=scfg.name, kind="serve",
+        lowered=jax.jit(fused).lower(pack_sds, rec_sds, origins_sds),
+        n_devices=1,
+        compute_dtype=("float32" if scfg.precision == "f32"
+                       else "bfloat16"),
+        donation="none", analytic_by_dtype=analytic,
+        expect_int8=expect_int8)]
+
+
 def resolve_configs(preset: Optional[str] = None,
                     names: Optional[str] = None) -> list:
     """CLI selection: ``names`` (comma-separated target-cell names from
-    :func:`full_matrix` / :func:`serve_matrix`) beats ``preset``; default
-    preset is ``ci``."""
+    :func:`full_matrix` / :func:`serve_matrix` / :func:`stream_matrix`)
+    beats ``preset``; default preset is ``ci``."""
     if names:
         wanted = [n.strip() for n in names.split(",") if n.strip()]
         by_name = {c.name: c for c in full_matrix()}
         by_name.update({c.name: c for c in serve_matrix()})
+        by_name.update({c.name: c for c in stream_matrix()})
         unknown = sorted(set(wanted) - set(by_name))
         if unknown:
             raise ValueError(

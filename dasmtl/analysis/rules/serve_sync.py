@@ -1,21 +1,24 @@
 """Serving data-plane synchronization rule.
 
-DAS111 — a blocking device->host sync inside ``dasmtl/serve/`` outside the
-designated ``collect()`` point.  The pipelined serve loop stays ahead of
-the device ONLY while nothing on the dispatch path blocks: one stray
-``jax.device_get`` / ``.block_until_ready()`` (or a numpy conversion of a
-device array, which syncs implicitly) re-serializes host and device and
-silently halves throughput — the serving twin of DAS101's step-path
-discipline.  The package carries exactly one suppression, on the single
-legal sync inside :meth:`dasmtl.serve.executor.InferExecutor.collect`.
+DAS111 — a blocking device->host sync inside ``dasmtl/serve/`` or
+``dasmtl/stream/`` outside the designated ``collect`` point.  The
+pipelined serve loop stays ahead of the device ONLY while nothing on the
+dispatch path blocks: one stray ``jax.device_get`` /
+``.block_until_ready()`` (or a numpy conversion of a device array, which
+syncs implicitly) re-serializes host and device and silently halves
+throughput — the serving twin of DAS101's step-path discipline.  Each
+covered package carries exactly one suppression, on its single legal
+sync: :meth:`dasmtl.serve.executor.InferExecutor.collect` for serve, and
+:func:`dasmtl.stream.resident.collect_host` (the resident cycle
+collector) for stream — every stream-tier D2H pull routes through it.
 
 Scope (docs/STATIC_ANALYSIS.md): every function in every module under
-``dasmtl/serve/`` — not just jit-reachable code, because in serving the
-sync cost is paid on the HOST thread, outside any trace.  Numpy
-conversions are flagged when their argument syntactically contains a
-``jax.*`` call or an executor dispatch (``self._fn(...)``): converting a
-fresh device value is always a sync, while ``np.asarray`` over host
-request payloads stays legal.
+``dasmtl/serve/`` and ``dasmtl/stream/`` — not just jit-reachable code,
+because in serving the sync cost is paid on the HOST thread, outside any
+trace.  Numpy conversions are flagged when their argument syntactically
+contains a ``jax.*`` call or an executor dispatch (``self._fn(...)``):
+converting a fresh device value is always a sync, while ``np.asarray``
+over host request payloads stays legal.
 """
 
 from __future__ import annotations
@@ -37,7 +40,8 @@ _NUMPY_CONVERSIONS = frozenset({"numpy.asarray", "numpy.array",
 
 
 def _in_serve_package(path: str) -> bool:
-    return "dasmtl/serve/" in path.replace("\\", "/")
+    p = path.replace("\\", "/")
+    return "dasmtl/serve/" in p or "dasmtl/stream/" in p
 
 
 def _mentions_device_value(ctx: ModuleContext, node: ast.AST) -> bool:

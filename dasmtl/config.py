@@ -249,6 +249,20 @@ class Config:
     stream_min_event_prob: float = 0.9
     stream_track_merge_bins: float = 2.0
     stream_distance_ewma: float = 0.3
+    # Device-resident data plane: each fiber keeps an on-device ring
+    # (one H2D per chunk via a donated in-graph update) and a cycle's
+    # admitted windows run as ONE fused slice+forward+decode dispatch
+    # over a power-of-two windows-per-dispatch ladder.  `auto` engages
+    # on accelerator backends when every ring fits device memory (the
+    # offline `--resident auto` convention); the host path stays the
+    # fallback with int-exact decode parity.
+    # `stream_resident_max_windows` caps the ladder (0 = the tenant's
+    # fairness quota).  `stream_adapt_weights` feeds each fiber's recent
+    # shed rate back into its fairness weight (bounded multiplicative
+    # decrease, additive recovery toward the configured base).
+    stream_resident: str = "auto"  # auto | on | off
+    stream_resident_max_windows: int = 0
+    stream_adapt_weights: bool = False
     # Track-record sinks: the last `stream_events_ring` records stay
     # queryable at GET /events; `stream_events_path` additionally appends
     # every record as JSONL (None = no file sink).
@@ -385,6 +399,13 @@ class Config:
             raise ValueError(
                 f"stream_distance_ewma {self.stream_distance_ewma} "
                 f"outside (0, 1]")
+        if self.stream_resident not in ("auto", "on", "off"):
+            raise ValueError(
+                f"unknown stream_resident {self.stream_resident!r}; "
+                f"expected auto | on | off")
+        if self.stream_resident_max_windows < 0:
+            raise ValueError("stream_resident_max_windows must be >= 0 "
+                             "(0 = the tenant's fairness quota)")
         if self.stream_events_ring < 1:
             raise ValueError("stream_events_ring must be >= 1")
         if self.router_replicas < 1:
@@ -831,6 +852,24 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
                    default=d.stream_distance_ewma,
                    help="EWMA weight smoothing a track's distance/"
                         "position estimate across windows")
+    p.add_argument("--stream_resident", type=str,
+                   default=d.stream_resident,
+                   choices=["auto", "on", "off"],
+                   help="device-resident live data plane: on-device "
+                        "fiber rings + one fused slice+forward+decode "
+                        "dispatch per fiber per cycle (auto = "
+                        "accelerator backend with rings fitting device "
+                        "memory)")
+    p.add_argument("--stream_resident_max_windows", type=int,
+                   default=d.stream_resident_max_windows,
+                   help="cap of the resident windows-per-dispatch rung "
+                        "ladder (0 = the tenant's fairness quota)")
+    p.add_argument("--stream_adapt_weights",
+                   action=argparse.BooleanOptionalAction,
+                   default=d.stream_adapt_weights,
+                   help="feed each fiber's recent shed rate back into "
+                        "its fairness weight (bounded decrease, additive "
+                        "recovery toward the configured base)")
     p.add_argument("--stream_events_ring", type=int,
                    default=d.stream_events_ring,
                    help="track records held for GET /events")

@@ -126,6 +126,75 @@ def make_serve_infer_fn(spec, state) -> Callable:
     return serve_infer
 
 
+#: Fixed-point scale of the quantized per-row event confidence
+#: (``event_prob_q`` below): probabilities in units of 2^-20 (~1e-6
+#: resolution), so the steady-state D2H transfer of the resident live
+#: path stays ints + bools while the track hysteresis still reads a
+#: confidence within the repo's 1e-6 float-parity convention.
+PROB_Q_SCALE = 1 << 20
+
+
+def make_resident_forward(body_fn: Callable, window) -> Callable:
+    """In-graph window slicing over a device-resident record or ring.
+
+    Returns ``forward(rec, origins)``: ``rec`` is a ``(channels, time)``
+    array already living on device, ``origins`` an ``(k, 2) int32`` array
+    of ``(channel, time)`` window origins.  Each window is gathered with a
+    static-shape ``dynamic_slice`` (``vmap`` over the origin rows) and the
+    stacked ``(k, h, w, 1)`` batch handed to ``body_fn`` — so the whole
+    slice+forward runs as ONE compiled program keyed only on the record
+    shape and ``k``, and the steady state moves window *origins*
+    host->device instead of window *pixels*.
+
+    This is the shared core of both resident paths: the offline sweep
+    (:func:`dasmtl.stream.offline.stream_predict` with ``resident``) and
+    the live tier's fused multi-window executor
+    (:mod:`dasmtl.stream.resident`).
+    """
+    import jax
+
+    h, w = int(window[0]), int(window[1])
+
+    def forward(rec, origins):
+        def slice_one(o):
+            return jax.lax.dynamic_slice(rec, (o[0], o[1]), (h, w))
+
+        xs = jax.vmap(slice_one)(origins)[..., None]
+        return body_fn(xs)
+
+    return forward
+
+
+def make_resident_serve_fn(infer_fn: Callable, window) -> Callable:
+    """:func:`make_resident_forward` with the serve decode tail fused in —
+    the production program of the live resident data plane (and what the
+    ``stream-resident`` audit target lowers).
+
+    ``infer_fn`` is a serve forward (``(k, h, w, 1) -> outputs``, e.g.
+    :func:`make_serve_infer_fn` or a precision preset's
+    :func:`~dasmtl.models.precision.make_precision_serve_fn`).  On top of
+    its outputs the fused program guarantees ``bad_rows`` (in-graph, for
+    infer fns that don't already emit it) and adds ``event_prob_q``: the
+    per-row event-head confidence ``exp(max(log_probs_event))`` quantized
+    to :data:`PROB_Q_SCALE` fixed point, so the cycle collector's pull
+    stays int predictions + bools — the ``log_probs_*`` heads remain
+    device-resident unless a parity check asks for them."""
+    import jax.numpy as jnp
+
+    def serve_body(xs):
+        out = dict(infer_fn(xs))
+        if "bad_rows" not in out:
+            out["bad_rows"] = nonfinite_rows(out)
+        lp = out.get("log_probs_event")
+        if lp is not None:
+            prob = jnp.exp(jnp.max(lp, axis=-1))
+            out["event_prob_q"] = jnp.round(
+                prob * PROB_Q_SCALE).astype(jnp.int32)
+        return out
+
+    return make_resident_forward(serve_body, window)
+
+
 def export_infer(spec, state, *, input_hw=(100, 250),
                  platforms=("cpu", "tpu", "axon"),
                  disable_platform_check=False, precision: str = "f32"):

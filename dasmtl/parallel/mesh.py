@@ -100,6 +100,22 @@ def infer_batch_sharding(plan: MeshPlan) -> NamedSharding:
     return NamedSharding(plan.mesh, P("dp", None, None, None))
 
 
+def fiber_placements(n_fibers: int,
+                     devices: Optional[Sequence] = None) -> list:
+    """Assign live fibers to the serving pool's devices, round-robin —
+    the resident data plane's placement policy: fiber ``i``'s on-device
+    ring and fused window executor both live on ``devices[i % n]``, so a
+    cycle's one-dispatch-per-fiber lands spread across the pool and the
+    per-(rung, device) recompile accounting stays per-lane exact.
+    ``devices`` entries may be ``jax.Device`` objects or ``None``
+    (default placement — a single-device pool); returns ``(device_index,
+    device)`` pairs, one per fiber."""
+    if n_fibers < 1:
+        raise ValueError("need at least one fiber")
+    devs = list(devices) if devices else [None]
+    return [(i % len(devs), devs[i % len(devs)]) for i in range(n_fibers)]
+
+
 def shard_batch(plan: MeshPlan, batch: dict) -> dict:
     """Place a host batch onto the mesh with the canonical layout."""
     shardings = batch_sharding(plan)

@@ -75,6 +75,11 @@ class InferExecutor:
                                              staging_dtype_for)
 
         self._fn = jax.jit(infer_fn) if jit else infer_fn
+        # The un-jitted forward, kept for fusion INTO a larger program
+        # (the resident stream lane traces it inside its slice+decode
+        # dispatch).  None on the exported path: a fixed StableHLO
+        # computation cannot be re-traced into a fused program.
+        self.raw_infer_fn = infer_fn if jit else None
         # The separately-jitted decode tail for computations whose body is
         # fixed (an exported artifact cannot grow a bad_rows output):
         # runs over the artifact's device outputs, so nothing transfers.

@@ -60,7 +60,19 @@ REQUIRED_STREAM_METRIC_FAMILIES = (
     "dasmtl_stream_open_tracks",
     "dasmtl_stream_tile_occupancy",
     "dasmtl_stream_sample_to_event_latency_seconds",
+    "dasmtl_stream_resident_h2d_bytes_total",
+    "dasmtl_stream_resident_windows_total",
+    "dasmtl_stream_resident_dispatches_total",
+    "dasmtl_stream_resident_ring_occupancy",
 )
+
+#: Adaptive per-tenant weights (``adapt_weights``): bounded
+#: multiplicative decrease on an interval that shed, additive recovery
+#: toward the configured base weight on a clean interval, floored at a
+#: fraction of base so a fiber can never be starved outright.
+ADAPT_DECREASE = 0.7
+ADAPT_RECOVER = 0.05
+ADAPT_MIN_WEIGHT_FRACTION = 0.25
 
 
 class StreamMetrics:
@@ -107,6 +119,23 @@ class StreamMetrics:
             "Sample arrival -> track-state update, per resolved window",
             buckets=tuple(latency_buckets_s or DEFAULT_LATENCY_BUCKETS_S),
             labelnames=lab)
+        # Resident data plane (docs/STREAMING.md "Resident data plane"):
+        # headers render on every scrape, samples only on resident lanes.
+        self.resident_h2d_bytes = r.counter(
+            "dasmtl_stream_resident_h2d_bytes_total",
+            "Bytes shipped host->device into the resident ring (one "
+            "transfer per CHUNK — divide by resident_windows_total for "
+            "bytes/window)", lab)
+        self.resident_windows = r.counter(
+            "dasmtl_stream_resident_windows_total",
+            "Windows gathered in-graph out of the resident ring", lab)
+        self.resident_dispatches = r.counter(
+            "dasmtl_stream_resident_dispatches_total",
+            "Fused slice+forward+decode dispatches (windows_total / "
+            "dispatches_total = windows per dispatch)", lab)
+        self.resident_ring_occupancy = r.gauge(
+            "dasmtl_stream_resident_ring_occupancy",
+            "Fraction of the on-device ring holding real samples", lab)
 
 
 class StreamTenant:
@@ -124,6 +153,10 @@ class StreamTenant:
         self.name = name
         self.source = source
         self.weight = float(weight)
+        # The configured share — adaptive weighting moves ``weight``
+        # within [ADAPT_MIN_WEIGHT_FRACTION * base, base] and recovers
+        # toward base, never past it.
+        self.base_weight = float(weight)
         self.feed = FiberFeed(source.channels, ring_samples)
         self.windower = LiveWindower(self.feed, window,
                                      stride_time=stride_time,
@@ -142,6 +175,9 @@ class StreamTenant:
         self.quota = 1
         self.max_outstanding = 4
         self.deadline_s: Optional[float] = None
+        # The resident lane (ResidentFeed + fused executor) when the
+        # device-resident data plane is on; None = host path.
+        self.resident = None
         # Counters (under the loop lock).
         self.outstanding = 0
         self.submitted = 0
@@ -150,6 +186,9 @@ class StreamTenant:
         self.serve_refused = 0
         self.rejected = 0
         self.latencies: deque = deque(maxlen=100_000)
+        # Adaptive-weight interval marks (shed/submitted at last adapt).
+        self._adapt_shed0 = 0
+        self._adapt_sub0 = 0
 
     def p99_latency_s(self) -> float:
         if not self.latencies:
@@ -173,7 +212,10 @@ class StreamLoop:
                  metrics: Optional[StreamMetrics] = None,
                  alerts: Optional[AlertEngine] = None,
                  alerts_interval_s: float = 1.0,
-                 history: Optional[MetricsHistory] = None):
+                 history: Optional[MetricsHistory] = None,
+                 resident: str = "off",
+                 resident_max_windows: int = 0,
+                 adapt_weights: bool = False, adapt_every: int = 8):
         if not tenants:
             raise ValueError("a stream loop needs at least one tenant")
         if cycle_budget < len(tenants):
@@ -184,15 +226,42 @@ class StreamLoop:
         self.tenants = list(tenants)
         self.clock = clock
         self.max_wait_s = float(max_wait_s)
+        self.cycle_budget = int(cycle_budget)
+        self.outstanding_factor = max(1, int(outstanding_factor))
         self.metrics = metrics or StreamMetrics()
-        total_w = sum(t.weight for t in self.tenants)
-        for t in self.tenants:
-            t.quota = max(1, int(cycle_budget * t.weight / total_w))
-            t.max_outstanding = t.quota * max(1, int(outstanding_factor))
-            # Heavier tenants carry earlier deadlines into the serve
-            # queue's min-heap — the per-tenant deadline tag.
-            t.deadline_s = self.max_wait_s / t.weight
+        self.adapt_weights = bool(adapt_weights)
+        self.adapt_every = max(1, int(adapt_every))
+        self._apply_weights()
         self._lock = threading.Lock()
+        # Device-resident data plane (docs/STREAMING.md): when it
+        # engages, each tenant's host ring is replaced by an on-device
+        # ResidentFeed lane and its cycle submits ONE fused dispatch
+        # instead of per-window serve submissions.  The fairness gate is
+        # untouched — it runs on the same quota/outstanding budgets
+        # BEFORE the dispatch is formed.
+        self.resident_enabled = False
+        self._collector = None
+        self._lanes: list = []
+        if resident != "off":
+            from dasmtl.stream.resident import (ResidentCollector,
+                                                build_lanes,
+                                                resolve_resident_mode)
+
+            pool = getattr(serve, "executor", None)
+            if resolve_resident_mode(resident, pool, self.tenants):
+                self._lanes = build_lanes(
+                    pool, self.tenants,
+                    max_windows=resident_max_windows)
+                for t, lane in zip(self.tenants, self._lanes):
+                    t.resident = lane
+                    t.feed = lane.feed
+                    t.windower = LiveWindower(
+                        lane.feed, t.windower.window,
+                        stride_time=t.windower.stride_time,
+                        stride_channels=t.windower.stride_channels)
+                self._collector = ResidentCollector(
+                    self._on_resident_batch)
+                self.resident_enabled = True
         self._events: deque = deque(maxlen=int(events_ring))
         self._events_f = open(events_path, "a", encoding="utf-8") \
             if events_path else None
@@ -207,6 +276,46 @@ class StreamLoop:
         self.alerts_interval_s = float(alerts_interval_s)
         self.history = history
 
+    def _apply_weights(self) -> None:
+        """Quota / outstanding budget / deadline from the CURRENT
+        weights — the one place the fairness shares turn into budgets
+        (recomputed by adaptive weighting; callers hold the loop lock
+        once concurrency exists)."""
+        total_w = sum(t.weight for t in self.tenants)
+        for t in self.tenants:
+            t.quota = max(1, int(self.cycle_budget * t.weight / total_w))
+            t.max_outstanding = t.quota * self.outstanding_factor
+            # Heavier tenants carry earlier deadlines into the serve
+            # queue's min-heap — the per-tenant deadline tag.
+            t.deadline_s = self.max_wait_s / t.weight
+
+    def _adapt_weights(self) -> None:
+        """Shed-rate feedback into the fairness shares: a tenant whose
+        last interval shed backs off multiplicatively (it is offering
+        more than its share can clear); a clean interval recovers
+        additively toward — never past — the configured base weight.
+        Neighbors that never shed keep their full share."""
+        with self._lock:
+            changed = False
+            for t in self.tenants:
+                d_shed = t.shed - t._adapt_shed0
+                d_sub = t.submitted - t._adapt_sub0
+                t._adapt_shed0, t._adapt_sub0 = t.shed, t.submitted
+                if d_shed + d_sub == 0:
+                    continue  # idle interval: no evidence either way
+                if d_shed > 0:
+                    t.weight = max(
+                        ADAPT_MIN_WEIGHT_FRACTION * t.base_weight,
+                        t.weight * ADAPT_DECREASE)
+                    changed = True
+                elif t.weight < t.base_weight:
+                    t.weight = min(t.base_weight,
+                                   t.weight
+                                   + ADAPT_RECOVER * t.base_weight)
+                    changed = True
+            if changed:
+                self._apply_weights()
+
     # -- steady state --------------------------------------------------------
     def run_cycle(self, now: Optional[float] = None) -> dict:
         """One pump iteration over every tenant: poll the source, cut
@@ -217,6 +326,11 @@ class StreamLoop:
             chunk = t.source.poll(t.chunk_samples)
             if chunk is not None and chunk.size:
                 t.feed.append(chunk, now=now)
+            if t.resident is not None:
+                s, sh = self._pump_resident(t)
+                submitted += s
+                shed += sh
+                continue
             sent_this_cycle = 0
             for wdw in t.windower.cut():
                 with self._lock:
@@ -240,9 +354,114 @@ class StreamLoop:
                 fut.add_done_callback(
                     lambda f, t=t, wdw=wdw: self._on_result(t, wdw, f))
         self.cycles += 1
+        if self.adapt_weights and self.cycles % self.adapt_every == 0:
+            self._adapt_weights()
         if self.alerts is not None:
             self.alerts.maybe_evaluate(now, self.alerts_interval_s)
         return {"submitted": submitted, "shed": shed}
+
+    def _pump_resident(self, t: StreamTenant) -> "tuple[int, int]":
+        """The resident cycle for one tenant: cut window METADATA only
+        (samples stay on device), run the identical fairness gate, then
+        book the admitted set as ONE fused dispatch (chunked by the
+        lane's top rung when the quota outgrows it).  The collector
+        thread resolves it — the pump never blocks on D2H."""
+        admitted, shed = [], 0
+        for wdw in t.windower.cut(pixels=False):
+            with self._lock:
+                over = (len(admitted) >= t.quota
+                        or t.outstanding >= t.max_outstanding)
+                if over:
+                    t.shed += 1
+                else:
+                    t.outstanding += 1
+                    t.submitted += 1
+            if over:
+                self.metrics.shed.inc(labels=(t.name,))
+                shed += 1
+                continue
+            self.metrics.windows.inc(labels=(t.name,))
+            admitted.append(wdw)
+        lane = t.resident
+        for i in range(0, len(admitted), lane.max_rung):
+            group = admitted[i:i + lane.max_rung]
+            self._collector.submit(t, group,
+                                   lane.dispatch_windows(group))
+        return len(admitted), shed
+
+    def _on_resident_batch(self, tenant: StreamTenant, windows,
+                           preds, bad, prob) -> None:
+        """Resolve one fused dispatch (collector thread) — the resident
+        twin of ``_on_result``, per window: same counters, same
+        WindowDecode -> TrackBook flow, ``bad_rows`` standing in for the
+        serve tier's per-request ``nonfinite`` error and the fixed-point
+        ``event_prob_q`` for the host path's log-prob-derived
+        confidence.  ``preds is None`` marks a dropped dispatch."""
+        now = self.clock()
+        emitted: List[dict] = []
+        with self._lock:
+            for j, wdw in enumerate(windows):
+                tenant.outstanding -= 1
+                tenant.resolved += 1
+                if preds is None:
+                    tenant.serve_refused += 1
+                    self.metrics.serve_refusals.inc(
+                        labels=(tenant.name,))
+                    continue
+                ok = not bool(bad[j])
+                if not ok:
+                    tenant.rejected += 1
+                    self.metrics.rejected.inc(labels=(tenant.name,))
+                event = (int(preds["event"][j])
+                         if ok and "event" in preds else -1)
+                distance = (int(preds["distance"][j])
+                            if ok and "distance" in preds else -1)
+                d = WindowDecode(t_origin=wdw.t_origin, t_end=wdw.t_end,
+                                 ok=ok, event=event, distance=distance,
+                                 event_prob=float(prob[j]) if ok else 0.0)
+                records = tenant.book.update(wdw.tile, d, now)
+                lat = max(0.0, now - wdw.arrival_s)
+                tenant.latencies.append(lat)
+                self.metrics.latency.observe(lat, (tenant.name,))
+                self._publish_records(tenant, records)
+                emitted.extend(records)
+        self._emit_alert_records(emitted)
+
+    def _publish_records(self, tenant: StreamTenant, records) -> None:
+        """Track records -> metrics + event ring + JSONL (caller holds
+        the loop lock)."""
+        for rec in records:
+            if rec["kind"] == "open":
+                self.metrics.track_opens.inc(labels=(tenant.name,))
+            elif rec["kind"] == "close":
+                self.metrics.track_closes.inc(labels=(tenant.name,))
+            self._events.append(rec)
+            if self._events_f is not None:
+                self._events_f.write(json.dumps(rec) + "\n")
+        if records and self._events_f is not None:
+            self._events_f.flush()
+
+    def _emit_alert_records(self, records) -> None:
+        """Track records -> alert events, OUTSIDE the loop lock: sink
+        I/O (webhook POSTs) must never stall the pump.  Records are
+        already debounced by the TrackFuser hysteresis; the dedupe key
+        makes a replayed record deliver exactly once."""
+        if self.alerts is None:
+            return
+        for rec in records:
+            if rec["kind"] not in ("open", "close"):
+                continue
+            self.alerts.emit_event(
+                f"stream_track_{rec['kind']}",
+                labels={"fiber": rec["fiber"],
+                        "type": rec["event_name"]},
+                value=rec["confidence"],
+                severity="page" if rec["kind"] == "open" else "info",
+                dedupe_key=f"{rec['fiber']}:{rec['track_id']}:"
+                           f"{rec['kind']}",
+                description=f"track {rec['track_id']} "
+                            f"{rec['kind']} at fiber_pos "
+                            f"{rec['fiber_pos']}")
 
     def _on_result(self, tenant: StreamTenant, wdw, fut) -> None:
         now = self.clock()
@@ -277,35 +496,8 @@ class StreamLoop:
             lat = max(0.0, now - wdw.arrival_s)
             tenant.latencies.append(lat)
             self.metrics.latency.observe(lat, (tenant.name,))
-            for rec in records:
-                if rec["kind"] == "open":
-                    self.metrics.track_opens.inc(labels=(tenant.name,))
-                elif rec["kind"] == "close":
-                    self.metrics.track_closes.inc(labels=(tenant.name,))
-                self._events.append(rec)
-                if self._events_f is not None:
-                    self._events_f.write(json.dumps(rec) + "\n")
-            if records and self._events_f is not None:
-                self._events_f.flush()
-        # Outside the loop lock: sink I/O (webhook POSTs) must never
-        # stall the pump.  Records are already debounced by the
-        # TrackFuser hysteresis; the dedupe key makes a replayed record
-        # deliver exactly once.
-        if self.alerts is not None:
-            for rec in records:
-                if rec["kind"] not in ("open", "close"):
-                    continue
-                self.alerts.emit_event(
-                    f"stream_track_{rec['kind']}",
-                    labels={"fiber": rec["fiber"],
-                            "type": rec["event_name"]},
-                    value=rec["confidence"],
-                    severity="page" if rec["kind"] == "open" else "info",
-                    dedupe_key=f"{rec['fiber']}:{rec['track_id']}:"
-                               f"{rec['kind']}",
-                    description=f"track {rec['track_id']} "
-                                f"{rec['kind']} at fiber_pos "
-                                f"{rec['fiber_pos']}")
+            self._publish_records(tenant, records)
+        self._emit_alert_records(records)
 
     # -- pump thread ---------------------------------------------------------
     def start(self, poll_s: float = 0.002) -> "StreamLoop":
@@ -336,6 +528,14 @@ class StreamLoop:
 
     def close(self) -> None:
         self.begin_drain()
+        if self._collector is not None:
+            # The sentinel queues BEHIND any in-flight dispatches, so
+            # close() still resolves everything already booked.
+            self._collector.close()
+            self._collector = None
+        for lane in self._lanes:
+            lane.close()
+        self._lanes = []
         if self._events_f is not None:
             self._events_f.close()
             self._events_f = None
@@ -359,6 +559,7 @@ class StreamLoop:
             tenants = {
                 t.name: {
                     "weight": t.weight,
+                    "base_weight": t.base_weight,
                     "quota": t.quota,
                     "max_outstanding": t.max_outstanding,
                     "submitted": t.submitted,
@@ -373,8 +574,19 @@ class StreamLoop:
                     "track_opens": t.book.opens,
                     "track_closes": t.book.closes,
                     "p99_latency_ms": round(t.p99_latency_s() * 1e3, 3),
+                    **({"resident": {
+                        "device": t.resident.executor.device_name,
+                        "rungs": list(t.resident.executor.rungs),
+                        "windows_dispatched": t.resident.windows_dispatched,
+                        "dispatches": t.resident.dispatches,
+                        "h2d_bytes": t.resident.feed.h2d_bytes,
+                        "h2d_chunks": t.resident.feed.h2d_chunks,
+                        "post_warmup_compiles":
+                            t.resident.post_warmup_compiles,
+                    }} if t.resident is not None else {}),
                 } for t in self.tenants}
-        out = {"cycles": self.cycles, "tenants": tenants,
+        out = {"cycles": self.cycles, "resident": self.resident_enabled,
+               "tenants": tenants,
                "events_held": len(self._events)}
         if self.alerts is not None:
             out["alerts"] = self.alerts.stats()
@@ -394,6 +606,17 @@ class StreamLoop:
                     (t.name,))
                 self.metrics.overrun.set_total(
                     t.windower.overrun_windows, (t.name,))
+                if t.resident is not None:
+                    lane = t.resident
+                    self.metrics.resident_h2d_bytes.set_total(
+                        lane.feed.h2d_bytes, (t.name,))
+                    self.metrics.resident_windows.set_total(
+                        lane.windows_dispatched, (t.name,))
+                    self.metrics.resident_dispatches.set_total(
+                        lane.dispatches, (t.name,))
+                    self.metrics.resident_ring_occupancy.set(
+                        min(lane.feed.total, lane.feed.ring_samples)
+                        / lane.feed.ring_samples, (t.name,))
         return self.serve.metrics_text() + self.metrics.registry.render()
 
 
@@ -540,6 +763,23 @@ def serve_main(argv=None) -> int:
     st.add_argument("--cycle_budget", type=int, default=d.stream_cycle_budget,
                     help="total windows all tenants may submit per pump "
                          "cycle, split by weight (the fairness gate)")
+    st.add_argument("--resident", type=str, default=d.stream_resident,
+                    choices=["auto", "on", "off"],
+                    help="device-resident data plane: on-device fiber "
+                         "rings + one fused slice+forward+decode dispatch "
+                         "per fiber per cycle (auto = accelerator backend "
+                         "with rings fitting device memory; needs a "
+                         "checkpoint forward, not --exported)")
+    st.add_argument("--resident_max_windows", type=int,
+                    default=d.stream_resident_max_windows,
+                    help="cap of the windows-per-dispatch rung ladder "
+                         "(0 = the tenant's fairness quota)")
+    st.add_argument("--adapt_weights",
+                    action=argparse.BooleanOptionalAction,
+                    default=d.stream_adapt_weights,
+                    help="feed each fiber's recent shed rate back into "
+                         "its fairness weight (bounded multiplicative "
+                         "decrease, additive recovery to base)")
     st.add_argument("--open_windows", type=int, default=d.stream_open_windows)
     st.add_argument("--close_windows", type=int,
                     default=d.stream_close_windows)
@@ -598,6 +838,11 @@ def serve_main(argv=None) -> int:
                    help="executor-pool size for the selftest (use "
                         "XLA_FLAGS=--xla_force_host_platform_device_"
                         "count=N for N virtual CPU devices)")
+    p.add_argument("--selftest_resident",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="run the selftest on the device-resident data "
+                        "plane (forces resident='on'; the CI stream "
+                        "job's second leg)")
     args = p.parse_args(argv)
 
     from dasmtl.utils.platform import apply_device
@@ -611,7 +856,8 @@ def serve_main(argv=None) -> int:
         report = run_selftest(fibers=args.selftest_fibers,
                               cycles=args.selftest_cycles,
                               devices=args.selftest_devices,
-                              inflight=args.inflight)
+                              inflight=args.inflight,
+                              resident=args.selftest_resident)
         write_stream_job_summary(report)
         return 0 if report["passed"] else 1
 
@@ -715,7 +961,10 @@ def serve_main(argv=None) -> int:
                         events_ring=args.events_ring,
                         alerts=engine,
                         alerts_interval_s=args.alerts_interval_s,
-                        history=history)
+                        history=history,
+                        resident=args.resident,
+                        resident_max_windows=args.resident_max_windows,
+                        adapt_weights=args.adapt_weights)
     if engine is not None:
         engine.add_exposition(stream.metrics_text)
     sampler = None

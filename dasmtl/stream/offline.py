@@ -25,6 +25,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from dasmtl.stream.resident import collect_host
+
 EVENT_NAMES = ("striking", "excavating")
 
 
@@ -156,7 +158,7 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
         def forward_artifact(x):
             out = artifact_call(x)
             if sanitize:
-                bad = np.asarray(jax.device_get(row_mask(
+                bad = np.asarray(collect_host(row_mask(
                     {k: v for k, v in out.items()
                      if k.startswith("log_probs_")})))
                 if bad.any():
@@ -225,7 +227,7 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
         if not sanitize:
             return out
         preds, flag = out
-        if bool(jax.device_get(flag)):
+        if bool(collect_host(flag)):
             from dasmtl.analysis.sanitize.common import NonFiniteError
 
             idx = [int(i) for i in batch["index"] if int(i) >= 0]
@@ -240,16 +242,18 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
         # The record is a jit ARGUMENT (not a closed-over constant): the
         # compiled program keys on shape/dtype, so streaming many same-shape
         # records reuses one executable and the record isn't duplicated into
-        # the HLO as a literal.
-        h, w = plan.window
+        # the HLO as a literal.  The in-graph gather is the SHARED fused
+        # builder (dasmtl.export.make_resident_forward) — the same program
+        # structure the live tier's resident lanes dispatch, so offline and
+        # live stay int-exact twins by construction.
+        from dasmtl.export import make_resident_forward
 
-        @jax.jit
-        def forward_resident(rec, origin):
-            def slice_one(o):
-                return jax.lax.dynamic_slice(rec, (o[0], o[1]), (h, w))
-            xs = jax.vmap(slice_one)(origin)[..., None]
+        def body(xs):
             return decode_checked(state.apply_fn(variables, xs,
                                                  train=False))
+
+        forward_resident = jax.jit(
+            make_resident_forward(body, plan.window))
 
         record_dev = jax.device_put(
             np.asarray(record, np.float32),
@@ -295,7 +299,9 @@ def _emit(spec, plan, batches, run, out_csv,
 
     rows = []
     for batch in batches:
-        preds = {k: np.asarray(v) for k, v in run(batch).items()}
+        # One pull per batch through the stream tier's designated sync.
+        preds = {k: np.asarray(v)
+                 for k, v in collect_host(run(batch)).items()}
         for j, idx in enumerate(batch["index"]):
             if idx < 0:  # batch padding slot
                 continue

@@ -1,0 +1,487 @@
+"""Device-resident live data plane: on-device fiber rings, fused windows.
+
+The host live path (:mod:`dasmtl.stream.live`) cuts every sliding window
+on host and ships it as its own H2D + serve submission — with
+overlapping strides each raw sample is re-uploaded ``window/stride``
+times per tile.  This module moves the steady state onto the device:
+
+- :class:`ResidentFeed` — one on-device ring per (fiber, device).  Each
+  incoming chunk crosses H2D ONCE and lands in the ring via a donated
+  in-graph update (``jnp.roll`` + ``dynamic_update_slice``), so the ring
+  stays *sliding-contiguous*: absolute sample ``t`` always lives at
+  column ``ring_samples - (total - t)``, every retained window is a
+  contiguous slice, and the fused gather below needs no seam handling.
+  Host-side bookkeeping mirrors :class:`~dasmtl.stream.feed.FiberFeed`
+  exactly — same ``total``/``oldest`` absolute addressing, same
+  ``IndexError`` overrun/underrun contract.
+- :class:`ResidentExecutor` — the fused multi-window program
+  (:func:`dasmtl.export.make_resident_serve_fn`: ``slice_windows +
+  forward + decode`` in ONE jitted dispatch) over a power-of-two
+  *windows-per-dispatch* ladder, compiled rung by rung at warmup under a
+  :class:`~dasmtl.analysis.guards.StepGuards` counter — the serve bucket
+  discipline, applied to window counts: 0 post-warmup recompiles per
+  (rung, device).
+- :class:`ResidentCollector` — the cycle collector thread.  Its pull of
+  the decoded int predictions + ``bad_rows`` bools (+ the quantized
+  ``event_prob_q`` ints) is the stream package's ONE designated
+  device->host sync (:func:`collect_host`), the same role
+  ``InferExecutor.collect`` plays for ``dasmtl/serve/`` under lint rule
+  DAS111.
+
+A cycle then runs as ONE dispatch per fiber instead of N per-window
+serve submissions; fairness/shed accounting stays in
+:class:`~dasmtl.stream.live.StreamLoop` (the gate runs BEFORE the
+dispatch, on the same per-tenant quota/outstanding budgets).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dasmtl.export import PROB_Q_SCALE, make_resident_serve_fn
+
+
+def collect_host(outputs):
+    """THE designated device->host sync of the stream package: one
+    blocking pull of a dispatch's small decoded outputs (int predictions,
+    ``bad_rows`` bools, fixed-point confidences — log-prob heads only
+    when a parity check asks).  Every other host sync under
+    ``dasmtl/stream/`` is a DAS111 lint error, exactly like the serve
+    package's ``InferExecutor.collect`` discipline."""
+    import jax
+
+    return jax.device_get(outputs)  # dasmtl: noqa[DAS111] — the stream tier's one legal sync (cycle collector)
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= ``n`` (>= 1)."""
+    p = 1
+    while p < max(1, int(n)):
+        p <<= 1
+    return p
+
+
+def rung_ladder(max_windows: int) -> Tuple[int, ...]:
+    """The windows-per-dispatch ladder: every power of two up to
+    ``next_pow2(max_windows)`` — one compiled program per rung, all
+    warmed up front (the serve bucket ladder, for window counts)."""
+    if int(max_windows) < 1:
+        raise ValueError("the dispatch ladder needs >= 1 window")
+    top = next_pow2(max_windows)
+    out, p = [], 1
+    while p <= top:
+        out.append(p)
+        p <<= 1
+    return tuple(out)
+
+
+class ResidentFeed:
+    """On-device ring buffer over one fiber, FiberFeed-addressed.
+
+    The device array keeps the newest ``ring_samples`` samples
+    *sliding-contiguous*: after every append, column ``j`` holds absolute
+    sample ``total - ring_samples + j`` (zeros left of the first real
+    sample).  The donated append program rolls the ring left by one chunk
+    and writes the new chunk at the right edge — one H2D per CHUNK, one
+    compiled program, buffers donated in place.
+
+    Chunks are staged host-side to ``chunk_samples`` granularity (ragged
+    source polls accumulate until a full chunk exists), so the update
+    program has ONE static shape and the unbounded stream rides zero
+    post-warmup recompiles.  ``total`` counts device-resident samples;
+    the staged remainder is ``pending``.
+    """
+
+    def __init__(self, channels: int, ring_samples: int, *,
+                 chunk_samples: int, device=None, dtype=np.float32):
+        import jax
+        import jax.numpy as jnp
+
+        if channels < 1 or ring_samples < 1:
+            raise ValueError(f"channels {channels} and ring_samples "
+                             f"{ring_samples} must be >= 1")
+        chunk_samples = int(chunk_samples)
+        if not 1 <= chunk_samples <= int(ring_samples):
+            raise ValueError(f"chunk_samples {chunk_samples} must be in "
+                             f"[1, ring_samples={ring_samples}]")
+        self.channels = int(channels)
+        self.ring_samples = int(ring_samples)
+        self.chunk_samples = chunk_samples
+        self.dtype = np.dtype(dtype)
+        self.device = device
+        self.total = 0
+        self.h2d_bytes = 0
+        self.h2d_chunks = 0
+        self._pending = np.zeros((self.channels, 0), self.dtype)
+        self._arrivals: list = []  # (total_after_append, clock) pairs
+        w_c = self.chunk_samples
+
+        def _append(ring, chunk):
+            ring = jnp.roll(ring, -w_c, axis=1)
+            return jax.lax.dynamic_update_slice(
+                ring, chunk, (0, ring.shape[1] - w_c))
+
+        self._append_fn = jax.jit(_append, donate_argnums=0)
+        self.ring = jax.device_put(
+            np.zeros((self.channels, self.ring_samples), self.dtype),
+            device)
+
+    @property
+    def oldest(self) -> int:
+        """First absolute sample index still retained on device."""
+        return max(0, self.total - self.ring_samples)
+
+    @property
+    def pending(self) -> int:
+        """Host-staged samples not yet a full device chunk."""
+        return self._pending.shape[1]
+
+    def warmup(self) -> None:
+        """Compile the donated ring-update program on zeros, then restore
+        the empty ring — post-warmup appends must never compile."""
+        import jax
+
+        z = jax.device_put(
+            np.zeros((self.channels, self.chunk_samples), self.dtype),
+            self.device)
+        self.ring = self._append_fn(self.ring, z)
+        self.ring = jax.device_put(
+            np.zeros((self.channels, self.ring_samples), self.dtype),
+            self.device)
+
+    def slot(self, t0: int) -> int:
+        """Ring column of absolute sample ``t0`` (sliding-contiguous
+        layout: the newest sample sits at the right edge)."""
+        return self.ring_samples - (self.total - int(t0))
+
+    def check_window(self, t0: int, n: int) -> None:
+        """The FiberFeed absolute-addressing contract, for in-graph
+        reads: raise before dispatching a gather that would touch
+        overwritten or not-yet-appended samples."""
+        t0 = int(t0)
+        if t0 < self.oldest:
+            raise IndexError(f"samples from {t0} overwritten — ring "
+                             f"retains [{self.oldest}, {self.total})")
+        if t0 + int(n) > self.total:
+            raise IndexError(f"samples to {t0 + int(n)} not yet appended "
+                             f"(total {self.total})")
+
+    def append(self, chunk: np.ndarray, now: float = 0.0) -> int:
+        """Stage ``(channels, n_new)`` samples and flush every full
+        ``chunk_samples`` piece to the device — one H2D per flushed
+        chunk.  Returns ``n_new``."""
+        import jax
+
+        chunk = np.asarray(chunk)
+        if chunk.ndim != 2 or chunk.shape[0] != self.channels:
+            raise ValueError(f"chunk shape {chunk.shape} != "
+                             f"({self.channels}, n_new)")
+        n = chunk.shape[1]
+        if n == 0:
+            return 0
+        self._pending = np.concatenate(
+            [self._pending, chunk.astype(self.dtype, copy=False)], axis=1)
+        w_c = self.chunk_samples
+        while self._pending.shape[1] >= w_c:
+            piece = np.ascontiguousarray(self._pending[:, :w_c])
+            self._pending = self._pending[:, w_c:]
+            dev = jax.device_put(piece, self.device)
+            self.ring = self._append_fn(self.ring, dev)
+            self.total += w_c
+            self.h2d_bytes += piece.nbytes
+            self.h2d_chunks += 1
+            self._arrivals.append((self.total, now))
+        while (len(self._arrivals) > 1
+               and self._arrivals[1][0] <= self.oldest):
+            self._arrivals.pop(0)
+        return n
+
+    def arrival_time(self, sample: int) -> float:
+        """Clock reading of the append that first covered ``sample``
+        (0.0 if unknown) — the FiberFeed contract."""
+        for covered, now in self._arrivals:
+            if covered > sample:
+                return now
+        return self._arrivals[-1][1] if self._arrivals else 0.0
+
+    def view(self, t0: int, n: int) -> np.ndarray:
+        """Host copy of absolute samples ``[t0, t0 + n)`` — a debug /
+        parity helper (one full-ring D2H through the designated sync),
+        NEVER the steady state; the live path gathers in-graph."""
+        self.check_window(t0, n)
+        host = np.asarray(collect_host(self.ring))
+        s = self.slot(t0)
+        return host[:, s:s + int(n)].copy()
+
+
+@dataclasses.dataclass
+class ResidentBatch:
+    """One fused dispatch in flight: device output buffers + routing."""
+
+    outputs: Dict[str, Any]
+    k: int          # real windows (<= rung; the tail rows are padding)
+    rung: int
+    executor: "ResidentExecutor"
+
+
+class ResidentExecutor:
+    """The fused slice+forward+decode program over a rung ladder, on one
+    placement — the resident twin of :class:`~dasmtl.serve.executor.
+    InferExecutor`'s bucket discipline: every (rung, device) compiles at
+    warmup, dispatch after that must never compile."""
+
+    def __init__(self, infer_fn: Callable, window: Tuple[int, int],
+                 max_windows: int, *, device=None, name: str = "lane",
+                 strict_recompile: bool = True):
+        import jax
+
+        from dasmtl.analysis.guards import StepGuards
+
+        self.window = (int(window[0]), int(window[1]))
+        self.rungs = rung_ladder(max_windows)
+        self.max_rung = self.rungs[-1]
+        self.device = device
+        self.name = name
+        self._fn = jax.jit(make_resident_serve_fn(infer_fn, self.window))
+        self._warm = False
+        self.warmup_compiles = 0
+        # Warmup legitimately compiles once per rung; transfer="off":
+        # the origin array is a declared per-dispatch H2D input.
+        self._guards = StepGuards(warmup_steps=len(self.rungs),
+                                  transfer="off",
+                                  recompile_check=strict_recompile)
+        self._guards.__enter__()
+
+    @property
+    def device_name(self) -> str:
+        return str(self.device) if self.device is not None else "default"
+
+    def warmup(self, ring) -> None:
+        """Compile every rung against the (already device-resident)
+        ring; blocks on each so post-warmup dispatches never compile."""
+        before = self._guards.compiles
+        for rung in self.rungs:
+            origins = np.zeros((rung, 2), np.int32)
+            with self._guards.step():
+                out = self._fn(ring, origins)
+            collect_host({k: v for k, v in out.items()
+                          if not k.startswith("log_probs_")})
+        self._warm = True
+        self.warmup_compiles = self._guards.compiles - before
+
+    def dispatch(self, ring, origins: np.ndarray) -> ResidentBatch:
+        """ONE fused dispatch over ``k`` window origins, padded up to the
+        covering rung (pad rows repeat origin 0 — recomputed, discarded
+        at collect)."""
+        k = int(origins.shape[0])
+        if k < 1:
+            raise ValueError("a resident dispatch needs >= 1 window")
+        if k > self.max_rung:
+            raise ValueError(f"{k} windows exceed the top rung "
+                             f"{self.max_rung} — split the cycle")
+        rung = next(r for r in self.rungs if r >= k)
+        if rung != k:
+            pad = np.repeat(origins[:1], rung - k, axis=0)
+            origins = np.concatenate([origins, pad], axis=0)
+        with self._guards.step():
+            out = dict(self._fn(ring, np.asarray(origins, np.int32)))
+        return ResidentBatch(outputs=out, k=k, rung=rung, executor=self)
+
+    def collect(self, batch: ResidentBatch, want_log_probs: bool = False
+                ) -> Tuple[Dict[str, np.ndarray], np.ndarray, np.ndarray,
+                           Optional[Dict[str, np.ndarray]]]:
+        """Pull one dispatch's decode tail host-side through the
+        designated sync: int predictions + ``bad_rows`` bools + the
+        fixed-point confidence (floats only on explicit request)."""
+        pull = {k: v for k, v in batch.outputs.items()
+                if want_log_probs or not k.startswith("log_probs_")}
+        host = collect_host(pull)
+        k = batch.k
+        bad = np.asarray(host.pop("bad_rows"), bool)[:k]
+        prob_q = host.pop("event_prob_q", None)
+        prob = (np.asarray(prob_q[:k], np.float64) / PROB_Q_SCALE
+                if prob_q is not None else np.ones((k,), np.float64))
+        preds, log_probs = {}, ({} if want_log_probs else None)
+        for key, v in host.items():
+            if key.startswith("log_probs_"):
+                log_probs[key] = np.asarray(v)[:k]
+            else:
+                preds[key] = np.asarray(v)[:k]
+        return preds, bad, prob, log_probs
+
+    @property
+    def post_warmup_compiles(self) -> int:
+        return self._guards.post_warmup_compiles
+
+    def compile_summary(self) -> dict:
+        return {"rungs": list(self.rungs), "warm": self._warm,
+                "device": self.device_name,
+                "warmup_compiles": self.warmup_compiles,
+                **self._guards.summary()}
+
+    def close(self) -> None:
+        self._guards.__exit__(None, None, None)
+
+
+class ResidentLane:
+    """One (fiber, device) pairing: the on-device ring plus its fused
+    executor.  ``dispatch_windows`` turns a gated list of window metas
+    (:class:`~dasmtl.stream.windower.CutWindow`, pixel-free) into one
+    fused dispatch of their origins."""
+
+    def __init__(self, feed: ResidentFeed, executor: ResidentExecutor):
+        self.feed = feed
+        self.executor = executor
+        self.windows_dispatched = 0
+        self.dispatches = 0
+
+    @property
+    def max_rung(self) -> int:
+        return self.executor.max_rung
+
+    def warmup(self) -> None:
+        self.feed.warmup()
+        self.executor.warmup(self.feed.ring)
+
+    def dispatch_windows(self, windows: Sequence) -> ResidentBatch:
+        h, w = self.executor.window
+        # The FiberFeed addressing contract, enforced on the extremes of
+        # this dispatch (the windower cuts oldest-first, so checking the
+        # first and last origin covers the batch).
+        self.feed.check_window(windows[0].t_origin, w)
+        self.feed.check_window(windows[-1].t_origin, w)
+        origins = np.asarray(
+            [(wdw.c_origin, self.feed.slot(wdw.t_origin))
+             for wdw in windows], np.int32)
+        batch = self.executor.dispatch(self.feed.ring, origins)
+        self.windows_dispatched += len(windows)
+        self.dispatches += 1
+        return batch
+
+    @property
+    def post_warmup_compiles(self) -> int:
+        return self.executor.post_warmup_compiles
+
+    def close(self) -> None:
+        self.executor.close()
+
+
+class ResidentCollector:
+    """The cycle collector: one thread draining fused dispatches and
+    handing their host-side decodes to a callback
+    (``on_batch(tenant, windows, preds, bad, prob)``).  The pump thread
+    never blocks on D2H; this thread owns the package's single legal
+    sync (via :meth:`ResidentExecutor.collect`)."""
+
+    def __init__(self, on_batch: Callable):
+        self._on_batch = on_batch
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dasmtl-resident-collect")
+        self._thread.start()
+
+    def submit(self, tenant, windows: List, batch: ResidentBatch) -> None:
+        self._q.put((tenant, windows, batch))
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            tenant, windows, batch = item
+            try:
+                preds, bad, prob, _ = batch.executor.collect(batch)
+                self._on_batch(tenant, windows, preds, bad, prob)
+            except Exception:  # noqa: BLE001 — a dropped batch must not
+                # kill the collector; the loop's resolve path counts it.
+                self._on_batch(tenant, windows, None, None, None)
+
+    def close(self, timeout: float = 10.0) -> None:
+        self._q.put(None)
+        self._thread.join(timeout=timeout)
+
+
+# -- wiring the lanes to a tenant set ------------------------------------------
+
+def _pool_members(pool) -> list:
+    """ExecutorPool members, or the bare executor itself."""
+    return list(getattr(pool, "executors", None) or [pool])
+
+
+def pool_supports_resident(pool) -> bool:
+    """The fused program needs a jit-able forward: an exported StableHLO
+    artifact's computation is fixed (same restriction as the offline
+    ``resident='on'`` path), a checkpoint/oracle forward qualifies."""
+    return pool is not None and all(
+        getattr(e, "raw_infer_fn", None) is not None
+        for e in _pool_members(pool))
+
+
+def resident_rings_fit(tenants, budget_bytes: Optional[int] = None) -> bool:
+    """``auto`` engages only when every fiber's ring fits the device
+    memory budget (per device, fibers round-robin over the pool)."""
+    budget = budget_bytes if budget_bytes is not None else 1 << 30
+    need = sum(t.feed.channels * t.feed.ring_samples * 4
+               for t in tenants)
+    return need <= budget
+
+
+def resolve_resident_mode(mode: str, pool, tenants, *,
+                          budget_bytes: Optional[int] = None) -> bool:
+    """``on`` | ``off`` | ``auto`` -> engage?  ``auto`` mirrors the
+    offline convention (accelerator backends only — on plain CPU the
+    host path is usually as fast, docs/STREAMING.md) and additionally
+    requires the rings to fit the device budget; ``on`` raises when the
+    pool cannot support the fused path at all."""
+    import jax
+
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"unknown resident mode {mode!r}")
+    if mode == "off":
+        return False
+    supported = pool_supports_resident(pool)
+    if mode == "on":
+        if not supported:
+            raise ValueError(
+                "stream_resident='on' needs in-graph window slicing, "
+                "which a fixed exported computation cannot provide — "
+                "serve from a checkpoint, or run with resident off")
+        return True
+    return (supported and jax.default_backend() != "cpu"
+            and resident_rings_fit(tenants, budget_bytes))
+
+
+def build_lanes(pool, tenants, *, max_windows: int = 0,
+                strict_recompile: bool = True) -> List[ResidentLane]:
+    """One warmed :class:`ResidentLane` per tenant, fibers round-robin
+    over the pool's devices (:func:`dasmtl.parallel.mesh.
+    fiber_placements`).  ``max_windows`` caps the rung ladder (0 = the
+    tenant's per-cycle quota, the natural bound: the fairness gate admits
+    at most ``quota`` windows per cycle)."""
+    from dasmtl.parallel.mesh import fiber_placements
+
+    members = _pool_members(pool)
+    devices = [e.placement for e in members]
+    placements = fiber_placements(len(tenants), devices)
+    lanes = []
+    for t, (dev_i, device) in zip(tenants, placements):
+        ex = members[dev_i]
+        top = int(max_windows) or int(t.quota)
+        feed = ResidentFeed(t.feed.channels, t.feed.ring_samples,
+                            chunk_samples=t.chunk_samples,
+                            device=device, dtype=ex.input_dtype)
+        executor = ResidentExecutor(ex.raw_infer_fn,
+                                    pool.input_hw, top,
+                                    device=device,
+                                    name=f"{t.name}@{dev_i}",
+                                    strict_recompile=strict_recompile)
+        lane = ResidentLane(feed, executor)
+        lane.warmup()
+        lanes.append(lane)
+    return lanes

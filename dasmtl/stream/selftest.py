@@ -123,11 +123,16 @@ def _oracle_pool(input_hw: Tuple[int, int], buckets, devices: int):
 
 
 def run_selftest(*, fibers: int = 3, cycles: int = 140, devices: int = 1,
-                 inflight: int = 2, say=print) -> dict:
+                 inflight: int = 2, resident: bool = False,
+                 say=print) -> dict:
     """Run the soak and return a report dict (``passed``, ``failures``,
     per-tenant stats).  ``fibers >= 3``: fiber 0 and 1 carry the planted
     ground truth, the LAST fiber is overdriven (4x the chunk rate),
-    extras in between are plain background neighbors."""
+    extras in between are plain background neighbors.  ``resident``
+    runs the identical soak on the device-resident data plane
+    (on-device rings, one fused dispatch per fiber per cycle) — every
+    invariant above must hold unchanged, plus per-lane zero post-warmup
+    recompiles on the windows-per-dispatch ladder."""
     fibers = max(3, int(fibers))
     window = (64, 64)
     buckets = (1, 2, 4, 8)
@@ -219,7 +224,8 @@ def run_selftest(*, fibers: int = 3, cycles: int = 140, devices: int = 1,
     stream = StreamLoop(loop, tenants, cycle_budget=cycle_budget,
                         max_wait_s=0.002, events_path=events_path,
                         alerts=engine, alerts_interval_s=0.2,
-                        history=history)
+                        history=history,
+                        resident="on" if resident else "off")
     engine.add_exposition(stream.metrics_text)
 
     httpd = make_stream_http_server(stream, "127.0.0.1", 0)
@@ -383,6 +389,28 @@ def run_selftest(*, fibers: int = 3, cycles: int = 140, devices: int = 1,
                 f"device {p['placement']}: {p['post_warmup_compiles']} "
                 f"post-warmup recompile(s) — a stream shape escaped the "
                 f"warmed bucket ladder")
+    if resident:
+        for t in tenants:
+            lane = t.resident
+            if lane is None:
+                failures.append(f"{t.name}: resident='on' but the lane "
+                                f"never engaged")
+                continue
+            if lane.post_warmup_compiles:
+                failures.append(
+                    f"{t.name} lane ({lane.executor.device_name}): "
+                    f"{lane.post_warmup_compiles} post-warmup "
+                    f"recompile(s) — a window count escaped the warmed "
+                    f"rung ladder {list(lane.executor.rungs)}")
+            if lane.windows_dispatched != t.submitted:
+                failures.append(
+                    f"{t.name}: lane dispatched "
+                    f"{lane.windows_dispatched} window(s) for "
+                    f"{t.submitted} admitted — the fused path lost or "
+                    f"invented work")
+            if t.submitted and not lane.feed.h2d_bytes:
+                failures.append(f"{t.name}: resident lane ran without "
+                                f"any counted chunk H2D bytes")
 
     # -- 5. observability ----------------------------------------------------
     scrape_report = None
@@ -486,6 +514,7 @@ def run_selftest(*, fibers: int = 3, cycles: int = 140, devices: int = 1,
         "passed": not failures,
         "failures": failures,
         "fibers": fibers,
+        "resident": bool(resident),
         "cycles": cycles,
         "devices": len(per_device_compiles) or 1,
         "warmup_s": stats.get("warmup_s"),
@@ -539,7 +568,8 @@ def write_stream_job_summary(report: dict,
         return
     lines = [
         f"### stream soak ({report['fibers']} fibers, "
-        f"{report['devices']} device(s))",
+        f"{report['devices']} device(s)"
+        f"{', resident' if report.get('resident') else ''})",
         "",
         f"- passed: **{report['passed']}**",
         f"- warmup: **{report['warmup_s']:.2f}s**"

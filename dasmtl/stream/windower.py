@@ -39,7 +39,7 @@ class CutWindow:
     the feed clock reading when the window's last sample landed (the
     anchor of the sample->event latency histogram)."""
 
-    x: np.ndarray
+    x: Optional[np.ndarray]  # None on a meta-only cut (resident path)
     tile: int
     c_origin: int
     t_origin: int
@@ -85,9 +85,14 @@ class LiveWindower:
         return (self.feed.total - w - self._next_t) \
             // self.stride_time + 1
 
-    def cut(self, max_windows: Optional[int] = None) -> List[CutWindow]:
+    def cut(self, max_windows: Optional[int] = None, *,
+            pixels: bool = True) -> List[CutWindow]:
         """All currently cuttable windows (oldest first), tile-major
-        within each time row.  Bounded by ``max_windows`` when given."""
+        within each time row.  Bounded by ``max_windows`` when given.
+        ``pixels=False`` cuts metadata only (``x=None``) — the resident
+        path's cycle: windows stay on device and are gathered in-graph
+        from their ``(c_origin, t_origin)`` coordinates, so the host
+        never copies the samples at all."""
         h, w = self.window
         out: List[CutWindow] = []
         while self._next_t + w <= self.feed.total:
@@ -101,12 +106,14 @@ class LiveWindower:
                 self.overrun_windows += skipped * self.n_tiles
                 self._next_t += skipped * self.stride_time
                 continue
-            block = self.feed.view(self._next_t, w)  # (channels, w)
+            block = (self.feed.view(self._next_t, w)  # (channels, w)
+                     if pixels else None)
             arrival = self.feed.arrival_time(self._next_t + w - 1)
             for tile, c0 in enumerate(self.tile_origins):
                 out.append(CutWindow(
-                    x=np.ascontiguousarray(
-                        block[c0:c0 + h, :, None], dtype=np.float32),
+                    x=(np.ascontiguousarray(
+                        block[c0:c0 + h, :, None], dtype=np.float32)
+                       if pixels else None),
                     tile=tile, c_origin=c0, t_origin=self._next_t,
                     t_end=self._next_t + w, arrival_s=arrival))
             self.cut_windows += self.n_tiles

@@ -81,99 +81,174 @@ def latency(iters: int = 200) -> int:
     return 0
 
 
-def soak(cycles: int = 120, fibers: int = 3, devices: int = 1,
-         out: str = "BENCH_stream.json") -> int:
-    """Sustained-rate soak of the live tier at 1x and 2x offered load.
-
-    Geometry mirrors the stream selftest (64x64 windows, 3 tiles of a
-    160-channel fiber, stride 32, oracle detector through real
-    executors).  The fairness quota is sized to the 1x rate: the 2x leg
-    oversubscribes EVERY fiber, so its shed rate is the per-tenant gate
-    working as designed — windows/s per device stays the honest number
-    because shed windows never reach the serve plane."""
+def _soak_leg(name: str, *, load_x: int, cycles: int, fibers: int,
+              devices: int, resident: str, stride_time: int = 32,
+              quota_per_fiber: int = 8, base_chunk: int = 64) -> dict:
+    """One sustained-rate leg of the live tier: N fibers through the
+    oracle-backed serve plane on the requested data plane (``resident``
+    'off' = host per-window pixel staging, 'on' = on-device rings with
+    fused in-graph slicing).  Returns the leg dict including the measured
+    H2D bytes per window — the actual staged array bytes: per-chunk ring
+    appends on the resident path, per-window pixel blocks on the host
+    path."""
     import time as _time
 
-    import jax
     import numpy as np
 
     from dasmtl.serve.server import ServeLoop
     from dasmtl.stream.feed import SyntheticSource
     from dasmtl.stream.live import StreamLoop, StreamTenant
     from dasmtl.stream.selftest import _oracle_pool
+
+    window, buckets, channels = (64, 64), (1, 2, 4, 8), 160
+    pool = _oracle_pool(window, buckets, devices)
+    loop = ServeLoop(pool, buckets=buckets, max_wait_s=0.002,
+                     queue_depth=256, inflight=2)
+    loop.start()
+    tenants = [StreamTenant(f"f{i}",
+                            SyntheticSource(channels, seed=i),
+                            window=window, stride_time=stride_time,
+                            stride_channels=48, ring_samples=4096,
+                            chunk_samples=base_chunk * load_x)
+               for i in range(fibers)]
+    stream = StreamLoop(loop, tenants, cycle_budget=quota_per_fiber * fibers,
+                        max_wait_s=0.002, resident=resident)
+    t0 = _time.perf_counter()
+    for _ in range(cycles):
+        stream.run_cycle()
+        deadline = _time.monotonic() + 2.0
+        while (any(t.outstanding > 4 for t in tenants)
+               and _time.monotonic() < deadline):
+            _time.sleep(0.0005)
+    stream.drain(timeout=60.0)
+    elapsed = _time.perf_counter() - t0
+    loop.drain(timeout=60.0)
+    resolved = sum(t.resolved for t in tenants)
+    submitted = sum(t.submitted for t in tenants)
+    shed = sum(t.shed for t in tenants)
+    p99s = [t.p99_latency_s() * 1e3 for t in tenants]
+    h, w = window
+    if resident == "on":
+        h2d_bytes = sum(t.resident.feed.h2d_bytes for t in tenants)
+        recompiles = sum(t.resident.post_warmup_compiles for t in tenants)
+    else:
+        # Each admitted window ships its pixel block host->device once.
+        h2d_bytes = submitted * h * w * 4
+        recompiles = sum(e.post_warmup_compiles for e in pool.executors)
+    stream.close()
+    loop.close()
+    return {
+        "metric": f"stream_soak_windows_per_s_per_device_{name}",
+        "value": round(resolved / elapsed / devices, 2),
+        "unit": "windows/s/device",
+        "data_plane": "resident" if resident == "on" else "host",
+        "offered_load_x": load_x,
+        "stride_time": stride_time,
+        "windows_resolved": resolved,
+        "windows_shed": shed,
+        "shed_rate": round(shed / max(1, resolved + shed), 4),
+        "per_fiber_shed_rate": [
+            round(t.shed / max(1, t.submitted + t.shed), 4)
+            for t in tenants],
+        "p99_sample_to_event_ms": round(float(np.max(p99s)), 2),
+        "per_fiber_p99_ms": [round(p, 2) for p in p99s],
+        "elapsed_s": round(elapsed, 3),
+        "h2d_bytes_per_window": round(h2d_bytes / max(1, submitted), 1),
+        "post_warmup_recompiles": recompiles,
+    }
+
+
+def soak(cycles: int = 120, fibers: int = 3, devices: int = 1,
+         out: str = "BENCH_stream.json") -> int:
+    """Sustained-rate soak of the live tier: host vs resident A/B.
+
+    Geometry mirrors the stream selftest (64x64 windows, 3 tiles of a
+    160-channel fiber, oracle detector through real executors).  Four
+    stride-32 legs — 1x and 2x offered load on each data plane; the
+    fairness quota is sized to the 1x rate, so 2x oversubscribes EVERY
+    fiber and its shed rate is the per-tenant gate working as designed.
+    Two dense-overlap legs (stride 8, quota sized to the 8x window rate)
+    then isolate the H2D story: the host path re-uploads each pixel
+    stride-fold, the resident path ships each sample ONCE per chunk, so
+    bytes/window must drop >= 5x.  The throughput gate (resident >= 2x
+    host windows/s/device at equal shed) arms only on a multi-core host
+    with >= 2 pool devices — on one CPU core the fused program and the
+    host forward contend for the same cycles and the honest resident win
+    is the transfer reduction, not wall clock (docs/STREAMING.md)."""
+    import jax
+
     from dasmtl.utils.platform import normalize_backend
 
     backend = normalize_backend(jax.default_backend())
-    window, buckets, channels = (64, 64), (1, 2, 4, 8), 160
-    base_chunk = 64  # 2 window rows x 3 tiles = 6 windows/fiber/cycle
     report = {"backend": backend, "devices": devices, "fibers": fibers,
               "cycles": cycles, "window": "64x64", "tiles": 3,
               "legs": {}}
-    for load_x in (1, 2):
-        pool = _oracle_pool(window, buckets, devices)
-        loop = ServeLoop(pool, buckets=buckets, max_wait_s=0.002,
-                         queue_depth=256, inflight=2)
-        loop.start()
-        tenants = [StreamTenant(f"f{i}",
-                                SyntheticSource(channels, seed=i),
-                                window=window, stride_time=32,
-                                stride_channels=48, ring_samples=4096,
-                                chunk_samples=base_chunk * load_x)
-                   for i in range(fibers)]
-        # Quota sized to the 1x rate: 8 submit slots per fiber per cycle
-        # against 6 offered at 1x (headroom, shed 0) and 12 at 2x
-        # (oversubscribed, each fiber sheds its own excess).
-        stream = StreamLoop(loop, tenants, cycle_budget=8 * fibers,
-                            max_wait_s=0.002)
-        t0 = _time.perf_counter()
-        for _ in range(cycles):
-            stream.run_cycle()
-            deadline = _time.monotonic() + 2.0
-            while (any(t.outstanding > 4 for t in tenants)
-                   and _time.monotonic() < deadline):
-                _time.sleep(0.0005)
-        stream.drain(timeout=60.0)
-        elapsed = _time.perf_counter() - t0
-        loop.drain(timeout=60.0)
-        stream.close()
-        loop.close()
-        resolved = sum(t.resolved for t in tenants)
-        shed = sum(t.shed for t in tenants)
-        p99s = [t.p99_latency_s() * 1e3 for t in tenants]
-        leg = {
-            "metric": f"stream_soak_windows_per_s_per_device_x{load_x}",
-            "value": round(resolved / elapsed / devices, 2),
-            "unit": "windows/s/device",
-            "offered_load_x": load_x,
-            "windows_resolved": resolved,
-            "windows_shed": shed,
-            "shed_rate": round(shed / max(1, resolved + shed), 4),
-            "per_fiber_shed_rate": [
-                round(t.shed / max(1, t.submitted + t.shed), 4)
-                for t in tenants],
-            "p99_sample_to_event_ms": round(float(np.max(p99s)), 2),
-            "per_fiber_p99_ms": [round(p, 2) for p in p99s],
-            "elapsed_s": round(elapsed, 3),
-            "post_warmup_recompiles": sum(
-                e.post_warmup_compiles for e in pool.executors),
-        }
-        report["legs"][f"x{load_x}"] = leg
+    legs = [
+        # name, load_x, resident, stride, quota/fiber, cycles
+        ("x1", 1, "off", 32, 8, cycles),
+        ("x2", 2, "off", 32, 8, cycles),
+        ("resident_x1", 1, "on", 32, 8, cycles),
+        ("resident_x2", 2, "on", 32, 8, cycles),
+        # Dense overlap: 64-sample chunks at stride 8 = 24 windows per
+        # fiber-cycle; quota 32 keeps headroom (shed 0 on both planes).
+        ("dense_host", 1, "off", 8, 32, max(20, cycles // 2)),
+        ("dense_resident", 1, "on", 8, 32, max(20, cycles // 2)),
+    ]
+    for name, load_x, resident, stride, quota, n_cycles in legs:
+        leg = _soak_leg(name, load_x=load_x, cycles=n_cycles,
+                        fibers=fibers, devices=devices, resident=resident,
+                        stride_time=stride, quota_per_fiber=quota)
+        report["legs"][name] = leg
         print(json.dumps(leg))
-        print(f"soak x{load_x}: {leg['value']:,.0f} windows/s/device, "
-              f"shed rate {leg['shed_rate']:.1%}, p99 "
+        print(f"soak {name}: {leg['value']:,.0f} windows/s/device, "
+              f"shed rate {leg['shed_rate']:.1%}, "
+              f"{leg['h2d_bytes_per_window']:,.0f} H2D B/window, p99 "
               f"{leg['p99_sample_to_event_ms']:.0f}ms", file=sys.stderr)
+
     rc = 0
-    if report["legs"]["x1"]["windows_shed"]:
-        print("FAIL: 1x load shed windows — quota headroom gone",
-              file=sys.stderr)
-        rc = 1
-    if not report["legs"]["x2"]["windows_shed"]:
-        print("FAIL: 2x load never shed — the gate is not engaging",
-              file=sys.stderr)
-        rc = 1
+    for name in ("x1", "resident_x1", "dense_host", "dense_resident"):
+        if report["legs"][name]["windows_shed"]:
+            print(f"FAIL: {name} shed windows — quota headroom gone",
+                  file=sys.stderr)
+            rc = 1
+    for name in ("x2", "resident_x2"):
+        if not report["legs"][name]["windows_shed"]:
+            print(f"FAIL: {name} never shed — the gate is not engaging",
+                  file=sys.stderr)
+            rc = 1
     if any(leg["post_warmup_recompiles"]
            for leg in report["legs"].values()):
         print("FAIL: post-warmup recompile during soak", file=sys.stderr)
         rc = 1
+
+    # A/B verdicts: the transfer reduction gates everywhere; the
+    # throughput gate arms only where the fused program has cores and
+    # devices to win on (a 1-core host time-slices both planes).
+    h2d_ratio = (report["legs"]["dense_host"]["h2d_bytes_per_window"]
+                 / max(1e-9, report["legs"]["dense_resident"]
+                       ["h2d_bytes_per_window"]))
+    speedup = (report["legs"]["resident_x1"]["value"]
+               / max(1e-9, report["legs"]["x1"]["value"]))
+    throughput_gate_armed = bool(
+        (os.cpu_count() or 1) >= 4 and devices >= 2)
+    report["ab"] = {
+        "h2d_bytes_per_window_reduction_dense": round(h2d_ratio, 2),
+        "resident_speedup_x1": round(speedup, 3),
+        "throughput_gate_armed": throughput_gate_armed,
+    }
+    print(json.dumps({"metric": "stream_resident_ab", **report["ab"]}))
+    if h2d_ratio < 5.0:
+        print(f"FAIL: dense-overlap H2D reduction {h2d_ratio:.1f}x < 5x",
+              file=sys.stderr)
+        rc = 1
+    if throughput_gate_armed and speedup < 2.0:
+        print(f"FAIL: resident throughput {speedup:.2f}x < 2x host "
+              f"(gate armed: >=4 cores, >=2 devices)", file=sys.stderr)
+        rc = 1
+    print(f"A/B: H2D reduction {h2d_ratio:.1f}x (dense overlap), resident "
+          f"speedup {speedup:.2f}x at 1x load "
+          f"({'armed' if throughput_gate_armed else 'informational'})",
+          file=sys.stderr)
     if out:
         with open(out, "w", encoding="utf-8") as f:
             json.dump(report, f, indent=2, sort_keys=True)

@@ -308,6 +308,9 @@ def test_matrix_names_and_presets():
     assert "MTL-bf16-dp2" in names
     assert [c.name for c in resolve_configs("quick")] == ["MTL-f32-dp2"]
     assert resolve_configs(None, "MTL-f32-dp1,single_event-f32-dp1")
+    assert resolve_configs(None, "stream-MTL-f32-k8")
+    ci_names = [c.name for c in resolve_configs("ci")]
+    assert "stream-MTL-int8-k8" in ci_names
     with pytest.raises(ValueError, match="unknown audit config"):
         resolve_configs(None, "nope-f32-dp1")
     with pytest.raises(ValueError, match="unknown preset"):
@@ -326,9 +329,21 @@ def test_committed_baseline_covers_ci_preset():
     baseline = load_baseline(path)
     assert baseline is not None, "artifacts/audit_baseline.json missing"
     targets = baseline["targets"]
-    from dasmtl.analysis.audit.targets import ServeAuditConfig
+    from dasmtl.analysis.audit.targets import (ServeAuditConfig,
+                                               StreamResidentAuditConfig)
 
     for acfg in resolve_configs("full"):
+        if isinstance(acfg, StreamResidentAuditConfig):
+            # Fused resident-stream dispatch: the live lane's program —
+            # one entry per precision, never donates, never communicates.
+            assert acfg.name in targets, acfg.name
+            entry = targets[acfg.name]
+            assert entry["metrics"]["flops"] > 0
+            assert entry["donation"] == "none"
+            assert entry["collectives"] == {}
+            if acfg.precision == "int8":
+                assert entry["metrics"]["int8_dequant_converts"] > 0
+            continue
         if isinstance(acfg, ServeAuditConfig):
             # Serve-forward precision targets: one entry under the
             # config's own name; never donate, never communicate.

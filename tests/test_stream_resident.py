@@ -1,0 +1,401 @@
+"""Device-resident live data plane (dasmtl/stream/resident.py): on-device
+fiber rings, in-graph window slicing, one fused dispatch per cycle — and
+its parity contracts against the host path (decoded ints EXACT, float
+heads within 1e-6) on 1 and 2 virtual devices."""
+
+import numpy as np
+import pytest
+
+from dasmtl.stream.feed import FiberFeed, PlantedEvent, SyntheticSource
+from dasmtl.stream.resident import (ResidentFeed, build_lanes, next_pow2,
+                                    pool_supports_resident,
+                                    resident_rings_fit,
+                                    resolve_resident_mode, rung_ladder)
+from dasmtl.stream.windower import LiveWindower
+
+WINDOW = (64, 64)
+
+
+def _fiber_data(seed=0, channels=64, samples=1024):
+    """Background noise with one strong planted block so the oracle's
+    decoded ints actually vary across windows."""
+    rng = np.random.default_rng(seed)
+    data = (rng.normal(size=(channels, samples)) * 2.0).astype(np.float32)
+    data[16:48, 320:832] *= 5.0
+    return data
+
+
+# -- rung ladder ---------------------------------------------------------------
+
+def test_rung_ladder_covers_power_of_two_dispatch_sizes():
+    assert next_pow2(1) == 1
+    assert next_pow2(5) == 8
+    assert rung_ladder(8) == (1, 2, 4, 8)
+    assert rung_ladder(6) == (1, 2, 4, 8)  # rounded up to the covering rung
+    assert rung_ladder(1) == (1,)
+    with pytest.raises(ValueError):
+        rung_ladder(0)
+
+
+# -- the on-device ring vs the host ring ---------------------------------------
+
+def test_resident_feed_matches_fiberfeed_content_and_addressing():
+    host = FiberFeed(4, 16)
+    res = ResidentFeed(4, 16, chunk_samples=8)
+    data = np.arange(4 * 40, dtype=np.float32).reshape(4, 40)
+    for c0 in range(0, 40, 8):
+        host.append(data[:, c0:c0 + 8], now=float(c0))
+        res.append(data[:, c0:c0 + 8], now=float(c0))
+    assert res.total == host.total == 40
+    assert res.oldest == host.oldest == 24
+    np.testing.assert_array_equal(res.view(24, 16), host.view(24, 16))
+    np.testing.assert_array_equal(res.view(30, 8), host.view(30, 8))
+    assert res.arrival_time(25) == host.arrival_time(25)
+
+
+def test_resident_feed_overrun_underrun_match_fiberfeed_errors():
+    host = FiberFeed(4, 16)
+    res = ResidentFeed(4, 16, chunk_samples=8)
+    chunk = np.zeros((4, 8), np.float32)
+    for _ in range(4):  # 32 samples through a 16-sample ring
+        host.append(chunk)
+        res.append(chunk)
+    # Overrun: the oldest retained sample is 16, sample 8 is gone.
+    with pytest.raises(IndexError, match="overwritten"):
+        host.view(8, 8)
+    with pytest.raises(IndexError, match="overwritten"):
+        res.check_window(8, 8)
+    # Underrun: asking past the appended total.
+    with pytest.raises(IndexError, match="not yet appended"):
+        host.view(28, 8)
+    with pytest.raises(IndexError, match="not yet appended"):
+        res.check_window(28, 8)
+
+
+def test_resident_feed_stages_partial_chunks():
+    res = ResidentFeed(2, 32, chunk_samples=8)
+    res.append(np.ones((2, 5), np.float32))
+    assert res.total == 0 and res.pending == 5  # staged, no H2D yet
+    assert res.h2d_chunks == 0
+    res.append(np.ones((2, 3), np.float32))
+    assert res.total == 8 and res.pending == 0
+    assert res.h2d_chunks == 1
+
+
+# -- the fused multi-window executor vs the plain host forward -----------------
+
+@pytest.mark.parametrize("devices", [1, 2])
+def test_resident_lane_matches_host_forward(devices):
+    """Every window of a planted stream, decoded through the fused
+    slice+forward+decode program, must agree with the plain jitted
+    forward over host-gathered pixels: ints and bools exactly, the
+    confidence and log-prob heads within 1e-6."""
+    import jax
+
+    from dasmtl.stream.live import StreamTenant
+    from dasmtl.stream.selftest import _oracle_pool
+
+    pool = _oracle_pool(WINDOW, (1, 2, 4, 8), devices)
+    tenants = [
+        StreamTenant(f"f{i}", SyntheticSource(64, seed=10 + i),
+                     window=WINDOW, stride_time=32, ring_samples=2048,
+                     chunk_samples=64)
+        for i in range(devices)]
+    lanes = build_lanes(pool, tenants, max_windows=8)
+    if devices > 1:
+        assert (lanes[0].executor.device_name
+                != lanes[1].executor.device_name), \
+            "fibers must round-robin over the pool devices"
+    for i, lane in enumerate(lanes):
+        data = _fiber_data(seed=100 + i)
+        for c0 in range(0, data.shape[1], 64):
+            lane.feed.append(data[:, c0:c0 + 64], now=float(c0))
+        windower = LiveWindower(lane.feed, WINDOW, stride_time=32)
+        host_fwd = jax.jit(pool.executors[i % len(pool.executors)]
+                           .raw_infer_fn)
+        n_checked = 0
+        while True:
+            cuts = windower.cut(8, pixels=False)
+            if not cuts:
+                break
+            assert all(c.x is None for c in cuts)  # meta-only: no pixels
+            batch = lane.dispatch_windows(cuts)
+            preds, bad, prob, log_probs = lane.executor.collect(
+                batch, want_log_probs=True)
+            xs = np.stack([data[c.c_origin:c.c_origin + 64,
+                                c.t_origin:c.t_origin + 64]
+                           for c in cuts])[..., None]
+            host = {k: np.asarray(v)
+                    for k, v in jax.device_get(host_fwd(xs)).items()}
+            np.testing.assert_array_equal(preds["event"], host["event"])
+            np.testing.assert_array_equal(preds["distance"],
+                                          host["distance"])
+            np.testing.assert_array_equal(bad, host["bad_rows"])
+            want_prob = np.exp(host["log_probs_event"].max(axis=-1))
+            assert np.abs(prob - want_prob).max() <= 1e-6
+            for key in ("log_probs_event", "log_probs_distance"):
+                assert np.abs(log_probs[key] - host[key]).max() <= 1e-6
+            n_checked += len(cuts)
+        assert n_checked == 31  # (1024 - 64) // 32 + 1 windows covered
+        assert lane.windows_dispatched == n_checked
+        lane.close()
+
+
+def test_zero_post_warmup_recompiles_on_every_rung():
+    """After warmup, a dispatch at EVERY batch size 1..max must reuse a
+    warmed rung executable — padded up, never recompiled."""
+    from dasmtl.stream.live import StreamTenant
+    from dasmtl.stream.selftest import _oracle_pool
+
+    pool = _oracle_pool(WINDOW, (1, 2, 4, 8), 1)
+    tenant = StreamTenant("f0", SyntheticSource(64, seed=3),
+                          window=WINDOW, stride_time=32,
+                          ring_samples=2048, chunk_samples=64)
+    (lane,) = build_lanes(pool, [tenant], max_windows=8)
+    assert lane.executor.rungs == (1, 2, 4, 8)
+    assert lane.executor.warmup_compiles >= len(lane.executor.rungs)
+    data = _fiber_data(seed=3)
+    for c0 in range(0, data.shape[1], 64):
+        lane.feed.append(data[:, c0:c0 + 64], now=float(c0))
+    windower = LiveWindower(lane.feed, WINDOW, stride_time=32)
+    for k in (1, 2, 3, 4, 5, 6, 7, 8, 1, 5):
+        cuts = windower.cut(k, pixels=False)
+        if not cuts:
+            break
+        batch = lane.dispatch_windows(cuts)
+        assert batch.rung == next_pow2(len(cuts))
+        lane.executor.collect(batch)
+    assert lane.post_warmup_compiles == 0, \
+        lane.executor.compile_summary()
+    lane.close()
+
+
+def test_dispatch_beyond_top_rung_is_refused():
+    from dasmtl.stream.live import StreamTenant
+    from dasmtl.stream.selftest import _oracle_pool
+
+    pool = _oracle_pool(WINDOW, (1, 2), 1)
+    tenant = StreamTenant("f0", SyntheticSource(64, seed=4),
+                          window=WINDOW, stride_time=32,
+                          ring_samples=2048, chunk_samples=64)
+    (lane,) = build_lanes(pool, [tenant], max_windows=2)
+    data = _fiber_data(seed=4)
+    for c0 in range(0, 256, 64):
+        lane.feed.append(data[:, c0:c0 + 64], now=float(c0))
+    windower = LiveWindower(lane.feed, WINDOW, stride_time=32)
+    cuts = windower.cut(pixels=False)
+    assert len(cuts) > 2
+    with pytest.raises(ValueError, match="top rung"):
+        lane.dispatch_windows(cuts)
+    lane.close()
+
+
+# -- mode resolution -----------------------------------------------------------
+
+def test_resolve_resident_mode_contract():
+    import types
+
+    from dasmtl.stream.live import StreamTenant
+    from dasmtl.stream.selftest import _oracle_pool
+
+    pool = _oracle_pool(WINDOW, (1, 2), 1)
+    tenant = StreamTenant("f0", SyntheticSource(64, seed=5),
+                          window=WINDOW, stride_time=32,
+                          ring_samples=2048, chunk_samples=64)
+    assert pool_supports_resident(pool)
+    assert resolve_resident_mode("off", pool, [tenant]) is False
+    assert resolve_resident_mode("on", pool, [tenant]) is True
+    # auto never engages on the plain CPU backend (host path is as fast).
+    assert resolve_resident_mode("auto", pool, [tenant]) is False
+    with pytest.raises(ValueError, match="unknown resident mode"):
+        resolve_resident_mode("maybe", pool, [tenant])
+    # An exported artifact's computation is fixed: no fused slicing.
+    exported = types.SimpleNamespace(
+        executors=[types.SimpleNamespace(raw_infer_fn=None)])
+    assert not pool_supports_resident(exported)
+    with pytest.raises(ValueError, match="resident"):
+        resolve_resident_mode("on", exported, [tenant])
+    assert resolve_resident_mode("auto", exported, [tenant]) is False
+    # Rings beyond the device budget keep auto off.
+    assert resident_rings_fit([tenant])
+    assert not resident_rings_fit([tenant], budget_bytes=1024)
+
+
+# -- adaptive per-tenant weights (fake clock: no sleeps, no wall time) ---------
+
+def test_adaptive_weights_converge_and_recover():
+    """A tenant that sheds every interval backs off multiplicatively to
+    the configured floor; a clean neighbor holds its base share; once the
+    shedding stops the weight recovers additively to — never past — the
+    base."""
+    from dasmtl.stream.live import (ADAPT_MIN_WEIGHT_FRACTION, StreamLoop,
+                                    StreamTenant)
+
+    hot = StreamTenant("hot", SyntheticSource(64, seed=6),
+                       window=WINDOW, stride_time=32, ring_samples=2048,
+                       chunk_samples=64)
+    calm = StreamTenant("calm", SyntheticSource(64, seed=7),
+                        window=WINDOW, stride_time=32, ring_samples=2048,
+                        chunk_samples=64)
+    serve_stub = type("ServeStub", (), {})()
+    loop = StreamLoop(serve_stub, [hot, calm], cycle_budget=16,
+                      max_wait_s=0.01, adapt_weights=True, adapt_every=1)
+    try:
+        assert hot.quota == calm.quota == 8  # equal shares at start
+        base_deadline = calm.deadline_s
+        # Overdrive: hot sheds every interval, calm never does.
+        for _ in range(12):
+            hot.submitted += 20
+            hot.shed += 5
+            calm.submitted += 4
+            loop._adapt_weights()
+        assert hot.weight == pytest.approx(
+            ADAPT_MIN_WEIGHT_FRACTION * hot.base_weight)
+        assert calm.weight == calm.base_weight
+        assert hot.quota < calm.quota  # the share actually moved
+        assert hot.deadline_s > calm.deadline_s == base_deadline
+        floor_quota = hot.quota
+        # An idle interval is no evidence: weights must not move.
+        loop._adapt_weights()
+        assert hot.weight == pytest.approx(
+            ADAPT_MIN_WEIGHT_FRACTION * hot.base_weight)
+        # Recovery: shedding stops, weight climbs back to base, not past.
+        for _ in range(40):
+            hot.submitted += 8
+            calm.submitted += 4
+            loop._adapt_weights()
+        assert hot.weight == pytest.approx(hot.base_weight)
+        assert hot.quota == calm.quota == 8
+        assert hot.quota > floor_quota
+        assert hot.base_weight == 1.0  # the configured share never moved
+    finally:
+        loop.close()
+
+
+# -- end-to-end: the resident StreamLoop vs the host StreamLoop ----------------
+
+def _run_loop(resident):
+    import time as _time
+
+    from dasmtl.serve.server import ServeLoop
+    from dasmtl.stream.live import StreamLoop, StreamTenant
+    from dasmtl.stream.selftest import _oracle_pool
+
+    pool = _oracle_pool(WINDOW, (1, 2, 4, 8), 1)
+    serve = ServeLoop(pool, buckets=(1, 2, 4, 8), max_wait_s=0.002,
+                      queue_depth=64, inflight=2)
+    serve.start()
+    try:
+        ev = PlantedEvent(onset=320, duration=512, event=0,
+                          center_channel=32)
+        tenant = StreamTenant(
+            "f0", SyntheticSource(64, seed=1, events=(ev,)),
+            window=WINDOW, stride_time=32, ring_samples=2048,
+            chunk_samples=64)
+        stream = StreamLoop(serve, [tenant], cycle_budget=8,
+                            max_wait_s=0.01, resident=resident)
+        try:
+            assert stream.resident_enabled == (resident == "on")
+            for _ in range(30):
+                stream.run_cycle()
+                deadline = _time.monotonic() + 2.0
+                while tenant.outstanding and _time.monotonic() < deadline:
+                    _time.sleep(0.001)
+            assert stream.drain(timeout=30.0)
+            lane = tenant.resident
+            if resident == "on":
+                assert lane is not None
+                assert lane.windows_dispatched == tenant.submitted
+                assert lane.post_warmup_compiles == 0
+                assert lane.feed.h2d_bytes > 0
+                text = stream.metrics_text()
+                assert "dasmtl_stream_resident_h2d_bytes_total" in text
+                assert "dasmtl_stream_resident_windows_total" in text
+                stats = stream.stats()
+                assert stats["resident"] is True
+                assert stats["tenants"]["f0"]["resident"]["dispatches"] > 0
+            return {
+                "submitted": tenant.submitted,
+                "resolved": tenant.resolved,
+                "shed": tenant.shed,
+                "rejected": tenant.rejected,
+                "tracks": [(t.event, t.onset_sample,
+                            round(t.fiber_pos, 3))
+                           for t in tenant.book.closed_tracks],
+            }
+        finally:
+            stream.close()
+    finally:
+        serve.drain(timeout=10.0)
+        serve.close()
+
+
+def test_stream_loop_resident_matches_host_end_to_end():
+    """The same planted stream through both data planes: identical
+    admission counters, identical decoded track recovery.  (fiber_pos is
+    prob-weighted — the resident path's fixed-point confidence is within
+    2^-20 of the host float, so 3 decimals must agree.)"""
+    host = _run_loop("off")
+    res = _run_loop("on")
+    assert host["submitted"] == res["submitted"] > 0
+    assert host["resolved"] == res["resolved"]
+    assert host["shed"] == res["shed"] == 0
+    assert host["rejected"] == res["rejected"] == 0
+    assert host["tracks"] == res["tracks"]
+    assert len(res["tracks"]) == 1 and res["tracks"][0][0] == 0
+
+
+# -- offline vs live: the shared fused builder is the same program -------------
+
+def test_offline_and_live_resident_paths_agree(tmp_path):
+    """stream_predict (offline resident sweep) and a live ResidentLane
+    serving the same checkpoint must decode every window of the same
+    record identically — both ride dasmtl.export.make_resident_forward,
+    and this pins that the refactor kept them twins."""
+    from dasmtl.config import Config
+    from dasmtl.main import build_state
+    from dasmtl.models.registry import get_model_spec
+    from dasmtl.serve.executor import ExecutorPool, InferExecutor
+    from dasmtl.stream import EVENT_NAMES, stream_predict
+    from dasmtl.stream.live import StreamTenant
+    from dasmtl.train.checkpoint import CheckpointManager
+
+    cfg = Config(model="MTL", batch_size=4)
+    spec = get_model_spec("MTL")
+    state = build_state(cfg, spec, input_hw=WINDOW)
+    mgr = CheckpointManager(str(tmp_path / "run"))
+    ckpt = mgr.save(state)
+    mgr.wait()
+
+    rec = np.random.default_rng(8).normal(
+        size=(64, 64 * 6)).astype(np.float32)
+    offline = stream_predict(rec, ckpt, model="MTL", batch_size=8,
+                             window=WINDOW, stride=(64, 32),
+                             resident="on")
+    by_origin = {r["time_origin"]: r for r in offline}
+
+    ex = InferExecutor.from_checkpoint("MTL", ckpt, (1, 2, 4, 8),
+                                       input_hw=WINDOW)
+    pool = ExecutorPool([ex])
+    tenant = StreamTenant("f0", SyntheticSource(64, seed=9),
+                          window=WINDOW, stride_time=32,
+                          ring_samples=2048, chunk_samples=64)
+    (lane,) = build_lanes(pool, [tenant], max_windows=8)
+    for c0 in range(0, rec.shape[1], 64):
+        lane.feed.append(rec[:, c0:c0 + 64], now=float(c0))
+    windower = LiveWindower(lane.feed, WINDOW, stride_time=32)
+    n = 0
+    while True:
+        cuts = windower.cut(8, pixels=False)
+        if not cuts:
+            break
+        preds, bad, _, _ = lane.executor.collect(
+            lane.dispatch_windows(cuts))
+        for j, c in enumerate(cuts):
+            row = by_origin[c.t_origin]
+            assert not bad[j]
+            assert EVENT_NAMES[int(preds["event"][j])] == row["pred_event"]
+            assert int(preds["distance"][j]) == row["pred_distance_m"]
+            n += 1
+    assert n == len(offline) > 0  # every offline window live-covered
+    lane.close()
