@@ -3,7 +3,7 @@
 JAX-specific defects — stray host syncs inside the step path, per-step
 recompilation, PRNG key reuse, donated-buffer reads — pass CPU unit tests
 and only surface as silent wall-clock regressions (or heap corruption) on a
-real v4-8.  This package catches them four ways:
+real v4-8.  This package catches them five ways:
 
 - :mod:`dasmtl.analysis.lint` — an AST linter with JAX-aware rules
   (``dasmtl-lint``; rule registry in :mod:`dasmtl.analysis.rules`), run over
@@ -21,6 +21,13 @@ real v4-8.  This package catches them four ways:
   NaN/Inf blame threaded through the step factories, and determinism
   hash chains gated against a committed baseline.  Enabled by
   ``Config.sanitize``; proves itself by seeded fault injection.
+- :mod:`dasmtl.analysis.conc` — the concurrency suite (``dasmtl-conc``):
+  AST rules DAS301–305 for the threaded serve/stream/obs tiers (races,
+  leaked locks, blocking under locks, if-guarded waits, self-deadlocks)
+  plus a runtime lockdep — instrumented lock factories that build the
+  lock-acquisition-order graph, flag cycles/long holds/unjoined threads,
+  and gate new edges against ``artifacts/lockorder_baseline.json``.
+  Enabled by ``Config.conc_lockdep``; proves itself the same way.
 
 ``docs/STATIC_ANALYSIS.md`` documents every rule id and the
 ``# dasmtl: noqa[RULE]`` suppression syntax.

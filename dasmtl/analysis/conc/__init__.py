@@ -1,0 +1,19 @@
+"""dasmtl-conc — concurrency analysis for the threaded fleet.
+
+The fourth member of the analysis family (lint / audit / sanitize /
+conc), with a static and a runtime half:
+
+- the static half is AST rules DAS301–DAS305 in
+  :mod:`dasmtl.analysis.rules.concurrency`, run by ``dasmtl-lint`` like
+  every other rule;
+- the runtime half is :mod:`dasmtl.analysis.conc.lockdep` — drop-in
+  instrumented ``Lock/RLock/Condition`` wrappers that record the
+  process-wide lock-acquisition-order graph, detect order cycles
+  (potential deadlocks) and long hold times, and check the observed
+  graph against the committed ``artifacts/lockorder_baseline.json``
+  (:mod:`dasmtl.analysis.conc.baseline`).
+
+CLI: ``dasmtl-conc`` / ``dasmtl conc`` / ``python -m
+dasmtl.analysis.conc`` (:mod:`dasmtl.analysis.conc.runner`).
+Docs: docs/STATIC_ANALYSIS.md "Concurrency analysis".
+"""

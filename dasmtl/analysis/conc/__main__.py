@@ -1,0 +1,9 @@
+"""``python -m dasmtl.analysis.conc`` — same surface as the installed
+``dasmtl-conc`` console script (and ``dasmtl conc``)."""
+
+import sys
+
+from dasmtl.analysis.conc.runner import main
+
+if __name__ == "__main__":
+    sys.exit(main())

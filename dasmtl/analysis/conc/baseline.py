@@ -1,0 +1,102 @@
+"""Lock-order baseline: the reviewed acquisition-order graph.
+
+``artifacts/lockorder_baseline.json`` commits the edge set the serve +
+stream selftests observe with lockdep armed.  ``--check-baseline``
+fails on any edge NOT in the file — a new lock-nesting relationship is
+a reviewable event (it widens the deadlock surface), exactly like a
+new collective in the audit baseline.  Baseline edges that a given run
+does not reproduce are fine: a ci-preset run observes a subset of the
+committed full graph.
+
+Workflow (mirrors ``dasmtl-audit``): after an intentional locking
+change run ``dasmtl-conc --update-baseline``, review the diff, commit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+DEFAULT_BASELINE_PATH = os.path.join("artifacts",
+                                     "lockorder_baseline.json")
+
+_COMMENT = ("Observed lock-acquisition-order edges for the serve + "
+            "stream selftests with lockdep armed (dasmtl-conc "
+            "--update-baseline).  An edge [A, B] means some thread "
+            "acquired B while holding A; a NEW edge widens the "
+            "deadlock surface and must be reviewed, not waved through "
+            "(docs/STATIC_ANALYSIS.md 'Concurrency analysis').")
+
+
+def _generated_with() -> dict:
+    import platform
+
+    from dasmtl.analysis.audit.runner import (
+        _generated_with as _deps_versions)
+
+    out = _deps_versions()
+    out["python"] = platform.python_version()
+    return out
+
+
+def load_baseline(path: str = DEFAULT_BASELINE_PATH) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def update_baseline(edges: List[List[str]],
+                    path: str = DEFAULT_BASELINE_PATH) -> dict:
+    """Write/refresh the baseline.  Edges accumulate across updates
+    (a ci-preset run must not silently drop the full graph's edges);
+    a hand-edited comment survives."""
+    prev = load_baseline(path)
+    merged = {tuple(e) for e in edges}
+    comment = _COMMENT
+    if prev is not None:
+        merged |= {tuple(e) for e in prev.get("edges", [])}
+        comment = prev.get("comment", _COMMENT)
+    doc = {
+        "version": 1,
+        "comment": comment,
+        "generated_with": _generated_with(),
+        "edges": sorted(list(e) for e in merged),
+    }
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def check_edges(edges: List[List[str]],
+                baseline: Optional[dict],
+                path: str = DEFAULT_BASELINE_PATH) -> List[dict]:
+    """CONC403 per observed edge missing from the baseline; CONC404
+    when there is no baseline at all."""
+    if baseline is None:
+        return [{
+            "id": "CONC404", "severity": "error",
+            "message": f"no lock-order baseline at {path} — run "
+                       f"`dasmtl-conc --update-baseline` and commit "
+                       f"the reviewed graph",
+        }]
+    known = {tuple(e) for e in baseline.get("edges", [])}
+    findings = []
+    for a, b in (tuple(e) for e in edges):
+        if (a, b) in known:
+            continue
+        findings.append({
+            "id": "CONC403", "severity": "error",
+            "edge": [a, b],
+            "message": f"new lock-order edge {a} -> {b} not in the "
+                       f"committed baseline — a new nesting "
+                       f"relationship widens the deadlock surface; "
+                       f"review it, then `dasmtl-conc "
+                       f"--update-baseline`",
+        })
+    return findings

@@ -1,0 +1,99 @@
+"""Seeded fault injection — how the concurrency suite proves itself.
+
+Same convention as :mod:`dasmtl.analysis.sanitize.faults`: a checker
+that has never caught anything is an assertion, not a tool.  The hooks
+here let ``dasmtl-conc --self-test`` plant exactly the defects the
+suite exists for, each caught by its half:
+
+- ``inject("abba")`` — :func:`run_lock_exercise` acquires two tracked
+  locks in *opposite orders on two threads* (run sequentially, so the
+  self-test can never actually deadlock; the order graph does not care
+  about interleaving).  → a lockdep cycle finding the moment the
+  closing edge appears.
+- ``inject("unguarded_mutation")`` — :func:`mutation_snippet` emits a
+  worker class whose thread body mutates shared state *outside* its
+  lock.  → DAS301 from the static rules.
+
+Test-only by construction: nothing in the production path activates a
+fault, and the injection registry is process-local.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Set
+
+FAULTS = ("abba", "unguarded_mutation")
+
+_ACTIVE: Set[str] = set()
+
+
+def active(name: str) -> bool:
+    """Is a fault currently injected?  Consulted by the exercises."""
+    return name in _ACTIVE
+
+
+@contextmanager
+def inject(name: str):
+    """Activate one named fault for the duration of the context."""
+    if name not in FAULTS:
+        raise ValueError(f"unknown fault {name!r}; known: {FAULTS}")
+    _ACTIVE.add(name)
+    try:
+        yield
+    finally:
+        _ACTIVE.discard(name)
+
+
+def run_lock_exercise() -> None:
+    """Acquire two tracked locks from two worker threads.  Clean: both
+    threads nest A -> B (one edge, no cycle).  With ``abba`` injected
+    the second thread nests B -> A — the classic deadlock shape.  The
+    threads run **sequentially** (each is joined before the next
+    starts), so the exercise itself can never hang: lockdep flags the
+    *order* cycle, which is exactly the point — the graph convicts the
+    shape before any run loses the race."""
+    from dasmtl.analysis.conc import lockdep
+
+    a = lockdep.lock("conc_selftest.A")
+    b = lockdep.lock("conc_selftest.B")
+
+    def forward() -> None:
+        with a:
+            with b:
+                pass
+
+    def backward() -> None:
+        with b:
+            with a:
+                pass
+
+    second = backward if active("abba") else forward
+    for fn in (forward, second):
+        t = threading.Thread(target=fn, name="conc-selftest-worker")
+        t.start()
+        t.join()
+
+
+def mutation_snippet() -> str:
+    """Source for a minimal worker class, linted by the self-test.
+    Clean: the thread body mutates ``self.count`` under ``self._lock``.
+    With ``unguarded_mutation`` injected the guard is gone — the race
+    DAS301 exists to catch."""
+    mutate = ("self.count += 1" if active("unguarded_mutation")
+              else "with self._lock:\n                self.count += 1")
+    return f'''\
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        for _ in range(100):
+            {mutate}
+'''

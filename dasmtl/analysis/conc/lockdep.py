@@ -1,0 +1,533 @@
+"""Runtime lock-order tracking — drop-in instrumented lock wrappers.
+
+Every lock the fleet cares about is constructed through the factories
+here (``lockdep.lock("ServeLoop._swap_lock")`` instead of
+``threading.Lock()``).  Disabled — the default — each factory returns
+the *plain* ``threading`` primitive, so steady-state code pays nothing.
+Enabled (``Config.conc_lockdep``, the ``DASMTL_CONC_LOCKDEP=1`` env
+var, or :func:`enable`), they return tracked wrappers that record, per
+acquisition, the set of locks the acquiring thread already holds:
+
+- the process-wide **acquisition-order graph** (edge ``A -> B`` = some
+  thread acquired B while holding A).  A cycle in that graph is a
+  potential deadlock even if this run never interleaved badly — the
+  classic ABBA shape — and is reported the moment the closing edge
+  appears;
+- **hold times**: releasing a lock after more than ``hold_warn_ms``
+  (``Condition.wait`` correctly splits the segments — waiting releases
+  the lock) records a long-hold finding, the "why is p99 pausing"
+  smoking gun;
+- **unjoined threads**: :func:`assert_joined` turns an abandoned
+  worker after a drain deadline from a silent leak into a named
+  :class:`UnjoinedThreadError`.
+
+Findings surface three ways: :func:`snapshot` (the runner / tests),
+:func:`publish` into an obs ``MetricsRegistry`` (``dasmtl_conc_*``
+families), and :func:`dump_jsonl` (trace-style one record per line).
+The observed edge set is diffed against the committed
+``artifacts/lockorder_baseline.json`` by
+:mod:`dasmtl.analysis.conc.baseline` — a new nesting relationship
+fails CI until reviewed.
+
+Recursion hazard (do not "fix" this): the tracker must never touch the
+obs registry on the acquire path — the registry's own lock would
+re-enter the tracker and deadlock it.  State lives behind one plain,
+untracked guard lock (a leaf: nothing is ever acquired under it), and
+metrics publish only at :func:`publish` time via ``set_total``.  For
+the same reason :mod:`dasmtl.obs.registry`'s internal lock stays a
+plain ``threading.Lock``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+#: Cap per finding list — a pathological loop must not grow memory
+#: unboundedly; the first occurrences are the diagnostic ones.
+_MAX_FINDINGS = 256
+
+
+class LockdepError(RuntimeError):
+    """Base for runtime concurrency findings raised as errors."""
+
+
+class UnjoinedThreadError(LockdepError):
+    """A spawned thread outlived its join deadline (see assert_joined)."""
+
+
+class _Entry:
+    """One held lock on one thread's stack."""
+
+    __slots__ = ("name", "ident", "t0", "depth")
+
+    def __init__(self, name: str, ident: int, t0: float):
+        self.name = name
+        self.ident = ident
+        self.t0 = t0
+        self.depth = 1
+
+
+class _State:
+    """Process-wide tracker state.  ``guard`` is a plain (untracked)
+    leaf lock — nothing is acquired while holding it."""
+
+    def __init__(self, hold_warn_ms: float = 200.0):
+        self.guard = threading.Lock()
+        self.tls = threading.local()
+        self.hold_warn_s = float(hold_warn_ms) / 1e3
+        self.nodes: Set[str] = set()
+        self.edges: Dict[Tuple[str, str], int] = {}
+        self.acquisitions = 0
+        self.cycles: List[dict] = []
+        self.long_holds: List[dict] = []
+        self.unjoined: List[dict] = []
+
+    def stack(self) -> List[_Entry]:
+        st = getattr(self.tls, "stack", None)
+        if st is None:
+            st = self.tls.stack = []
+        return st
+
+    # -- hooks (called by the wrappers, never under user locks' waits) ----
+    def on_acquired(self, name: str, ident: int, reentrant: bool) -> None:
+        st = self.stack()
+        if reentrant:
+            for e in reversed(st):
+                if e.ident == ident:
+                    e.depth += 1
+                    return
+        held = {e.name for e in st if e.name != name}
+        st.append(_Entry(name, ident, time.monotonic()))
+        with self.guard:
+            self.acquisitions += 1
+            self.nodes.add(name)
+            for prev in held:
+                edge = (prev, name)
+                if edge not in self.edges:
+                    self.edges[edge] = 0
+                    cycle = self._cycle_through(name, prev)
+                    if cycle and len(self.cycles) < _MAX_FINDINGS:
+                        self.cycles.append({
+                            "kind": "cycle",
+                            "edge": [prev, name],
+                            "cycle": cycle,
+                            "thread": threading.current_thread().name,
+                        })
+                self.edges[edge] += 1
+
+    def _cycle_through(self, src: str, dst: str) -> Optional[List[str]]:
+        """Path ``src -> ... -> dst`` in the edge graph (which closes a
+        cycle with the just-added ``dst -> src`` edge), or None."""
+        adj: Dict[str, List[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, []).append(b)
+        path = [src]
+        seen = {src}
+
+        def dfs(node: str) -> bool:
+            if node == dst:
+                return True
+            for nxt in adj.get(node, ()):
+                if nxt in seen:
+                    continue
+                seen.add(nxt)
+                path.append(nxt)
+                if dfs(nxt):
+                    return True
+                path.pop()
+            return False
+
+        return path + [src] if dfs(src) else None
+
+    def on_release(self, name: str, ident: int) -> None:
+        st = self.stack()
+        for i in range(len(st) - 1, -1, -1):
+            e = st[i]
+            if e.ident != ident:
+                continue
+            e.depth -= 1
+            if e.depth > 0:
+                return
+            st.pop(i)
+            held_s = time.monotonic() - e.t0
+            if held_s >= self.hold_warn_s:
+                with self.guard:
+                    if len(self.long_holds) < _MAX_FINDINGS:
+                        self.long_holds.append({
+                            "kind": "long_hold",
+                            "lock": name,
+                            "held_ms": round(held_s * 1e3, 3),
+                            "warn_ms": round(self.hold_warn_s * 1e3, 3),
+                            "thread": threading.current_thread().name,
+                        })
+            return
+        # Release without a matching tracked acquire (lock handed across
+        # threads) — legal for semaphore-style use, but these wrappers
+        # are for mutexes; record nothing rather than corrupt the stack.
+
+
+_state: Optional[_State] = None
+
+
+def enabled() -> bool:
+    return _state is not None
+
+
+def enable(hold_warn_ms: Optional[float] = None, *,
+           reset: bool = True) -> None:
+    """Arm the tracker.  Must run BEFORE the locks it should observe are
+    constructed — the factories consult it at construction time.
+    ``reset=False`` keeps an existing graph (re-arming mid-process)."""
+    global _state
+    if _state is not None and not reset:
+        if hold_warn_ms is not None:
+            _state.hold_warn_s = float(hold_warn_ms) / 1e3
+        _install_publish_hook()
+        return
+    _state = _State(hold_warn_ms if hold_warn_ms is not None else 200.0)
+    _install_publish_hook()
+
+
+def disable() -> None:
+    """Stop recording.  Wrappers already constructed keep working as
+    plain locks (their hooks no-op once the state is gone)."""
+    global _state
+    _state = None
+
+
+def configure(config) -> bool:
+    """Arm from a :class:`dasmtl.config.Config`: returns True when
+    lockdep came on (``conc_lockdep`` or the env var)."""
+    if getattr(config, "conc_lockdep", False) or _env_on():
+        enable(getattr(config, "conc_hold_warn_ms", None), reset=False)
+        path = getattr(config, "conc_dump_path", None)
+        if path:
+            dump_jsonl_at_exit(path)
+        return True
+    return False
+
+
+def _env_on() -> bool:
+    return os.environ.get("DASMTL_CONC_LOCKDEP", "").lower() in (
+        "1", "true", "on", "yes")
+
+
+# -- wrappers ----------------------------------------------------------------
+
+class TrackedLock:
+    """``threading.Lock`` plus acquisition-order recording."""
+
+    _REENTRANT = False
+
+    def __init__(self, name: str):
+        self.name = name
+        self._inner = self._make_inner()
+
+    @staticmethod
+    def _make_inner():
+        return threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got and _state is not None:
+            _state.on_acquired(self.name, id(self), self._REENTRANT)
+        return got
+
+    def release(self) -> None:
+        if _state is not None:
+            _state.on_release(self.name, id(self))
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+class TrackedRLock(TrackedLock):
+    """``threading.RLock`` plus recording (re-entry adds no edges)."""
+
+    _REENTRANT = True
+
+    @staticmethod
+    def _make_inner():
+        return threading.RLock()
+
+    def locked(self) -> bool:  # RLock has no .locked() before 3.12
+        raise AttributeError("RLock.locked is not portable; track "
+                             "ownership in the caller")
+
+
+class TrackedCondition:
+    """``threading.Condition`` plus recording.  ``wait()`` splits the
+    hold-time segments (waiting releases the lock) and keeps the
+    thread's held-stack truthful across the release/re-acquire."""
+
+    def __init__(self, name: str, lock=None):
+        self.name = name
+        if isinstance(lock, TrackedLock):
+            # Share the wrapped lock's identity: holding this condition
+            # IS holding that lock (mirrors the static rules' aliasing).
+            self._cond = threading.Condition(lock._inner)
+            self._node = lock.name
+            self._ident = id(lock)
+            self._reentrant = lock._REENTRANT
+        elif lock is not None:
+            self._cond = threading.Condition(lock)
+            self._node = name
+            self._ident = id(self)
+            self._reentrant = isinstance(
+                lock, type(threading.RLock()))
+        else:
+            self._cond = threading.Condition()  # stdlib default: RLock
+            self._node = name
+            self._ident = id(self)
+            self._reentrant = True
+
+    def acquire(self, *args) -> bool:
+        # Pass-through wrapper: acquire/release pairing is the CALLER's
+        # contract (DAS302 checks the call sites, not this forwarder).
+        got = self._cond.acquire(*args)  # dasmtl: noqa[DAS302]
+        if got and _state is not None:
+            _state.on_acquired(self._node, self._ident, self._reentrant)
+        return got
+
+    def release(self) -> None:
+        if _state is not None:
+            _state.on_release(self._node, self._ident)
+        self._cond.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if _state is not None:
+            _state.on_release(self._node, self._ident)
+        try:
+            # Pass-through wrapper: the while-predicate loop is the
+            # CALLER's contract (DAS304 checks the call sites).
+            return self._cond.wait(timeout)  # dasmtl: noqa[DAS304]
+        finally:
+            if _state is not None:
+                _state.on_acquired(self._node, self._ident,
+                                   self._reentrant)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        # Re-implemented over self.wait() so the hooks above see every
+        # release/re-acquire (the stdlib loop would bypass them).
+        endtime = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + timeout
+                waittime = endtime - time.monotonic()
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait()
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def __repr__(self) -> str:
+        return f"<TrackedCondition {self.name!r} over {self._node!r}>"
+
+
+# -- factories (the fleet-facing API) ---------------------------------------
+
+def lock(name: str):
+    """A mutex named for the graph: ``lockdep.lock("Class._lock")``.
+    Plain ``threading.Lock`` while disabled — zero overhead."""
+    return TrackedLock(name) if _state is not None else threading.Lock()
+
+
+def rlock(name: str):
+    return TrackedRLock(name) if _state is not None else threading.RLock()
+
+
+def condition(name: str, lock=None):
+    """A condition variable; pass the lock it guards (tracked or plain)
+    to share that lock's graph node, or nothing for a private one."""
+    if _state is not None:
+        return TrackedCondition(name, lock)
+    if isinstance(lock, TrackedLock):  # armed after the lock was built
+        return threading.Condition(lock._inner)
+    return threading.Condition(lock)
+
+
+# -- watchdog ----------------------------------------------------------------
+
+def assert_joined(threads: Sequence, context: str) -> None:
+    """Lockdep-mode watchdog for drain paths: every thread in
+    ``threads`` must be dead (joined).  A survivor records an unjoined
+    finding and raises :class:`UnjoinedThreadError` — the "abandoned
+    daemon thread" leak as a named failure.  No-op while disabled."""
+    if _state is None:
+        return
+    alive = [t for t in threads
+             if t is not None and getattr(t, "is_alive", lambda: False)()]
+    if not alive:
+        return
+    names = sorted(getattr(t, "name", "?") for t in alive)
+    with _state.guard:
+        if len(_state.unjoined) < _MAX_FINDINGS:
+            _state.unjoined.append({
+                "kind": "unjoined", "context": context, "threads": names})
+    raise UnjoinedThreadError(
+        f"{context}: {len(alive)} thread(s) outlived the join deadline: "
+        f"{', '.join(names)} — a drain that abandons its workers leaks "
+        f"them silently in production")
+
+
+# -- reporting ---------------------------------------------------------------
+
+def snapshot() -> dict:
+    """The current graph + findings as plain data (empty when off)."""
+    if _state is None:
+        return {"enabled": False, "nodes": [], "edges": [], "cycles": [],
+                "long_holds": [], "unjoined": [], "acquisitions": 0}
+    with _state.guard:
+        return {
+            "enabled": True,
+            "nodes": sorted(_state.nodes),
+            "edges": sorted([a, b, n] for (a, b), n in
+                            _state.edges.items()),
+            "cycles": list(_state.cycles),
+            "long_holds": list(_state.long_holds),
+            "unjoined": list(_state.unjoined),
+            "acquisitions": _state.acquisitions,
+        }
+
+
+def observed_edges() -> List[List[str]]:
+    """Sorted ``[from, to]`` pairs — what the baseline stores."""
+    return [[a, b] for a, b, _n in snapshot()["edges"]]
+
+
+def clean_since(before: dict) -> Tuple[List[str], dict]:
+    """Selftest leg: cycle/unjoined findings newer than an earlier
+    :func:`snapshot`, rendered as failure strings, plus a summary dict.
+    Disabled tracker -> no failures, ``{"enabled": False}`` (the leg is
+    opt-in: CI arms it via DASMTL_CONC_LOCKDEP=1, dasmtl-conc via
+    :func:`enable`).  Long holds are reported in the summary but are
+    not failures — hold times on a loaded CI host are advisory."""
+    snap = snapshot()
+    if not snap["enabled"]:
+        return [], {"enabled": False}
+    cycles = snap["cycles"][len(before.get("cycles", ())):]
+    unjoined = snap["unjoined"][len(before.get("unjoined", ())):]
+    msgs = [f"lockdep: lock-order cycle on thread {c['thread']}: "
+            f"{' -> '.join(c['cycle'])}" for c in cycles]
+    msgs += [f"lockdep: {u['context']}: unjoined thread(s) "
+             f"{', '.join(u['threads'])}" for u in unjoined]
+    return msgs, {"enabled": True, "edges": len(snap["edges"]),
+                  "long_holds": len(snap["long_holds"]),
+                  "cycles": len(cycles), "unjoined": len(unjoined)}
+
+
+_publish_hook_installed = False
+
+
+def _install_publish_hook() -> None:
+    """Mirror the graph into the default obs registry at scrape time, so
+    a lockdep-armed server's ``/metrics`` carries the ``dasmtl_conc_*``
+    families without any tier-specific wiring.  Safe against the
+    recursion hazard: the registry runs collect callbacks OUTSIDE its
+    own lock, and the callback no-ops once lockdep is disabled."""
+    global _publish_hook_installed
+    if _publish_hook_installed:
+        return
+    try:
+        from dasmtl.obs.registry import default_registry
+    except ImportError:  # interpreter teardown mid-import
+        return
+    default_registry().add_collect_callback(_publish_if_enabled)
+    _publish_hook_installed = True
+
+
+def _publish_if_enabled() -> None:
+    if _state is not None:
+        publish()
+
+
+def publish(registry=None) -> None:
+    """Export ``dasmtl_conc_*`` families into an obs registry.  Called
+    at dump/drain time, NEVER from the acquire path (see module
+    docstring — the registry's own lock would recurse)."""
+    from dasmtl.obs.registry import default_registry
+
+    snap = snapshot()
+    reg = registry if registry is not None else default_registry()
+    reg.counter("dasmtl_conc_acquisitions_total",
+                "Tracked lock acquisitions since lockdep came on"
+                ).set_total(snap["acquisitions"])
+    reg.gauge("dasmtl_conc_edges",
+              "Distinct lock-acquisition-order edges observed"
+              ).set(len(snap["edges"]))
+    reg.counter("dasmtl_conc_cycles_total",
+                "Lock-order cycles (potential deadlocks) detected"
+                ).set_total(len(snap["cycles"]))
+    reg.counter("dasmtl_conc_long_holds_total",
+                "Lock holds exceeding conc_hold_warn_ms"
+                ).set_total(len(snap["long_holds"]))
+    reg.counter("dasmtl_conc_unjoined_threads_total",
+                "Threads that outlived a drain join deadline"
+                ).set_total(len(snap["unjoined"]))
+
+
+def dump_jsonl(path: str) -> int:
+    """Trace-style dump: one JSON record per line (edges, then
+    findings).  Returns the record count."""
+    snap = snapshot()
+    records: List[dict] = [
+        {"kind": "edge", "from": a, "to": b, "count": n}
+        for a, b, n in snap["edges"]]
+    records.extend(snap["cycles"])
+    records.extend(snap["long_holds"])
+    records.extend(snap["unjoined"])
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    return len(records)
+
+
+_atexit_registered: Set[str] = set()
+
+
+def dump_jsonl_at_exit(path: str) -> None:
+    import atexit
+
+    if path in _atexit_registered:
+        return
+    _atexit_registered.add(path)
+    atexit.register(lambda: _state is not None and dump_jsonl(path))
+
+
+# CI subprocess legs arm via the environment.  Must stay at module
+# BOTTOM: enable() installs the scrape-time publish hook, defined above.
+if _env_on():
+    enable()

@@ -1,0 +1,361 @@
+"""Orchestration + CLI for the concurrency suite (``dasmtl-conc``).
+
+Three verbs:
+
+- **exercise run** (default): arm lockdep, drive the serve + stream
+  selftests in-process (the preset picks which), and report the
+  observed lock-order graph plus any runtime findings — order cycles
+  (CONC401), long holds (CONC402), unjoined threads (CONC405).
+  ``--check-baseline`` additionally diffs the observed edges against
+  the committed ``artifacts/lockorder_baseline.json`` (CONC403 per new
+  edge, CONC404 when the file is missing); ``--update-baseline``
+  regenerates it (edges merge across runs — review the diff, commit).
+- ``--self-test``: fault injection — plant the ABBA lock order and the
+  unguarded shared mutation (:mod:`dasmtl.analysis.conc.faults`) and
+  verify lockdep / DAS301 catch them, plus the long-hold and
+  thread-join watchdog legs.  A checker that misses its fault fails
+  the run.
+- ``--list-exercises``: print the exercises and presets.
+
+Exit code: 1 on any **error**-severity finding.  Long holds (CONC402)
+are warnings — load, compile pauses, and CI-host jitter make hold
+times advisory; cycles and baseline drift are not.
+
+Backend handling mirrors the audit CLI: the CPU backend is pinned
+before jax initializes and donation is disabled for the process — an
+analysis tool must never touch this container's TPU tunnel.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from dasmtl.analysis.conc import lockdep
+from dasmtl.analysis.conc.baseline import (DEFAULT_BASELINE_PATH,
+                                           check_edges, load_baseline,
+                                           update_baseline)
+
+
+def _pin_backend(min_devices: int = 1) -> None:
+    os.environ["DASMTL_DISABLE_DONATION"] = "1"
+    from dasmtl.analysis.audit.runner import _pin_cpu_backend
+
+    _pin_cpu_backend(min_devices)
+
+
+# -- exercises ---------------------------------------------------------------
+
+def _serve_exercise(verbose: bool) -> dict:
+    from dasmtl.serve.selftest import run_selftest
+
+    return run_selftest(verbose=verbose)
+
+
+def _stream_exercise(verbose: bool) -> dict:
+    from dasmtl.stream.selftest import run_selftest
+
+    say = print if verbose else (lambda *_a, **_k: None)
+    return run_selftest(say=say)
+
+
+def _stream_resident_exercise(verbose: bool) -> dict:
+    from dasmtl.stream.selftest import run_selftest
+
+    say = print if verbose else (lambda *_a, **_k: None)
+    return run_selftest(resident=True, say=say)
+
+
+EXERCISES: Dict[str, Callable[[bool], dict]] = {
+    "serve": _serve_exercise,
+    "stream": _stream_exercise,
+    "stream-resident": _stream_resident_exercise,
+}
+
+PRESETS: Dict[str, Tuple[str, ...]] = {
+    "quick": ("serve",),
+    "ci": ("serve", "stream"),
+    "full": ("serve", "stream", "stream-resident"),
+}
+
+
+def resolve_exercises(preset: str,
+                      names: Optional[str]) -> List[str]:
+    if names:
+        picked = [n.strip() for n in names.split(",") if n.strip()]
+        unknown = [n for n in picked if n not in EXERCISES]
+        if unknown:
+            raise ValueError(f"unknown exercise(s) {unknown}; known: "
+                             f"{sorted(EXERCISES)}")
+        return picked
+    return list(PRESETS[preset])
+
+
+def run_exercises(names: Sequence[str], *,
+                  hold_warn_ms: Optional[float] = None,
+                  verbose: bool = True) -> List[dict]:
+    """Arm lockdep (fresh graph), run the selftests, return findings.
+    The observed edges stay in the armed tracker afterwards —
+    :func:`lockdep.observed_edges` reads them for the baseline verbs."""
+    findings: List[dict] = []
+    lockdep.enable(hold_warn_ms, reset=True)
+    for name in names:
+        report = EXERCISES[name](verbose)
+        if not report.get("passed", False):
+            findings.append({
+                "id": "CONC400", "severity": "error",
+                "message": f"{name} selftest failed under lockdep: "
+                           f"{report.get('failures')}",
+            })
+    findings.extend(runtime_findings(lockdep.snapshot()))
+    return findings
+
+
+def runtime_findings(snap: dict) -> List[dict]:
+    """Map a lockdep snapshot's finding lists to CONC40x records."""
+    out: List[dict] = []
+    for c in snap["cycles"]:
+        out.append({
+            "id": "CONC401", "severity": "error",
+            "message": f"lock-order cycle (potential deadlock) on "
+                       f"thread {c['thread']}: "
+                       f"{' -> '.join(c['cycle'])} (closed by "
+                       f"{c['edge'][0]} -> {c['edge'][1]})",
+        })
+    for h in snap["long_holds"]:
+        out.append({
+            "id": "CONC402", "severity": "warning",
+            "message": f"{h['lock']} held {h['held_ms']}ms on thread "
+                       f"{h['thread']} (warn threshold "
+                       f"{h['warn_ms']}ms)",
+        })
+    for u in snap["unjoined"]:
+        out.append({
+            "id": "CONC405", "severity": "error",
+            "message": f"{u['context']}: thread(s) outlived their join "
+                       f"deadline: {', '.join(u['threads'])}",
+        })
+    return out
+
+
+# -- fault-injection self-test ------------------------------------------------
+
+def self_test(verbose: bool = True) -> List[dict]:
+    """Prove each half catches its fault.  Returns findings for every
+    fault that went UNCAUGHT (empty = the suite works)."""
+    from dasmtl.analysis.conc import faults
+    from dasmtl.analysis.lint import lint_source
+
+    findings: List[dict] = []
+
+    def note(msg: str) -> None:
+        if verbose:
+            print(f"[self-test] {msg}")
+
+    def miss(id_: str, msg: str) -> None:
+        findings.append({"id": id_, "severity": "error",
+                         "message": msg})
+
+    # 1. Lockdep: the injected ABBA order must close a cycle.
+    lockdep.enable(reset=True)
+    with faults.inject("abba"):
+        faults.run_lock_exercise()
+    cycles = lockdep.snapshot()["cycles"]
+    if cycles:
+        note(f"CONC401 caught injected ABBA: "
+             f"{' -> '.join(cycles[0]['cycle'])}")
+    else:
+        miss("CONC401", "injected ABBA lock order was NOT caught — no "
+                        "cycle in the acquisition-order graph")
+
+    # 2. ... and the clean order must not (false-positive guard).
+    lockdep.enable(reset=True)
+    faults.run_lock_exercise()
+    snap = lockdep.snapshot()
+    if snap["cycles"]:
+        miss("CONC401", f"clean A -> B exercise produced a spurious "
+                        f"cycle: {snap['cycles']}")
+    elif not snap["edges"]:
+        miss("CONC401", "clean exercise recorded no edges — the "
+                        "tracked wrappers are not reporting")
+    else:
+        note("clean lock exercise: edges recorded, no cycle")
+
+    # 3. DAS301: the unguarded-mutation snippet must lint dirty ...
+    with faults.inject("unguarded_mutation"):
+        dirty = faults.mutation_snippet()
+    hits = [f for f in lint_source(dirty, "<conc-self-test>")
+            if f.rule == "DAS301"]
+    if hits:
+        note(f"DAS301 caught injected unguarded mutation: "
+             f"{hits[0].message.splitlines()[0]}")
+    else:
+        miss("DAS301", "injected unguarded shared-attribute mutation "
+                       "was NOT caught by the static rules")
+
+    # 4. ... and the guarded version must lint clean.
+    hits = [f for f in lint_source(faults.mutation_snippet(),
+                                   "<conc-self-test>")
+            if f.rule.startswith("DAS3")]
+    if hits:
+        miss("DAS301", f"guarded snippet tripped the concurrency "
+                       f"rules: {[f.render() for f in hits]}")
+    else:
+        note("guarded snippet lints clean")
+
+    # 5. Long holds: a deliberate slow critical section must be flagged.
+    lockdep.enable(hold_warn_ms=1.0, reset=True)
+    slow = lockdep.lock("conc_selftest.slow")
+    with slow:
+        # Deliberate fault: sleeping under the lock IS the injected
+        # long hold this leg must catch.
+        time.sleep(0.01)  # dasmtl: noqa[DAS303]
+    holds = lockdep.snapshot()["long_holds"]
+    if holds:
+        note(f"CONC402 caught deliberate long hold: "
+             f"{holds[0]['held_ms']}ms over {holds[0]['warn_ms']}ms")
+    else:
+        miss("CONC402", "a 10ms hold over a 1ms threshold was NOT "
+                        "recorded")
+
+    # 6. Watchdog: a live straggler must raise; a joined set must not.
+    lockdep.enable(reset=True)
+    release = threading.Event()
+    straggler = threading.Thread(target=release.wait, daemon=True,
+                                 name="conc-selftest-straggler")
+    straggler.start()
+    try:
+        lockdep.assert_joined([straggler], "self-test drain")
+        miss("CONC405", "a thread that outlived its drain was NOT "
+                        "caught by assert_joined")
+    except lockdep.UnjoinedThreadError as exc:
+        note(f"CONC405 caught unjoined thread: "
+             f"{str(exc).splitlines()[0]}")
+    finally:
+        release.set()
+        straggler.join()
+    try:
+        lockdep.assert_joined([straggler], "self-test drain (joined)")
+        note("joined thread passes the watchdog")
+    except lockdep.UnjoinedThreadError:
+        miss("CONC405", "assert_joined raised on a fully joined thread")
+
+    # Leave the tracker the way the process-level switches say.
+    if lockdep._env_on():
+        lockdep.enable(reset=True)
+    else:
+        lockdep.disable()
+    return findings
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def render(f: dict) -> str:
+    return f"{f['id']} [{f['severity']}] {f['message']}"
+
+
+def summary_line(findings: Sequence[dict]) -> str:
+    n_err = sum(1 for f in findings if f["severity"] == "error")
+    n_warn = len(findings) - n_err
+    status = "clean" if not findings else (f"{n_err} error(s), "
+                                           f"{n_warn} warning(s)")
+    return f"conc: {status}"
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dasmtl-conc",
+        description="Concurrency suite: runtime lockdep (lock-order "
+                    "graph, cycles, hold times, join watchdog) over the "
+                    "serve + stream selftests, gated by the committed "
+                    "lock-order baseline (docs/STATIC_ANALYSIS.md).  The "
+                    "static half, rules DAS301-DAS305, runs under "
+                    "dasmtl-lint.")
+    ap.add_argument("--preset", choices=sorted(PRESETS), default="ci",
+                    help="exercise subset (default: ci)")
+    ap.add_argument("--exercises", type=str, default=None,
+                    help="comma-separated exercise names (overrides "
+                         "--preset; see --list-exercises)")
+    ap.add_argument("--hold-warn-ms", type=float, default=None,
+                    help="override the long-hold threshold for this run "
+                         "(default: lockdep's 200ms)")
+    ap.add_argument("--check-baseline", action="store_true",
+                    help="fail on observed lock-order edges missing "
+                         "from the committed baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="merge this run's observed edges into the "
+                         "baseline (review the diff, commit)")
+    ap.add_argument("--baseline", type=str, default=DEFAULT_BASELINE_PATH)
+    ap.add_argument("--dump", type=str, default=None,
+                    help="write the observed graph + findings as JSONL")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the fault-injection legs instead of the "
+                         "exercises: each planted fault must be caught")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-exercises", action="store_true",
+                    help="print the exercises and presets, then exit")
+    args = ap.parse_args(argv)
+
+    if args.list_exercises:
+        for name in sorted(EXERCISES):
+            print(name)
+        for name, members in sorted(PRESETS.items()):
+            print(f"preset {name}: {', '.join(members)}")
+        return 0
+
+    if args.self_test:
+        findings = self_test(verbose=args.format == "text")
+        if args.format == "json":
+            print(json.dumps({"findings": findings}))
+        else:
+            for f in findings:
+                print(render(f))
+            print("self-test: "
+                  + ("all injected faults caught" if not findings
+                     else f"{len(findings)} fault(s) NOT caught"),
+                  file=sys.stderr)
+        return 1 if findings else 0
+
+    try:
+        names = resolve_exercises(args.preset, args.exercises)
+    except ValueError as exc:
+        ap.error(str(exc))
+    _pin_backend()
+
+    findings = run_exercises(names, hold_warn_ms=args.hold_warn_ms,
+                             verbose=args.format == "text")
+    edges = lockdep.observed_edges()
+    if args.update_baseline:
+        doc = update_baseline(edges, args.baseline)
+        print(f"baseline written: {args.baseline} "
+              f"({len(doc['edges'])} edge(s), {len(edges)} observed)",
+              file=sys.stderr)
+    elif args.check_baseline:
+        findings = findings + check_edges(edges, load_baseline(
+            args.baseline), args.baseline)
+    if args.dump:
+        n = lockdep.dump_jsonl(args.dump)
+        print(f"dumped {n} record(s) to {args.dump}", file=sys.stderr)
+
+    if args.format == "json":
+        print(json.dumps({
+            "exercises": list(names),
+            "edges": edges,
+            "findings": findings,
+        }))
+    else:
+        for a, b in edges:
+            print(f"edge: {a} -> {b}")
+        for f in findings:
+            print(render(f))
+        print(summary_line(findings), file=sys.stderr)
+    return 1 if any(f["severity"] == "error" for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,17 +39,17 @@ ride along in any ``GET /metrics`` scrape (docs/OBSERVABILITY.md).
 
 from __future__ import annotations
 
-import threading
 from contextlib import contextmanager
 from typing import Any, Dict, List
 
 import jax
 
+from dasmtl.analysis.conc import lockdep
 from dasmtl.obs.registry import default_registry
 
 _COMPILE_EVENT_PREFIX = "/jax/core/compile/backend_compile"
 
-_lock = threading.Lock()
+_lock = lockdep.lock("analysis.guards._lock")
 _listener_registered = False
 _active: List["StepGuards"] = []
 

@@ -47,7 +47,7 @@ TRACING_TRANSFORMS = frozenset({
 
 #: Modules whose import aliases we resolve through.  Anything else keeps its
 #: literal spelling (e.g. ``self.cv_step`` stays ``self.cv_step``).
-_KNOWN_ROOTS = ("jax", "numpy", "functools")
+_KNOWN_ROOTS = ("jax", "numpy", "functools", "threading")
 
 _NOQA_RE = re.compile(
     r"#\s*dasmtl:\s*noqa(?:\[\s*([A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*)\s*\])?")

@@ -53,8 +53,8 @@ def make_finding(ctx: ModuleContext, rule_id: str, node, message: str,
 def all_rules() -> List[Rule]:
     # Import here (not at module top) so the registry modules can import
     # this one without a cycle.
-    from dasmtl.analysis.rules import (donation, dtype,  # noqa: F401
-                                       host_sync, hygiene, loops, prng,
-                                       serve_sync, tracing)
+    from dasmtl.analysis.rules import (concurrency, donation,  # noqa: F401
+                                       dtype, host_sync, hygiene, loops,
+                                       prng, serve_sync, tracing)
 
     return [r for _, r in sorted(_REGISTRY.items())]

@@ -1,0 +1,720 @@
+"""Concurrency-discipline rules — the static half of ``dasmtl-conc``.
+
+The fleet is genuinely threaded (serve dispatcher/collector, router
+probes, stream pump, obs alert/history threads, the data-pipeline
+worker pool), and thread bugs regress silently: PR 8's
+``BatchAssembler`` shape-learning race flaked 1-in-15 under CPU
+contention before it was found by accident.  These rules encode the
+repo's locking conventions the same way DAS101–111 encode its tracing
+conventions:
+
+DAS301 — an attribute shared with a ``Thread`` target (or
+  ``worker_pool`` callback) is mutated outside any ``with <lock>``
+  block, in a class that owns a lock.  Exactly the shape of the PR 8
+  race.
+DAS302 — ``lock.acquire()`` with no ``try/finally`` release discipline
+  in the same function (``with lock:`` is the preferred spelling).
+DAS303 — a blocking call (``.join()``, ``queue.get()`` without
+  timeout, socket/urlopen, ``time.sleep`` > 0, ``jax.device_get`` /
+  ``block_until_ready``) while a lock is held: every other thread
+  contending on that lock now waits on the slow operation too.
+DAS304 — ``Condition.wait()`` not wrapped in a predicate ``while``
+  loop (spurious wakeups and stolen wakeups are legal; a bare ``if``
+  or no re-check at all is a latent hang or lost update).
+DAS305 — double-acquire of the same non-reentrant lock reachable in
+  one call chain (``with self._lock:`` then a call into a method that
+  takes ``self._lock`` again deadlocks the calling thread on itself).
+
+Lock recognition is name-based (the linter's standing contract:
+intra-module, false negatives over false positives): an attribute or
+local assigned from ``threading.Lock/RLock/Condition`` — or from the
+runtime half's instrumented factories ``lockdep.lock/rlock/condition``
+(dasmtl/analysis/conc/lockdep.py), so instrumenting a module never
+blinds the static rules to it.  ``threading.Condition(existing_lock)``
+aliases the wrapped lock: holding the condition *is* holding the lock,
+and both spellings count as the same lock everywhere.  Semaphores are
+deliberately NOT locks here — split acquire/release across threads is
+their legitimate idiom (the serve in-flight window).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dasmtl.analysis.lint import ModuleContext
+from dasmtl.analysis.rules import make_finding, rule
+
+#: Resolved constructor name -> (kind, reentrant).  ``threading.Condition``
+#: with no argument wraps an RLock (stdlib default), so re-entry through a
+#: bare condition is legal; Condition(some_lock) takes the wrapped lock's
+#: reentrancy instead (see _collect_locks).
+_CTOR_KINDS = {
+    "threading.Lock": ("lock", False),
+    "threading.RLock": ("rlock", True),
+    "threading.Condition": ("condition", True),
+}
+
+#: The runtime half's drop-in factories (any import spelling ending in
+#: ``lockdep.<factory>`` counts: ``from dasmtl.analysis.conc import
+#: lockdep`` is the canonical one).
+_LOCKDEP_FACTORIES = {
+    "lockdep.lock": ("lock", False),
+    "lockdep.rlock": ("rlock", True),
+    "lockdep.condition": ("condition", True),
+}
+
+#: Resolved call names that block the host, for DAS303.
+_BLOCKING_NAMES = frozenset({
+    "urllib.request.urlopen", "socket.create_connection",
+    "jax.device_get", "jax.block_until_ready",
+})
+
+
+@dataclasses.dataclass
+class _Lock:
+    key: str          # "self._lock" or a bare local/module name
+    kind: str         # "lock" | "rlock" | "condition"
+    reentrant: bool
+    canonical: str    # Condition(existing) aliases to the wrapped lock
+
+
+@dataclasses.dataclass
+class _Event:
+    """One AST node observed by the held-region scan."""
+
+    node: ast.AST
+    held: frozenset   # canonical lock keys lexically held here
+    in_while: bool    # lexically inside a While of the same function
+
+
+@dataclasses.dataclass
+class _Unit:
+    """One function body analyzed with its visible locks."""
+
+    fn: ast.AST
+    locks: Dict[str, _Lock]
+    events: List[_Event]
+    with_acquires: List[Tuple[ast.AST, frozenset, List[str]]]
+    released_in_finally: Set[str]
+
+
+@dataclasses.dataclass
+class _ClassModel:
+    node: ast.ClassDef
+    methods: Dict[str, ast.AST]
+    locks: Dict[str, _Lock]
+    thread_bodies: List[ast.AST]   # methods/closures run on spawned threads
+    shared: Set[str]               # self.<attr> names touched on threads
+
+
+def _expr_key(node: ast.AST) -> Optional[str]:
+    """The lock-identity key of an expression: ``self.X`` for instance
+    attributes, the bare name for locals/globals, None otherwise."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return f"self.{node.attr}"
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _ctor_kind(ctx: ModuleContext,
+               value: ast.AST) -> Optional[Tuple[str, bool]]:
+    """(kind, reentrant) when ``value`` constructs a recognized lock."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = ctx.resolve(value.func)
+    if name is None:
+        return None
+    hit = _CTOR_KINDS.get(name)
+    if hit:
+        return hit
+    for suffix, info in _LOCKDEP_FACTORIES.items():
+        if name == suffix or name.endswith("." + suffix):
+            return info
+    return None
+
+
+def _collect_locks(ctx: ModuleContext, assigns: List[ast.Assign],
+                   keyer) -> Dict[str, _Lock]:
+    """Build the lock table from a list of Assign statements (in source
+    order, so ``Condition(self._lock)`` sees the lock it wraps)."""
+    locks: Dict[str, _Lock] = {}
+    for stmt in assigns:
+        info = _ctor_kind(ctx, stmt.value)
+        if info is None:
+            continue
+        kind, reentrant = info
+        for target in stmt.targets:
+            key = keyer(target)
+            if key is None:
+                continue
+            canonical = key
+            if kind == "condition" and stmt.value.args:
+                wrapped = keyer(stmt.value.args[0])
+                if wrapped is not None:
+                    base = locks.get(wrapped)
+                    if base is not None:
+                        canonical = base.canonical
+                        reentrant = base.reentrant
+                    else:
+                        canonical = wrapped
+                        reentrant = False  # plain-Lock assumption
+            locks[key] = _Lock(key, kind, reentrant, canonical)
+    return locks
+
+
+def _assigns_in(node: ast.AST, *, stop_at_defs: bool) -> List[ast.Assign]:
+    """Assign statements under ``node`` in source order."""
+    out = []
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop(0)
+        if isinstance(n, ast.Assign):
+            out.append(n)
+        if stop_at_defs and isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _acquire_key(stmt: ast.AST) -> Optional[str]:
+    """Key when ``stmt`` is a bare ``<key>.acquire(...)`` expression."""
+    if (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)
+            and isinstance(stmt.value.func, ast.Attribute)
+            and stmt.value.func.attr == "acquire"):
+        return _expr_key(stmt.value.func.value)
+    return None
+
+
+def _releases(stmts: List[ast.AST], key: str) -> bool:
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "release"
+                    and _expr_key(node.func.value) == key):
+                return True
+    return False
+
+
+def _scan_unit(ctx: ModuleContext, fn: ast.AST,
+               locks: Dict[str, _Lock]) -> _Unit:
+    """Lexical held-lock scan of one function body.  Recognizes both
+    ``with lock:`` bodies and the ``acquire(); try: ... finally:
+    release()`` pattern; does not descend into nested defs (they run
+    later, possibly on another thread — each gets its own unit)."""
+    events: List[_Event] = []
+    with_acquires: List[Tuple[ast.AST, frozenset, List[str]]] = []
+    released: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Try):
+            for key in locks:
+                if _releases(node.finalbody, key):
+                    released.add(key)
+
+    def canon(key: str) -> str:
+        return locks[key].canonical
+
+    def record_expr(node: ast.AST, held: frozenset, in_while: bool) -> None:
+        """Record node + every sub-node, stopping at nested defs."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            events.append(_Event(n, held, in_while))
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+    def scan_stmt(stmt: ast.AST, held: frozenset, in_while: bool) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            events.append(_Event(stmt, held, in_while))
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in stmt.items:
+                key = _expr_key(item.context_expr)
+                if key in locks:
+                    acquired.append(key)
+                record_expr(item.context_expr, held, in_while)
+                if item.optional_vars is not None:
+                    record_expr(item.optional_vars, held, in_while)
+            events.append(_Event(stmt, held, in_while))
+            with_acquires.append((stmt, held, acquired))
+            inner = held | {canon(k) for k in acquired}
+            scan_stmts(stmt.body, inner, in_while)
+            return
+        if isinstance(stmt, ast.While):
+            events.append(_Event(stmt, held, in_while))
+            record_expr(stmt.test, held, in_while)
+            scan_stmts(stmt.body, held, True)
+            scan_stmts(stmt.orelse, held, in_while)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            events.append(_Event(stmt, held, in_while))
+            record_expr(stmt.target, held, in_while)
+            record_expr(stmt.iter, held, in_while)
+            scan_stmts(stmt.body, held, in_while)
+            scan_stmts(stmt.orelse, held, in_while)
+            return
+        if isinstance(stmt, ast.If):
+            events.append(_Event(stmt, held, in_while))
+            record_expr(stmt.test, held, in_while)
+            scan_stmts(stmt.body, held, in_while)
+            scan_stmts(stmt.orelse, held, in_while)
+            return
+        if isinstance(stmt, ast.Try):
+            events.append(_Event(stmt, held, in_while))
+            scan_stmts(stmt.body, held, in_while)
+            for handler in stmt.handlers:
+                scan_stmts(handler.body, held, in_while)
+            scan_stmts(stmt.orelse, held, in_while)
+            scan_stmts(stmt.finalbody, held, in_while)
+            return
+        record_expr(stmt, held, in_while)
+
+    def scan_stmts(stmts: List[ast.AST], held: frozenset,
+                   in_while: bool) -> None:
+        i = 0
+        while i < len(stmts):
+            stmt = stmts[i]
+            key = _acquire_key(stmt)
+            if (key in locks and i + 1 < len(stmts)
+                    and isinstance(stmts[i + 1], ast.Try)
+                    and _releases(stmts[i + 1].finalbody, key)):
+                # acquire(); try: <held> finally: release()
+                record_expr(stmt, held, in_while)
+                t = stmts[i + 1]
+                inner = held | {canon(key)}
+                events.append(_Event(t, held, in_while))
+                scan_stmts(t.body, inner, in_while)
+                for handler in t.handlers:
+                    scan_stmts(handler.body, inner, in_while)
+                scan_stmts(t.orelse, inner, in_while)
+                scan_stmts(t.finalbody, held, in_while)
+                i += 2
+                continue
+            scan_stmt(stmt, held, in_while)
+            i += 1
+
+    scan_stmts(list(getattr(fn, "body", [])), frozenset(), False)
+    return _Unit(fn, locks, events, with_acquires, released)
+
+
+def _nested_defs(fn: ast.AST) -> List[ast.AST]:
+    out = []
+    for node in ast.walk(fn):
+        if node is not fn and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append(node)
+    return out
+
+
+def _thread_bodies(ctx: ModuleContext,
+                   model: "_ClassModel") -> List[ast.AST]:
+    """Methods (``target=self._run``) and method-nested closures
+    (``target=pump``) this class hands to ``threading.Thread`` or
+    ``worker_pool`` — the code that runs concurrently with callers."""
+    bodies: List[ast.AST] = []
+    for method in model.methods.values():
+        nested = {f.name: f for f in _nested_defs(method)}
+        for call in ast.walk(method):
+            if not isinstance(call, ast.Call):
+                continue
+            name = ctx.resolve(call.func) or ""
+            callback: Optional[ast.AST] = None
+            if name.endswith("threading.Thread") or name == "Thread":
+                for kw in call.keywords:
+                    if kw.arg == "target":
+                        callback = kw.value
+            elif name.endswith("worker_pool") and call.args:
+                callback = call.args[0]
+            if callback is None:
+                continue
+            key = _expr_key(callback)
+            if key and key.startswith("self."):
+                m = model.methods.get(key[5:])
+                if m is not None:
+                    bodies.append(m)
+            elif isinstance(callback, ast.Name):
+                f = nested.get(callback.id)
+                if f is not None:
+                    bodies.append(f)
+    return bodies
+
+
+def _class_models(ctx: ModuleContext) -> List[_ClassModel]:
+    models = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        methods = {s.name: s for s in node.body
+                   if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        assigns: List[ast.Assign] = []
+        for m in methods.values():
+            assigns.extend(_assigns_in(m, stop_at_defs=True))
+        locks = _collect_locks(
+            ctx, assigns,
+            lambda t: _expr_key(t) if (_expr_key(t) or "").startswith(
+                "self.") else None)
+        if not locks:
+            continue
+        model = _ClassModel(node, methods, locks, [], set())
+        model.thread_bodies = _thread_bodies(ctx, model)
+        if not model.thread_bodies:
+            models.append(model)
+            continue
+        # Shared attrs: every self.<attr> the thread bodies touch,
+        # closed over same-class method calls (the collector thread's
+        # helpers mutate state just as concurrently as the loop itself).
+        seen: Set[ast.AST] = set()
+        frontier = list(model.thread_bodies)
+        while frontier:
+            body = frontier.pop()
+            if body in seen:
+                continue
+            seen.add(body)
+            for sub in ast.walk(body):
+                if (isinstance(sub, ast.Attribute)
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id == "self"):
+                    model.shared.add(sub.attr)
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and isinstance(sub.func.value, ast.Name)
+                        and sub.func.value.id == "self"):
+                    callee = model.methods.get(sub.func.attr)
+                    if callee is not None and callee not in seen:
+                        frontier.append(callee)
+        models.append(model)
+    return models
+
+
+def _module_locks(ctx: ModuleContext) -> Dict[str, _Lock]:
+    assigns = [s for s in ctx.tree.body if isinstance(s, ast.Assign)]
+    return _collect_locks(
+        ctx, assigns,
+        lambda t: t.id if isinstance(t, ast.Name) else None)
+
+
+def _analyze(ctx: ModuleContext):
+    """Memoized whole-module concurrency model: class models plus one
+    scanned unit per function (locks visible = module-level locks +
+    owning-class ``self.*`` locks + own and enclosing-function locals —
+    closures hold their parent's locks by reference)."""
+    cached = getattr(ctx, "_conc_analysis", None)
+    if cached is not None:
+        return cached
+    classes = _class_models(ctx)
+    mod_locks = _module_locks(ctx)
+    method_class: Dict[ast.AST, _ClassModel] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ClassDef):
+            model = next((c for c in classes if c.node is node), None)
+            if model is None:
+                continue
+            for m in model.methods.values():
+                method_class[m] = model
+
+    def local_locks(fn: ast.AST) -> Dict[str, _Lock]:
+        return _collect_locks(
+            ctx, _assigns_in(fn, stop_at_defs=True),
+            lambda t: t.id if isinstance(t, ast.Name) else None)
+
+    units: List[_Unit] = []
+
+    def visit_scope(node: ast.AST, inherited: Dict[str, _Lock]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                locks = dict(inherited)
+                model = method_class.get(child)
+                if model is not None:
+                    locks.update(model.locks)
+                locks.update(local_locks(child))
+                units.append(_scan_unit(ctx, child, locks))
+                visit_scope(child, locks)
+            else:
+                visit_scope(child, inherited)
+
+    visit_scope(ctx.tree, mod_locks)
+    result = (classes, units)
+    ctx._conc_analysis = result
+    return result
+
+
+def _lock_names(locks: Dict[str, _Lock], held: frozenset) -> str:
+    return ", ".join(sorted(held))
+
+
+# -- DAS301: unguarded mutation of thread-shared attributes -----------------
+
+@rule("DAS301", "warning",
+      "attribute shared with a thread target mutated outside any lock")
+def check_shared_mutation(ctx: ModuleContext) -> Iterator:
+    classes, units = _analyze(ctx)
+    unit_by_fn = {u.fn: u for u in units}
+    for model in classes:
+        if not model.thread_bodies or not model.shared:
+            continue
+        thread_names = sorted({getattr(b, "name", "?")
+                               for b in model.thread_bodies})
+        scan_fns: List[ast.AST] = []
+        for m in model.methods.values():
+            if m.name in ("__init__", "__post_init__"):
+                continue
+            scan_fns.append(m)
+            scan_fns.extend(_nested_defs(m))
+        for fn in scan_fns:
+            unit = unit_by_fn.get(fn)
+            if unit is None:
+                continue
+            for ev in unit.events:
+                if not isinstance(ev.node, (ast.Assign, ast.AugAssign,
+                                            ast.AnnAssign)):
+                    continue
+                if ev.held:
+                    continue
+                targets = (ev.node.targets
+                           if isinstance(ev.node, ast.Assign)
+                           else [ev.node.target])
+                for target in targets:
+                    for t in _flatten_targets(target):
+                        attr = _mutated_self_attr(t)
+                        if attr is None or attr not in model.shared:
+                            continue
+                        yield make_finding(
+                            ctx, "DAS301", ev.node,
+                            f"self.{attr} is shared with thread target "
+                            f"{'/'.join(thread_names)}() but mutated "
+                            f"outside any `with <lock>` block — the "
+                            f"class owns "
+                            f"{_lock_names(model.locks, frozenset(model.locks))}"
+                            f" (the PR 8 BatchAssembler race shape)")
+
+
+def _flatten_targets(target: ast.AST) -> List[ast.AST]:
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out = []
+        for el in target.elts:
+            out.extend(_flatten_targets(el))
+        return out
+    return [target]
+
+
+def _mutated_self_attr(target: ast.AST) -> Optional[str]:
+    """Attr name when ``target`` writes ``self.X`` or ``self.X[...]``."""
+    if isinstance(target, ast.Subscript):
+        target = target.value
+    if (isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"):
+        return target.attr
+    return None
+
+
+# -- DAS302: acquire without release discipline ------------------------------
+
+@rule("DAS302", "error",
+      "Lock.acquire() without try/finally release (use `with lock:`)")
+def check_acquire_release(ctx: ModuleContext) -> Iterator:
+    _, units = _analyze(ctx)
+    for unit in units:
+        for ev in unit.events:
+            node = ev.node
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"):
+                continue
+            key = _expr_key(node.func.value)
+            if key not in unit.locks:
+                continue
+            if key in unit.released_in_finally:
+                continue
+            yield make_finding(
+                ctx, "DAS302", node,
+                f"{key}.acquire() has no try/finally release in this "
+                f"function — an exception between acquire and release "
+                f"wedges every other thread; spell it `with {key}:` "
+                f"(or release in a finally)")
+
+
+# -- DAS303: blocking call while a lock is held ------------------------------
+
+@rule("DAS303", "warning",
+      "blocking call while holding a lock")
+def check_blocking_under_lock(ctx: ModuleContext) -> Iterator:
+    _, units = _analyze(ctx)
+    for unit in units:
+        for ev in unit.events:
+            if not ev.held or not isinstance(ev.node, ast.Call):
+                continue
+            reason = _blocking_reason(ctx, ev.node)
+            if reason is None:
+                continue
+            yield make_finding(
+                ctx, "DAS303", ev.node,
+                f"{reason} while holding {_lock_names(unit.locks, ev.held)}"
+                f" — every thread contending on that lock now waits on "
+                f"this too; move the blocking work outside the lock "
+                f"(snapshot under the lock, block after)")
+
+
+def _blocking_reason(ctx: ModuleContext, node: ast.Call) -> Optional[str]:
+    name = ctx.resolve(node.func)
+    if name == "time.sleep":
+        if node.args and isinstance(node.args[0], ast.Constant):
+            try:
+                if float(node.args[0].value) <= 0:
+                    return None
+            except (TypeError, ValueError):
+                pass
+        return "time.sleep()"
+    if name in _BLOCKING_NAMES:
+        return f"{name}()"
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    attr = node.func.attr
+    if attr == "block_until_ready":
+        return ".block_until_ready()"
+    if attr == "join":
+        # str/path join lookalikes: constant receiver ("," .join), an
+        # os.path-style receiver, a comprehension/constant argument, or
+        # >= 2 positional args.  Thread.join takes at most a timeout.
+        if isinstance(node.func.value, ast.Constant):
+            return None
+        if name is not None and name.endswith("path.join"):
+            return None
+        if len(node.args) >= 2:
+            return None
+        if node.args and isinstance(
+                node.args[0], (ast.GeneratorExp, ast.ListComp, ast.SetComp,
+                               ast.Constant)):
+            return None
+        return ".join()"
+    if attr == "get" and not node.args:
+        kwargs = {kw.arg for kw in node.keywords}
+        if "timeout" in kwargs:
+            return None
+        for kw in node.keywords:
+            if (kw.arg == "block" and isinstance(kw.value, ast.Constant)
+                    and not kw.value.value):
+                return None
+        return "queue.get() without a timeout"
+    return None
+
+
+# -- DAS304: Condition.wait outside a predicate while loop ------------------
+
+@rule("DAS304", "error",
+      "Condition.wait() not wrapped in a predicate while loop")
+def check_condition_wait(ctx: ModuleContext) -> Iterator:
+    _, units = _analyze(ctx)
+    for unit in units:
+        for ev in unit.events:
+            node = ev.node
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "wait"):
+                continue
+            key = _expr_key(node.func.value)
+            lock = unit.locks.get(key)
+            if lock is None or lock.kind != "condition":
+                continue
+            if ev.in_while:
+                continue
+            yield make_finding(
+                ctx, "DAS304", node,
+                f"{key}.wait() outside a `while <predicate>:` loop — "
+                f"spurious and stolen wakeups are legal, so the "
+                f"predicate must be re-checked after every wait "
+                f"(use `while not ready: {key}.wait()`)")
+
+
+# -- DAS305: reachable double-acquire of a non-reentrant lock ---------------
+
+@rule("DAS305", "error",
+      "double-acquire of a non-reentrant lock reachable in one call chain")
+def check_double_acquire(ctx: ModuleContext) -> Iterator:
+    classes, units = _analyze(ctx)
+    unit_by_fn = {u.fn: u for u in units}
+    for model in classes:
+        canon_reentrant = {}
+        for lock in model.locks.values():
+            canon_reentrant.setdefault(lock.canonical, lock.reentrant)
+
+        # Locks each method with-acquires directly, then transitively
+        # through same-class calls (memoized, cycle-safe).
+        direct: Dict[str, Set[str]] = {}
+        for name, m in model.methods.items():
+            acquired: Set[str] = set()
+            for fn in [m] + _nested_defs(m):
+                unit = unit_by_fn.get(fn)
+                if unit is None:
+                    continue
+                for _stmt, _held, keys in unit.with_acquires:
+                    acquired.update(unit.locks[k].canonical for k in keys)
+            direct[name] = acquired
+
+        reach: Dict[str, Set[str]] = {}
+
+        def reachable(name: str, stack: Set[str]) -> Set[str]:
+            if name in reach:
+                return reach[name]
+            if name in stack:
+                return direct.get(name, set())
+            stack = stack | {name}
+            acc = set(direct.get(name, set()))
+            m = model.methods.get(name)
+            if m is not None:
+                for sub in ast.walk(m):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and isinstance(sub.func.value, ast.Name)
+                            and sub.func.value.id == "self"
+                            and sub.func.attr in model.methods):
+                        acc |= reachable(sub.func.attr, stack)
+            reach[name] = acc
+            return acc
+
+        for name, m in model.methods.items():
+            for fn in [m] + _nested_defs(m):
+                unit = unit_by_fn.get(fn)
+                if unit is None:
+                    continue
+                # Direct re-entry: with L: ... with L: (same canonical).
+                for stmt, held, keys in unit.with_acquires:
+                    for k in keys:
+                        c = unit.locks[k].canonical
+                        if c in held and not canon_reentrant.get(c, True):
+                            yield make_finding(
+                                ctx, "DAS305", stmt,
+                                f"`with {k}:` while {c} is already held "
+                                f"— a non-reentrant lock deadlocks its "
+                                f"own thread on re-acquire")
+                # Reachable re-entry: call into a method that takes the
+                # held lock again.
+                for ev in unit.events:
+                    node = ev.node
+                    if not (ev.held and isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and isinstance(node.func.value, ast.Name)
+                            and node.func.value.id == "self"
+                            and node.func.attr in model.methods):
+                        continue
+                    callee = node.func.attr
+                    overlap = {
+                        c for c in (reachable(callee, set()) & ev.held)
+                        if not canon_reentrant.get(c, True)}
+                    for c in sorted(overlap):
+                        yield make_finding(
+                            ctx, "DAS305", node,
+                            f"self.{callee}() acquires {c}, which this "
+                            f"call chain already holds — a non-reentrant "
+                            f"lock deadlocks its own thread on "
+                            f"re-acquire")

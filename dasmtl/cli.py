@@ -107,6 +107,19 @@ def sanitize_main(argv=None) -> int:
     return main(argv)
 
 
+def conc_main(argv=None) -> int:
+    """``dasmtl-conc`` — the concurrency suite
+    (dasmtl/analysis/conc/; DAS301-DAS305 + CONC40x in
+    docs/STATIC_ANALYSIS.md).  Drives the serve + stream selftests with
+    runtime lockdep armed on a CPU backend it pins itself, gates the
+    observed lock-order graph against the committed baseline, and
+    proves itself by seeded fault injection (--self-test)."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from dasmtl.analysis.conc.runner import main
+
+    return main(argv)
+
+
 def obs_main(argv=None) -> int:
     """``dasmtl-obs`` — the unified telemetry layer's CLI
     (dasmtl/obs/; docs/OBSERVABILITY.md): ``dump`` span records or
@@ -155,6 +168,8 @@ _SUBCOMMANDS = {
     "audit": (audit_main, "compile-time HLO/cost auditor (dasmtl-audit)"),
     "sanitize": (sanitize_main,
                  "runtime SPMD sanitizer suite (dasmtl-sanitize)"),
+    "conc": (conc_main, "concurrency suite: runtime lockdep + "
+                        "lock-order baseline (dasmtl-conc)"),
     "obs": (obs_main, "telemetry: trace dump/join, exposition check, "
                       "alert selftest, profiler capture+analyze "
                       "(dasmtl-obs)"),

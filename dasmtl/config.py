@@ -304,6 +304,15 @@ class Config:
     obs_alerts_webhook: str = ""
     obs_alerts_webhook_retries: int = 3
     obs_alerts_webhook_backoff_s: float = 0.25
+    # Runtime lockdep (dasmtl/analysis/conc/lockdep.py): off by default —
+    # disabled factories hand back plain threading primitives, zero
+    # overhead.  Selftests and the CI conc job arm it (also via
+    # DASMTL_CONC_LOCKDEP=1) to record the lock-acquisition-order graph,
+    # flag order cycles / holds over conc_hold_warn_ms, and diff the
+    # graph against artifacts/lockorder_baseline.json.
+    conc_lockdep: bool = False
+    conc_hold_warn_ms: float = 200.0
+    conc_dump_path: Optional[str] = None  # JSONL findings dump at exit
 
     # ---- misc ----
     seed: int = 1
@@ -467,6 +476,9 @@ class Config:
             raise ValueError("obs_alerts_webhook_retries must be >= 0")
         if self.obs_alerts_webhook_backoff_s < 0:
             raise ValueError("obs_alerts_webhook_backoff_s must be >= 0")
+        if self.conc_hold_warn_ms <= 0:
+            raise ValueError("conc_hold_warn_ms must be > 0 (gate the "
+                             "tracker itself with conc_lockdep)")
 
     @property
     def decay_at_epoch0(self) -> bool:
@@ -932,6 +944,19 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
                    default=d.obs_alerts_webhook_backoff_s,
                    help="initial webhook retry backoff (doubles per "
                         "attempt)")
+    p.add_argument("--conc_lockdep", action=argparse.BooleanOptionalAction,
+                   default=d.conc_lockdep,
+                   help="arm runtime lock-order tracking (lockdep): "
+                        "record the acquisition-order graph, flag order "
+                        "cycles and long holds (dasmtl-conc)")
+    p.add_argument("--conc_hold_warn_ms", type=float,
+                   default=d.conc_hold_warn_ms,
+                   help="lock hold time above which lockdep records a "
+                        "long-hold finding")
+    p.add_argument("--conc_dump_path", type=str,
+                   default=d.conc_dump_path,
+                   help="JSONL path for the lockdep graph + findings "
+                        "dump at process exit (requires --conc_lockdep)")
 
 
 def _resolve_compat(ns: argparse.Namespace) -> dict:

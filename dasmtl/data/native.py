@@ -18,10 +18,11 @@ import hashlib
 import os
 import subprocess
 import tempfile
-import threading
 from typing import Optional, Sequence
 
 import numpy as np
+
+from dasmtl.analysis.conc import lockdep
 
 _ERROR_NAMES = {
     0: "OK", 1: "EIO (cannot read file)", 2: "EFORMAT (MAT-5 parse error)",
@@ -33,7 +34,7 @@ _ERROR_NAMES = {
 _SRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native", "dasmat.cpp")
 
-_lock = threading.Lock()
+_lock = lockdep.lock("data.native._lock")
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 _mode = "auto"  # auto | on | off — Config.loader_native, via configure()

@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, Iterator, Optional
 
 import numpy as np
 
+from dasmtl.analysis.conc import lockdep
 from dasmtl.data.sources import _SourceBase
 from dasmtl.data.staging import StagingBuffers
 
@@ -99,6 +100,9 @@ def prefetch(iterator: Iterator, depth: int = 2,
             except queue.Empty:
                 break
         thread.join(timeout=5.0)
+        # Lockdep-mode watchdog (no-op otherwise): a worker that survived
+        # the 5s join deadline is a leak, not a timing detail.
+        lockdep.assert_joined([thread], "prefetch abandon-join")
 
 
 def worker_pool(items: Iterator, work_fn: Callable, *, workers: int = 2,
@@ -124,7 +128,7 @@ def worker_pool(items: Iterator, work_fn: Callable, *, workers: int = 2,
         return
     depth = max(int(depth), int(workers))
     it = iter(items)
-    cond = threading.Condition()
+    cond = lockdep.condition("worker_pool.cond")
     state = {"next_in": 0, "next_out": 0, "exhausted": False, "stop": False}
     results: Dict[int, tuple] = {}  # seq -> ("ok", value) | ("err", exc)
 
@@ -183,6 +187,7 @@ def worker_pool(items: Iterator, work_fn: Callable, *, workers: int = 2,
             cond.notify_all()
         for t in threads:
             t.join(timeout=5.0)
+        lockdep.assert_joined(threads, "worker_pool drain")
 
 
 #: Padding fill value per batch key.  Anything not listed pads with zeros;
@@ -274,7 +279,7 @@ class BatchAssembler:
         self.staging = staging or StagingBuffers(depth=depth)
         self.noise_seed = int(getattr(source, "noise_seed", 0) or 0)
         self._slot = ("train_batch", self.batch_size)
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("BatchAssembler._lock")
 
     def assemble(self, idx: np.ndarray,
                  rng: Optional[np.random.Generator] = None) -> StagedBatch:

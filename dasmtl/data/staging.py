@@ -35,10 +35,11 @@ backends DMA-copy and reuse the pool unchanged.
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, Hashable, Optional, Sequence, Tuple
 
 import numpy as np
+
+from dasmtl.analysis.conc import lockdep
 
 #: spec leaf: (shape tuple, numpy dtype)
 SpecLeaf = Tuple[tuple, Any]
@@ -132,8 +133,9 @@ class StagingBuffers:
     def __init__(self, specs: Optional[Dict[Hashable, Any]] = None, *,
                  depth: int = 2):
         self.depth = max(1, int(depth))
-        self._lock = threading.Lock()
-        self._available = threading.Condition(self._lock)
+        self._lock = lockdep.lock("StagingBuffers._lock")
+        self._available = lockdep.condition("StagingBuffers._available",
+                                            self._lock)
         self._free: Dict[Hashable, list] = {}
         self._specs: Dict[Hashable, Any] = {}
         self._out: Dict[int, Hashable] = {}  # id(buf) -> slot key

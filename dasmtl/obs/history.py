@@ -31,6 +31,8 @@ import time
 from collections import deque
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
+from dasmtl.analysis.conc import lockdep
+
 from dasmtl.obs.registry import escape_label_value, parse_exposition
 
 #: One snapshot's payload: ``{family: {(sample_name, labels): value}}``
@@ -69,7 +71,7 @@ class MetricsHistory:
             raise ValueError("MetricsHistory capacity must be >= 1")
         self.capacity = int(capacity)
         self.families_filter = frozenset(families) if families else None
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("MetricsHistory._lock")
         self._ring: deque = deque(maxlen=self.capacity)
         self._recorded = 0
 

@@ -38,6 +38,8 @@ import time
 from collections import defaultdict
 from typing import List, Optional
 
+from dasmtl.analysis.conc import lockdep
+
 
 class ProfilerHook:
     """Rate-limited arm/capture gate over ``jax.profiler``.
@@ -54,7 +56,7 @@ class ProfilerHook:
         self.duration_s = float(duration_s)
         self.clock = clock
         self._capture_fn = capture_fn or _jax_capture
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("ProfilerHook._lock")
         self._last_trigger: Optional[float] = None
         self._active: Optional[threading.Thread] = None
         self.captures = 0

@@ -229,6 +229,10 @@ class MetricsRegistry:
     """
 
     def __init__(self) -> None:
+        # Deliberately a PLAIN lock, not lockdep.lock(): lockdep's
+        # publish() writes into this registry, so a tracked lock
+        # here would re-enter the tracker (see the recursion-hazard
+        # note in dasmtl/analysis/conc/lockdep.py).
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}
         self._callbacks: List[Callable[[], None]] = []

@@ -27,9 +27,10 @@ from __future__ import annotations
 import itertools
 import json
 import os
-import threading
 from collections import deque
 from typing import Iterable, List, Optional
+
+from dasmtl.analysis.conc import lockdep
 
 #: The canonical span chain of one served request, in pipeline order.
 SPAN_STAGES = ("submit", "queue", "form", "dispatch", "collect", "resolve")
@@ -84,7 +85,7 @@ class TraceRing:
         if capacity < 1:
             raise ValueError("TraceRing capacity must be >= 1")
         self.capacity = int(capacity)
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("TraceRing._lock")
         self._spans: deque = deque(maxlen=self.capacity)
         self._recorded = 0
 

@@ -133,6 +133,22 @@ def main(argv=None) -> int:
     obs.add_argument("--history_interval_s", type=float,
                      default=d.obs_history_interval_s,
                      help="seconds between history snapshots")
+    conc = p.add_argument_group("concurrency lockdep (dasmtl-conc, "
+                                "docs/STATIC_ANALYSIS.md)")
+    conc.add_argument("--conc_lockdep",
+                      action=argparse.BooleanOptionalAction,
+                      default=d.conc_lockdep,
+                      help="arm runtime lock-order tracking: record the "
+                           "acquisition graph, flag order cycles and "
+                           "long holds (also DASMTL_CONC_LOCKDEP=1)")
+    conc.add_argument("--conc_hold_warn_ms", type=float,
+                      default=d.conc_hold_warn_ms,
+                      help="lock hold time above which lockdep records "
+                           "a long-hold finding")
+    conc.add_argument("--conc_dump_path", type=str,
+                      default=d.conc_dump_path, metavar="PATH",
+                      help="write the lockdep graph + findings as JSONL "
+                           "at exit")
     p.add_argument("--parity-check", action="store_true",
                    dest="parity_check",
                    help="run the precision parity gate instead of "
@@ -160,6 +176,12 @@ def main(argv=None) -> int:
     from dasmtl.utils.platform import apply_device
 
     apply_device(args.device)
+
+    # Arm lockdep BEFORE any ServeLoop/selftest lock is constructed —
+    # the factories consult the tracker at construction time.
+    from dasmtl.analysis.conc import lockdep
+
+    lockdep.configure(args)
 
     if args.selftest:
         from dasmtl.serve.selftest import run_selftest, write_job_summary

@@ -26,12 +26,12 @@ threaded server stays thin.
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
 from typing import List, Optional, Sequence
 
 import numpy as np
 
+from dasmtl.analysis.conc import lockdep
 from dasmtl.data.pipeline import pad_to_bucket
 #: Re-export: the per-bucket staging freelist started here (PR 5) and now
 #: lives in the shared home both training and serving assemble through.
@@ -117,7 +117,7 @@ class MicroBatcher:
         self.metrics = metrics or ServeMetrics()
         self.tracer = tracer
         self._queue = RequestQueue(queue_depth, watermark)
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("MicroBatcher._lock")
         self._next_id = 0
         self._draining = False
 

@@ -26,10 +26,11 @@ dispatched batch and is the one to watch when tuning ``serve_buckets`` and
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, Optional, Sequence
 
 import numpy as np
+
+from dasmtl.analysis.conc import lockdep
 
 from dasmtl.obs.registry import (DEFAULT_LATENCY_BUCKETS_S,
                                  OCCUPANCY_BUCKETS, MetricsRegistry)
@@ -55,7 +56,7 @@ class ServeMetrics:
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  latency_buckets_s: Optional[Sequence[float]] = None,
                  observe_registry: bool = True) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("ServeMetrics._lock")
         self._outcomes: Dict[str, int] = {k: 0 for k in OUTCOMES}
         self._submitted = 0
         self._latencies: list = []

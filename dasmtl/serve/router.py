@@ -49,6 +49,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence
 from urllib.parse import parse_qs, urlsplit
 
+from dasmtl.analysis.conc import lockdep
 from dasmtl.obs.registry import (MetricsRegistry, escape_label_value,
                                  parse_exposition, render_prometheus)
 from dasmtl.obs.trace import TraceRing, make_span, mint_trace_id
@@ -162,7 +163,7 @@ class Router:
         #: Optional MetricsHistory behind GET /query (set by main()/tests).
         self.history = history
         self._req_ids = itertools.count()
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("Router._lock")
         self._stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
         self._rollout_thread: Optional[threading.Thread] = None

@@ -85,11 +85,13 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
     NaN-rejection path (bf16 carries NaN like f32 does).  ``obs_check``
     adds the telemetry leg: mid-load /metrics scrapes over a real HTTP
     front end and a seeded SLO breach through the profiler hook."""
+    from dasmtl.analysis.conc import lockdep
     from dasmtl.obs.profiler import ProfilerHook
     from dasmtl.serve.executor import ExecutorPool
     from dasmtl.serve.server import (ServeLoop, install_signal_handlers,
                                      make_http_server)
 
+    conc0 = lockdep.snapshot()
     executor = ExecutorPool.from_checkpoint(model, None, buckets,
                                             input_hw=input_hw,
                                             devices=devices,
@@ -319,9 +321,21 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
         if profile_dir is not None:
             shutil.rmtree(profile_dir, ignore_errors=True)
 
+    # Lockdep leg (armed by CI / dasmtl-conc, {"enabled": False}
+    # otherwise): the soak must add zero lock-order cycles and zero
+    # unjoined threads to the acquisition graph.
+    conc_failures, conc_report = lockdep.clean_since(conc0)
+    failures.extend(conc_failures)
+    if conc_report["enabled"]:
+        say(f"[serve-selftest] lockdep: {conc_report['edges']} edge(s), "
+            f"{conc_report['cycles']} cycle(s), "
+            f"{conc_report['unjoined']} unjoined, "
+            f"{conc_report['long_holds']} long hold(s)")
+
     report = {
         "passed": not failures,
         "failures": failures,
+        "lockdep": conc_report,
         "precision": precision,
         "requests": requests,
         "ok": n_ok,

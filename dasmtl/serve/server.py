@@ -81,6 +81,7 @@ from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
+from dasmtl.analysis.conc import lockdep
 from dasmtl.obs.registry import default_registry, render_prometheus
 from dasmtl.obs.trace import TraceRing, make_span
 from dasmtl.serve.batcher import BatchPlan, MicroBatcher, StagingBuffers
@@ -147,7 +148,7 @@ class ServeLoop:
             buckets, getattr(executor, "input_hw", (1, 1)),
             depth=self.inflight_window + 1,
             dtype=getattr(executor, "input_dtype", np.float32))
-        self._cv = threading.Condition()
+        self._cv = lockdep.condition("ServeLoop._cv")
         self._stop = False
         self._slots = threading.BoundedSemaphore(self.inflight_window)
         self._completion: "_queue.Queue" = _queue.Queue()
@@ -163,7 +164,7 @@ class ServeLoop:
         self.generation = 1
         self._outstanding: dict = {}
         self._retired: list = []
-        self._swap_lock = threading.Lock()
+        self._swap_lock = lockdep.lock("ServeLoop._swap_lock")
         self._swap = {"state": "idle"}
 
     # -- lifecycle -----------------------------------------------------------
@@ -202,6 +203,9 @@ class ServeLoop:
                     else max(0.0, deadline - time.monotonic()))
             t.join(left)
             if t.is_alive():
+                # Lockdep-mode watchdog (no-op otherwise): surface the
+                # straggler as a named failure instead of a silent False.
+                lockdep.assert_joined([t], "ServeLoop.drain")
                 return False
         return True
 
@@ -505,7 +509,9 @@ class ServeLoop:
         if (self.slo_p99_ms <= 0 or self.profiler is None
                 or now - self._slo_checked < 1.0):
             return
-        self._slo_checked = now
+        # Single writer: only the collector thread reaches this method
+        # (via _resolve_plan), so the cadence stamp needs no lock.
+        self._slo_checked = now  # dasmtl: noqa[DAS301]
         p99 = self.metrics.latency_p99_ms()
         if p99 > self.slo_p99_ms:
             self.profiler.maybe_trigger(
@@ -535,10 +541,12 @@ class ServeLoop:
         mirroring + span tracing) with FRESH counters either way — the
         ``bench_serve.py --obs`` A/B legs measure the overhead on the
         same warmed loop."""
-        self.metrics = self.batcher.metrics = ServeMetrics(
-            observe_registry=enabled)
-        self.tracer = self.batcher.tracer = (
-            TraceRing(self._trace_ring_size or 4096) if enabled else None)
+        with self._cv:  # atomic swap vs the dispatcher/collector readers
+            self.metrics = self.batcher.metrics = ServeMetrics(
+                observe_registry=enabled)
+            self.tracer = self.batcher.tracer = (
+                TraceRing(self._trace_ring_size or 4096) if enabled
+                else None)
 
     def stats(self) -> dict:
         snap = self.metrics.snapshot()

@@ -40,6 +40,7 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from dasmtl.analysis.conc import lockdep
 from dasmtl.obs.alerts import AlertEngine, AlertRule
 from dasmtl.obs.history import MetricsHistory, handle_query
 from dasmtl.obs.registry import (DEFAULT_LATENCY_BUCKETS_S, MetricsRegistry)
@@ -232,7 +233,7 @@ class StreamLoop:
         self.adapt_weights = bool(adapt_weights)
         self.adapt_every = max(1, int(adapt_every))
         self._apply_weights()
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("StreamLoop._lock")
         # Device-resident data plane (docs/STREAMING.md): when it
         # engages, each tenant's host ring is replaced by an on-device
         # ResidentFeed lane and its cycle submits ONE fused dispatch
@@ -353,7 +354,8 @@ class StreamLoop:
                                               want_log_probs=True)
                 fut.add_done_callback(
                     lambda f, t=t, wdw=wdw: self._on_result(t, wdw, f))
-        self.cycles += 1
+        with self._lock:  # stats() reads cycles off the HTTP thread
+            self.cycles += 1
         if self.adapt_weights and self.cycles % self.adapt_every == 0:
             self._adapt_weights()
         if self.alerts is not None:
@@ -528,17 +530,23 @@ class StreamLoop:
 
     def close(self) -> None:
         self.begin_drain()
-        if self._collector is not None:
+        # Detach under the lock, close outside it: late done-callbacks on
+        # the serve collector thread still write the events file through
+        # _publish_records (which holds the lock), so the swap-to-None
+        # must be atomic with those writers — closing the file first
+        # would hand them a closed handle.
+        with self._lock:
+            collector, self._collector = self._collector, None
+            events_f, self._events_f = self._events_f, None
+        if collector is not None:
             # The sentinel queues BEHIND any in-flight dispatches, so
             # close() still resolves everything already booked.
-            self._collector.close()
-            self._collector = None
+            collector.close()
         for lane in self._lanes:
             lane.close()
         self._lanes = []
-        if self._events_f is not None:
-            self._events_f.close()
-            self._events_f = None
+        if events_f is not None:
+            events_f.close()
         for t in self.tenants:
             try:
                 t.source.close()
@@ -822,6 +830,22 @@ def serve_main(argv=None) -> int:
                      default=d.obs_alerts_webhook_retries)
     obs.add_argument("--alerts_webhook_backoff_s", type=float,
                      default=d.obs_alerts_webhook_backoff_s)
+    conc = p.add_argument_group("concurrency lockdep (dasmtl-conc, "
+                                "docs/STATIC_ANALYSIS.md)")
+    conc.add_argument("--conc_lockdep",
+                      action=argparse.BooleanOptionalAction,
+                      default=d.conc_lockdep,
+                      help="arm runtime lock-order tracking: record the "
+                           "acquisition graph, flag order cycles and "
+                           "long holds (also DASMTL_CONC_LOCKDEP=1)")
+    conc.add_argument("--conc_hold_warn_ms", type=float,
+                      default=d.conc_hold_warn_ms,
+                      help="lock hold time above which lockdep records "
+                           "a long-hold finding")
+    conc.add_argument("--conc_dump_path", type=str,
+                      default=d.conc_dump_path, metavar="PATH",
+                      help="write the lockdep graph + findings as JSONL "
+                           "at exit")
     p.add_argument("--host", type=str, default=d.serve_host)
     p.add_argument("--port", type=int, default=d.serve_port)
     p.add_argument("--port_file", type=str, default=None, metavar="PATH")
@@ -848,6 +872,10 @@ def serve_main(argv=None) -> int:
     from dasmtl.utils.platform import apply_device
 
     apply_device(args.device)
+
+    # Arm lockdep BEFORE any loop/selftest lock is constructed — the
+    # factories consult the tracker at construction time.
+    lockdep.configure(args)
 
     if args.selftest:
         from dasmtl.stream.selftest import (run_selftest,

@@ -143,8 +143,10 @@ def run_selftest(*, fibers: int = 3, cycles: int = 140, devices: int = 1,
     cycle_budget = 16 * fibers  # equal weights -> quota 16 each
     dur = 512
 
+    from dasmtl.analysis.conc import lockdep
     from dasmtl.serve.server import ServeLoop
 
+    conc0 = lockdep.snapshot()
     pool = _oracle_pool(window, buckets, devices)
     say(f"[stream-selftest] warming oracle pool: buckets {list(buckets)} "
         f"x {len(pool.executors)} device(s) ...")
@@ -509,10 +511,22 @@ def run_selftest(*, fibers: int = 3, cycles: int = 140, devices: int = 1,
                         f"POST(s) for {hook_sink.delivered} delivered — "
                         f"duplicate or lost deliveries")
 
+    # Lockdep leg (armed by CI / dasmtl-conc, {"enabled": False}
+    # otherwise): the soak must add zero lock-order cycles and zero
+    # unjoined threads to the acquisition graph.
+    conc_failures, conc_report = lockdep.clean_since(conc0)
+    failures.extend(conc_failures)
+    if conc_report["enabled"]:
+        say(f"[stream-selftest] lockdep: {conc_report['edges']} edge(s), "
+            f"{conc_report['cycles']} cycle(s), "
+            f"{conc_report['unjoined']} unjoined, "
+            f"{conc_report['long_holds']} long hold(s)")
+
     tstats = stream.stats()["tenants"]
     report = {
         "passed": not failures,
         "failures": failures,
+        "lockdep": conc_report,
         "fibers": fibers,
         "resident": bool(resident),
         "cycles": cycles,

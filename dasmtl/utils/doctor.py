@@ -206,6 +206,12 @@ def collect() -> dict:
             "sanitize_every": d.sanitize_every,
         },
         "determinism_baseline": _determinism_baseline_summary(),
+        "conc_defaults": {
+            "conc_lockdep": d.conc_lockdep,
+            "conc_hold_warn_ms": d.conc_hold_warn_ms,
+            "conc_dump_path": d.conc_dump_path,
+        },
+        "lockorder_baseline": _lockorder_baseline_summary(),
     }
     return info
 
@@ -265,6 +271,28 @@ def _determinism_baseline_summary() -> dict:
     return {"path": path, "status": "ok",
             "targets": len(data.get("targets", {})),
             "generated_with": data.get("generated_with", {})}
+
+
+def _lockorder_baseline_summary() -> dict:
+    """Status of the concurrency suite's committed lock-order graph —
+    metadata only, nothing executed.  ``stale`` means the recording
+    environment drifted (python/jax versions differ from this host):
+    the edges still gate, but regenerate after justifying the bump."""
+    from dasmtl.analysis.conc.baseline import (DEFAULT_BASELINE_PATH,
+                                               _generated_with,
+                                               load_baseline)
+
+    path = DEFAULT_BASELINE_PATH
+    try:
+        data = load_baseline(path)
+    except (OSError, ValueError) as exc:
+        return {"path": path, "status": f"unreadable ({exc})"}
+    if data is None:
+        return {"path": path, "status": "missing"}
+    gen = data.get("generated_with", {})
+    status = "ok" if gen == _generated_with() else "stale"
+    return {"path": path, "status": status,
+            "edges": len(data.get("edges", [])), "generated_with": gen}
 
 
 def check_exported_artifact(path: str, window=None,
@@ -445,6 +473,25 @@ def main(argv=None) -> int:
         print(f"  sanitize: determinism baseline "
               f"{db.get('status', 'missing')} at {db.get('path')} — "
               f"generate with dasmtl-sanitize --update-baseline "
+              f"--preset full")
+    print("  conc defaults: " + ", ".join(
+        f"{k}={v}" for k, v in ana.get("conc_defaults", {}).items()))
+    lb = ana.get("lockorder_baseline", {})
+    if lb.get("status") == "ok":
+        print(f"  conc: lock-order baseline ok — {lb['edges']} edge(s) "
+              f"in {lb['path']}; verify with dasmtl-conc "
+              f"--check-baseline")
+    elif lb.get("status") == "stale":
+        gen = lb.get("generated_with", {})
+        gen_s = ", ".join(f"{k} {v}" for k, v in sorted(gen.items()))
+        print(f"  conc: lock-order baseline STALE — {lb['edges']} "
+              f"edge(s) in {lb['path']} recorded under {gen_s}; edges "
+              f"still gate, refresh with dasmtl-conc --update-baseline "
+              f"after justifying the version bump")
+    else:
+        print(f"  conc: lock-order baseline "
+              f"{lb.get('status', 'missing')} at {lb.get('path')} — "
+              f"generate with dasmtl-conc --update-baseline "
               f"--preset full")
     return rc
 

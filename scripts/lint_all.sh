@@ -39,6 +39,19 @@ else
     echo "== dasmtl-sanitize skipped (DASMTL_LINT_SKIP_SANITIZE set)"
 fi
 
+# Concurrency suite: the fault-injection self-test (pure threading + AST,
+# no model compiles — cheap), then the lock-order baseline gate on the
+# `quick` preset (one serve selftest with lockdep armed).  CI's conc job
+# runs the wider `ci` preset plus standalone lockdep-armed selftests.
+if [ "${DASMTL_LINT_SKIP_CONC:-}" = "" ]; then
+    echo "== dasmtl-conc --self-test"
+    python -m dasmtl.analysis.conc --self-test || rc=1
+    echo "== dasmtl-conc --check-baseline --preset quick"
+    python -m dasmtl.analysis.conc --check-baseline --preset quick || rc=1
+else
+    echo "== dasmtl-conc skipped (DASMTL_LINT_SKIP_CONC set)"
+fi
+
 # Online-serving smoke: the in-process selftest (concurrent clients, NaN
 # poisoning, SIGTERM drain, recompile/occupancy invariants) on a reduced
 # window — a few model compiles, so skippable for doc-only edits.

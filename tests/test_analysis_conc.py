@@ -1,0 +1,616 @@
+"""dasmtl-conc: concurrency rules DAS301-DAS305 (positive + near-miss
+fixtures, same convention as test_analysis_lint.py), runtime lockdep
+(ABBA cycle, hold times, condition wait splitting, join watchdog), the
+lock-order baseline round-trip, and the fault-injection self-test.
+Pure AST + plain threading — no jax execution, fast."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from dasmtl.analysis.conc import baseline as conc_baseline
+from dasmtl.analysis.conc import faults, lockdep
+from dasmtl.analysis.conc.runner import (resolve_exercises,
+                                         runtime_findings, self_test)
+from dasmtl.analysis.lint import lint_source
+
+
+def ids(src: str):
+    return sorted({f.rule for f in lint_source(src, "snippet.py")})
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_off():
+    """Every test starts and ends with the tracker disarmed."""
+    lockdep.disable()
+    yield
+    lockdep.disable()
+
+
+# -- DAS301: unguarded shared-attribute mutation -----------------------------
+
+_DAS301_POS = """
+import threading
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.cycles = 0
+        self._t = threading.Thread(target=self._run)
+
+    def _run(self):
+        while True:
+            self.cycles += 1            # raced by stats() readers
+"""
+
+_DAS301_NEG = """
+import threading
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.cycles = 0
+        self._t = threading.Thread(target=self._run)
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.cycles += 1
+
+    def stats(self):
+        with self._lock:
+            return {"cycles": self.cycles}
+"""
+
+_DAS301_NO_THREADS = """
+import threading
+
+class Counter:                          # no thread body: nothing shared
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        self.n += 1
+"""
+
+
+def test_das301_flags_unguarded_shared_mutation():
+    assert "DAS301" in ids(_DAS301_POS)
+
+
+def test_das301_ignores_guarded_mutation():
+    assert "DAS301" not in ids(_DAS301_NEG)
+
+
+def test_das301_ignores_classes_without_threads():
+    assert "DAS301" not in ids(_DAS301_NO_THREADS)
+
+
+# -- DAS302: acquire without try/finally release -----------------------------
+
+_DAS302_POS = """
+import threading
+
+_lock = threading.Lock()
+
+def risky():
+    _lock.acquire()
+    do_work()                           # an exception leaks the lock
+    _lock.release()
+"""
+
+_DAS302_NEG = """
+import threading
+
+_lock = threading.Lock()
+
+def safe():
+    _lock.acquire()
+    try:
+        do_work()
+    finally:
+        _lock.release()
+
+def safest():
+    with _lock:
+        do_work()
+"""
+
+
+def test_das302_flags_unprotected_acquire():
+    assert "DAS302" in ids(_DAS302_POS)
+
+
+def test_das302_ignores_try_finally_and_with():
+    assert "DAS302" not in ids(_DAS302_NEG)
+
+
+def test_das302_ignores_semaphores():
+    src = """
+import threading
+
+class Gate:
+    def __init__(self):
+        self._slots = threading.BoundedSemaphore(2)
+
+    def take(self):
+        self._slots.acquire()           # released on another code path
+"""
+    assert "DAS302" not in ids(src)
+
+
+# -- DAS303: blocking call while holding a lock ------------------------------
+
+_DAS303_POS = """
+import threading
+import time
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def tick(self):
+        with self._lock:
+            time.sleep(0.5)             # stalls every other acquirer
+"""
+
+_DAS303_NEG = """
+import os
+import threading
+import time
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def tick(self):
+        time.sleep(0.5)                 # outside the critical section
+        with self._lock:
+            path = os.path.join("a", "b")   # not Thread.join
+        return path
+"""
+
+
+def test_das303_flags_sleep_under_lock():
+    assert "DAS303" in ids(_DAS303_POS)
+
+
+def test_das303_ignores_sleep_outside_lock_and_path_join():
+    assert "DAS303" not in ids(_DAS303_NEG)
+
+
+# -- DAS304: Condition.wait outside a predicate loop -------------------------
+
+_DAS304_POS = """
+import threading
+
+class Mailbox:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.items = []
+
+    def get(self):
+        with self._cv:
+            if not self.items:
+                self._cv.wait()         # spurious wakeup returns early
+            return self.items.pop()
+"""
+
+_DAS304_NEG = """
+import threading
+
+class Mailbox:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.items = []
+
+    def get(self):
+        with self._cv:
+            while not self.items:
+                self._cv.wait()
+            return self.items.pop()
+"""
+
+
+def test_das304_flags_wait_without_while():
+    assert "DAS304" in ids(_DAS304_POS)
+
+
+def test_das304_ignores_wait_in_predicate_loop():
+    assert "DAS304" not in ids(_DAS304_NEG)
+
+
+# -- DAS305: reachable double-acquire of a non-reentrant lock ----------------
+
+_DAS305_POS = """
+import threading
+
+class Book:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def add(self):
+        with self._lock:
+            self._flush()               # re-acquires self._lock
+
+    def _flush(self):
+        with self._lock:
+            pass
+"""
+
+_DAS305_NEG = """
+import threading
+
+class Book:
+    def __init__(self):
+        self._lock = threading.RLock()  # reentrant: re-entry is legal
+
+    def add(self):
+        with self._lock:
+            self._flush()
+
+    def _flush(self):
+        with self._lock:
+            pass
+"""
+
+
+def test_das305_flags_nested_acquire_through_method_call():
+    assert "DAS305" in ids(_DAS305_POS)
+
+
+def test_das305_ignores_rlock_reentry():
+    assert "DAS305" not in ids(_DAS305_NEG)
+
+
+def test_rules_recognize_lockdep_factories():
+    src = """
+from dasmtl.analysis.conc import lockdep
+
+class Book:
+    def __init__(self):
+        self._lock = lockdep.lock("Book._lock")
+
+    def add(self):
+        with self._lock:
+            self._flush()
+
+    def _flush(self):
+        with self._lock:
+            pass
+"""
+    assert "DAS305" in ids(src)
+
+
+# -- lockdep: cycles, reentrancy, hold times, condition wait ------------------
+
+def test_lockdep_detects_abba_cycle_without_deadlocking():
+    lockdep.enable(reset=True)
+    a, b = lockdep.lock("t.A"), lockdep.lock("t.B")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def backward():
+        with b:
+            with a:
+                pass
+
+    for fn in (forward, backward):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    snap = lockdep.snapshot()
+    assert len(snap["cycles"]) == 1
+    cyc = snap["cycles"][0]["cycle"]
+    assert cyc[0] == cyc[-1] and {"t.A", "t.B"} <= set(cyc)
+
+
+def test_lockdep_clean_nesting_records_edges_without_cycles():
+    lockdep.enable(reset=True)
+    a, b = lockdep.lock("t.A"), lockdep.lock("t.B")
+    with a:
+        with b:
+            pass
+    with a:
+        with b:
+            pass
+    snap = lockdep.snapshot()
+    assert snap["cycles"] == []
+    assert ["t.A", "t.B", 2] in snap["edges"]
+
+
+def test_lockdep_rlock_reentry_adds_no_self_edge():
+    lockdep.enable(reset=True)
+    r = lockdep.rlock("t.R")
+    with r:
+        with r:
+            pass
+    snap = lockdep.snapshot()
+    assert snap["edges"] == [] and snap["cycles"] == []
+
+
+def test_lockdep_flags_long_hold():
+    lockdep.enable(hold_warn_ms=1.0, reset=True)
+    slow = lockdep.lock("t.slow")
+    with slow:
+        time.sleep(0.01)
+    holds = lockdep.snapshot()["long_holds"]
+    assert holds and holds[0]["lock"] == "t.slow"
+    assert holds[0]["held_ms"] >= 1.0
+
+
+def test_lockdep_condition_wait_splits_hold_and_releases_stack():
+    """A thread parked in cv.wait() does NOT hold the lock: edges from
+    other locks acquired meanwhile must not originate at the condition,
+    and a long wait is not a long hold."""
+    lockdep.enable(hold_warn_ms=50.0, reset=True)
+    guard = lockdep.lock("t.guard")
+    cv = lockdep.condition("t.cv", guard)
+    ready = []
+
+    def waiter():
+        with cv:
+            while not ready:
+                cv.wait(timeout=0.5)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)  # park the waiter inside wait()
+    with cv:
+        ready.append(1)
+        cv.notify_all()
+    t.join()
+    snap = lockdep.snapshot()
+    assert snap["long_holds"] == []  # the 0.1s park was a wait, not a hold
+    assert snap["cycles"] == []
+
+
+def test_lockdep_condition_shares_its_locks_graph_node():
+    lockdep.enable(reset=True)
+    guard = lockdep.lock("t.guard")
+    cv = lockdep.condition("t.cv", guard)
+    other = lockdep.lock("t.other")
+    with cv:
+        with other:
+            pass
+    edges = lockdep.observed_edges()
+    assert ["t.guard", "t.other"] in edges  # node named for the lock
+    assert not any(a == "t.cv" for a, _b in edges)
+
+
+def test_lockdep_disabled_factories_return_plain_primitives():
+    assert not lockdep.enabled()
+    assert isinstance(lockdep.lock("t.x"), type(threading.Lock()))
+    cv = lockdep.condition("t.cv")
+    assert isinstance(cv, threading.Condition)
+    assert lockdep.snapshot()["enabled"] is False
+    assert lockdep.observed_edges() == []
+
+
+def test_assert_joined_watchdog():
+    lockdep.enable(reset=True)
+    release = threading.Event()
+    t = threading.Thread(target=release.wait, daemon=True)
+    t.start()
+    with pytest.raises(lockdep.UnjoinedThreadError):
+        lockdep.assert_joined([t], "test drain")
+    assert lockdep.snapshot()["unjoined"][0]["context"] == "test drain"
+    release.set()
+    t.join()
+    lockdep.assert_joined([t], "test drain")  # joined: no raise
+    lockdep.disable()
+    lockdep.assert_joined([object()], "disabled")  # no-op when off
+
+
+def test_clean_since_reports_only_new_findings():
+    lockdep.enable(reset=True)
+    a, b = lockdep.lock("t.A"), lockdep.lock("t.B")
+
+    def backward():
+        with b:
+            with a:
+                pass
+
+    with a:
+        with b:
+            pass
+    backward()  # same thread: cycle recorded
+    before = lockdep.snapshot()
+    msgs, summary = lockdep.clean_since(before)
+    assert msgs == [] and summary["enabled"]
+    release = threading.Event()
+    t = threading.Thread(target=release.wait, daemon=True)
+    t.start()
+    with pytest.raises(lockdep.UnjoinedThreadError):
+        lockdep.assert_joined([t], "late drain")
+    msgs, summary = lockdep.clean_since(before)
+    assert len(msgs) == 1 and "late drain" in msgs[0]
+    assert summary["unjoined"] == 1
+    release.set()
+    t.join()
+
+
+def test_runtime_findings_map_snapshot_to_conc_ids():
+    lockdep.enable(hold_warn_ms=1.0, reset=True)
+    a, b = lockdep.lock("t.A"), lockdep.lock("t.B")
+    with a:
+        with b:
+            time.sleep(0.01)
+    with b:
+        with a:
+            pass
+    found = runtime_findings(lockdep.snapshot())
+    by_id = {f["id"] for f in found}
+    assert "CONC401" in by_id and "CONC402" in by_id
+    assert all(f["severity"] == "warning" for f in found
+               if f["id"] == "CONC402")
+
+
+def test_publish_exports_conc_families():
+    from dasmtl.obs.registry import MetricsRegistry
+
+    lockdep.enable(reset=True)
+    a, b = lockdep.lock("t.A"), lockdep.lock("t.B")
+    with a:
+        with b:
+            pass
+    reg = MetricsRegistry()
+    lockdep.publish(reg)
+    text = reg.render()
+    assert "dasmtl_conc_acquisitions_total 2" in text
+    assert "dasmtl_conc_edges 1" in text
+    assert "dasmtl_conc_cycles_total 0" in text
+
+
+def test_enable_hooks_default_registry_scrape():
+    # Arming lockdep must surface dasmtl_conc_* on the DEFAULT registry's
+    # render (the live /metrics path) with no tier-specific wiring.
+    from dasmtl.obs.registry import default_registry
+
+    lockdep.enable(reset=True)
+    a = lockdep.lock("t.hook")
+    with a:
+        pass
+    assert "dasmtl_conc_acquisitions_total" in default_registry().render()
+
+
+def test_dump_jsonl_writes_edges_and_findings(tmp_path):
+    lockdep.enable(reset=True)
+    a, b = lockdep.lock("t.A"), lockdep.lock("t.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    path = tmp_path / "conc" / "dump.jsonl"
+    n = lockdep.dump_jsonl(str(path))
+    recs = [json.loads(line) for line in path.read_text().splitlines()]
+    assert len(recs) == n
+    kinds = {r["kind"] for r in recs}
+    assert {"edge", "cycle"} <= kinds
+
+
+# -- baseline round-trip ------------------------------------------------------
+
+def test_baseline_round_trip_and_new_edge_fails(tmp_path):
+    path = str(tmp_path / "lockorder_baseline.json")
+    edges = [["A", "B"], ["B", "C"]]
+    doc = conc_baseline.update_baseline(edges, path)
+    assert doc["version"] == 1 and doc["edges"] == sorted(edges)
+    loaded = conc_baseline.load_baseline(path)
+    assert loaded["edges"] == sorted(edges)
+    # Observed subset of the committed graph: clean.
+    assert conc_baseline.check_edges([["A", "B"]], loaded, path) == []
+    # A planted NEW edge fails with CONC403 naming the pair.
+    found = conc_baseline.check_edges([["A", "B"], ["C", "A"]],
+                                      loaded, path)
+    assert [f["id"] for f in found] == ["CONC403"]
+    assert found[0]["edge"] == ["C", "A"]
+
+
+def test_baseline_missing_is_conc404(tmp_path):
+    path = str(tmp_path / "nope.json")
+    found = conc_baseline.check_edges([["A", "B"]], None, path)
+    assert [f["id"] for f in found] == ["CONC404"]
+
+
+def test_baseline_update_merges_and_keeps_comment(tmp_path):
+    path = str(tmp_path / "lockorder_baseline.json")
+    conc_baseline.update_baseline([["A", "B"]], path)
+    doc = json.loads(open(path).read())
+    doc["comment"] = "hand-edited review note"
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    merged = conc_baseline.update_baseline([["B", "C"]], path)
+    assert merged["edges"] == [["A", "B"], ["B", "C"]]
+    assert merged["comment"] == "hand-edited review note"
+
+
+def test_committed_baseline_exists_and_parses():
+    data = conc_baseline.load_baseline()
+    assert data is not None, (
+        "artifacts/lockorder_baseline.json must be committed — "
+        "regenerate with dasmtl-conc --update-baseline --preset full")
+    assert data["version"] == 1 and data["edges"]
+    for a, b in data["edges"]:
+        assert isinstance(a, str) and isinstance(b, str)
+
+
+# -- fault injection + self-test ---------------------------------------------
+
+def test_fault_registry_rejects_unknown_names():
+    with pytest.raises(ValueError):
+        with faults.inject("nonsense"):
+            pass
+    assert not faults.active("abba")
+    with faults.inject("abba"):
+        assert faults.active("abba")
+    assert not faults.active("abba")
+
+
+def test_mutation_snippet_toggles_with_fault():
+    clean = faults.mutation_snippet()
+    assert "DAS301" not in ids(clean)
+    with faults.inject("unguarded_mutation"):
+        dirty = faults.mutation_snippet()
+    assert "DAS301" in ids(dirty)
+
+
+def test_self_test_catches_all_injected_faults(capsys):
+    assert self_test(verbose=False) == []
+
+
+def test_resolve_exercises():
+    assert resolve_exercises("ci", None) == ["serve", "stream"]
+    assert resolve_exercises("quick", "stream") == ["stream"]
+    with pytest.raises(ValueError):
+        resolve_exercises("ci", "bogus")
+
+
+# -- regressions for the DAS301-305 sweep fixes ------------------------------
+
+def test_alert_engine_counters_survive_racing_sources():
+    """PR fix regression: evaluate()'s source-error counter is now
+    guarded — hammer it from threads and the count must be exact."""
+    from dasmtl.obs.alerts import AlertEngine
+
+    def bad_source() -> str:
+        raise RuntimeError("scrape failed")
+
+    engine = AlertEngine(rules=[], sinks=[])
+    engine.add_exposition(bad_source)
+    threads = [threading.Thread(target=lambda: [engine.evaluate()
+                                                for _ in range(50)])
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert engine.stats()["source_errors"] == 200
+
+
+def test_stream_loop_close_detaches_before_closing(tmp_path):
+    """PR fix regression: close() swaps the events file out under the
+    lock, so a late collector-thread callback can never write into a
+    closed file."""
+    import io
+
+    from dasmtl.stream.live import StreamLoop
+
+    loop = StreamLoop.__new__(StreamLoop)
+    loop._lock = threading.Lock()
+    loop._stop = threading.Event()
+    loop._collector = None
+    loop._lanes = []
+    loop.tenants = []
+    loop._events_f = io.StringIO()
+    loop.close()
+    assert loop._events_f is None
+    loop.close()  # idempotent
