@@ -3,7 +3,7 @@
 JAX-specific defects — stray host syncs inside the step path, per-step
 recompilation, PRNG key reuse, donated-buffer reads — pass CPU unit tests
 and only surface as silent wall-clock regressions (or heap corruption) on a
-real v4-8.  This package catches them five ways:
+real v4-8.  This package catches them six ways:
 
 - :mod:`dasmtl.analysis.lint` — an AST linter with JAX-aware rules
   (``dasmtl-lint``; rule registry in :mod:`dasmtl.analysis.rules`), run over
@@ -28,6 +28,15 @@ real v4-8.  This package catches them five ways:
   lock-acquisition-order graph, flag cycles/long holds/unjoined threads,
   and gate new edges against ``artifacts/lockorder_baseline.json``.
   Enabled by ``Config.conc_lockdep``; proves itself the same way.
+- :mod:`dasmtl.analysis.mem` — the memory-discipline suite
+  (``dasmtl-mem``): AST rules DAS401–405 for the staged data plane
+  (raw hot-path allocation, exception-leaked leases, use-after-retire,
+  unaligned ``device_put``, re-read donated operands) plus a runtime
+  leasedep — the lease/donation tracker ``StagingBuffers`` and
+  ``ResidentFeed`` report to, with a NaN canary on released buffers,
+  retirement verification, and per-tier peak budgets gated against
+  ``artifacts/membudget_baseline.json``.  Enabled by
+  ``Config.mem_track``; proves itself the same way.
 
 ``docs/STATIC_ANALYSIS.md`` documents every rule id and the
 ``# dasmtl: noqa[RULE]`` suppression syntax.

@@ -1,0 +1,16 @@
+"""dasmtl-mem: the device-memory discipline suite.
+
+The fifth member of the analysis family (lint / audit / sanitize /
+conc / mem).  Static rules DAS401-DAS405
+(:mod:`dasmtl.analysis.rules.memory`, run by ``dasmtl-lint``) encode
+the aligned-allocation / lease-release / donation-retirement
+conventions; the runtime half (:mod:`dasmtl.analysis.mem.leasedep`)
+tracks every staging lease while armed — leak-at-drain (MEM501),
+double release (MEM502), NaN-canary use-after-release (MEM503),
+device-value retirement verification (MEM504) — and the committed
+``artifacts/membudget_baseline.json`` budgets the per-tier peak
+resident host bytes and outstanding leases (MEM505 on growth).
+
+CLI: ``dasmtl-mem`` / ``dasmtl mem`` / ``python -m dasmtl.analysis.mem``
+(:mod:`dasmtl.analysis.mem.runner`).
+"""

@@ -1,0 +1,131 @@
+"""Memory budgets: the reviewed per-tier host-staging footprint.
+
+``artifacts/membudget_baseline.json`` commits, per exercised tier
+(train / serve / stream), the peak resident host-buffer bytes and the
+peak outstanding lease count measured with leasedep armed.
+``--check-baseline`` fails MEM505 when a tier grows past tolerance —
+a memory-footprint regression becomes a reviewable JSON diff, exactly
+like the flop/collective budgets of the audit baseline.  Tiers shrink
+silently (headroom is not an error) and baseline tiers a given preset
+does not exercise are left untouched.
+
+Workflow (mirrors ``dasmtl-audit``): after an intentional batching /
+staging-depth change run ``dasmtl-mem --update-baseline --preset
+full``, review the diff, commit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+DEFAULT_BASELINE_PATH = os.path.join("artifacts",
+                                     "membudget_baseline.json")
+
+#: The budgeted metrics and the absolute slack added on top of the
+#: fractional tolerance (1 MiB of bytes; one lease) — small-footprint
+#: tiers must not fail on allocator rounding noise.
+_METRICS = {"peak_resident_bytes": 1 << 20, "peak_outstanding": 1}
+
+#: Fractional growth allowed before MEM505 fires.
+_TOLERANCE = 0.25
+
+_COMMENT = ("Per-tier peak resident host-staging bytes and peak "
+            "outstanding leases, measured with leasedep armed "
+            "(dasmtl-mem --update-baseline).  Growth past "
+            f"{_TOLERANCE:.0%} + slack fails MEM505: a bigger staging "
+            "footprint must be reviewed, not waved through "
+            "(docs/STATIC_ANALYSIS.md 'Memory discipline').")
+
+
+def _generated_with() -> dict:
+    import platform
+
+    from dasmtl.analysis.audit.runner import (
+        _generated_with as _deps_versions)
+
+    out = _deps_versions()
+    out["python"] = platform.python_version()
+    return out
+
+
+def load_baseline(path: str = DEFAULT_BASELINE_PATH) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def update_baseline(measured: Dict[str, dict],
+                    path: str = DEFAULT_BASELINE_PATH) -> dict:
+    """Write/refresh the baseline.  Measured tiers replace their
+    previous entries; tiers this run did not exercise survive (a
+    quick-preset run must not drop the full set); a hand-edited
+    comment survives."""
+    prev = load_baseline(path)
+    tiers: Dict[str, dict] = {}
+    comment = _COMMENT
+    if prev is not None:
+        tiers.update(prev.get("tiers", {}))
+        comment = prev.get("comment", _COMMENT)
+    for tier, stats in measured.items():
+        tiers[tier] = {m: int(stats.get(m, 0)) for m in _METRICS}
+    doc = {
+        "version": 1,
+        "comment": comment,
+        "generated_with": _generated_with(),
+        "tiers": {t: tiers[t] for t in sorted(tiers)},
+    }
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def check_budgets(measured: Dict[str, dict],
+                  baseline: Optional[dict],
+                  path: str = DEFAULT_BASELINE_PATH) -> List[dict]:
+    """MEM505 per measured metric over its budget (tolerance + slack),
+    per tier missing from the baseline, and when there is no baseline
+    file at all."""
+    if baseline is None:
+        return [{
+            "id": "MEM505", "severity": "error",
+            "message": f"no membudget baseline at {path} — run "
+                       f"`dasmtl-mem --update-baseline --preset full` "
+                       f"and commit the reviewed budgets",
+        }]
+    known = baseline.get("tiers", {})
+    findings: List[dict] = []
+    for tier in sorted(measured):
+        base = known.get(tier)
+        if base is None:
+            findings.append({
+                "id": "MEM505", "severity": "error",
+                "message": f"tier {tier!r} has no committed budget in "
+                           f"{path} — review its footprint, then "
+                           f"`dasmtl-mem --update-baseline`",
+            })
+            continue
+        for metric, slack in _METRICS.items():
+            got = int(measured[tier].get(metric, 0))
+            budget = int(base.get(metric, 0))
+            allowed = budget * (1.0 + _TOLERANCE) + slack
+            if got <= allowed:
+                continue
+            findings.append({
+                "id": "MEM505", "severity": "error",
+                "tier": tier, "metric": metric,
+                "measured": got, "budget": budget,
+                "message": f"{tier}: {metric} grew to {got} "
+                           f"(budget {budget}, allowed "
+                           f"{int(allowed)}) — a bigger staging "
+                           f"footprint must be reviewed; if "
+                           f"intentional, `dasmtl-mem "
+                           f"--update-baseline` and commit the diff",
+            })
+    return findings

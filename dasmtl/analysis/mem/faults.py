@@ -1,0 +1,132 @@
+"""Fault injection for the memory suite: deliberately plant each
+defect class and verify the checkers catch it (``dasmtl-mem
+--self-test``).  A memory checker that silently misses its fault is
+worse than none — it licenses trust.
+
+Faults: ``leaked_lease`` (a lease never returned, caught at drain —
+MEM501), ``double_release`` (the same buffer returned twice — MEM502),
+``use_after_release`` (a write into a freelisted buffer breaks the NaN
+canary — MEM503), ``retire_alias`` (the "device value" still aliases a
+retired host slot — MEM504), ``budget_bust`` (footprint growth past
+the committed budget — MEM505), ``raw_hot_alloc`` (a raw ``np.stack``
+on a hot path — DAS401).  Each exercise has a clean variant that must
+stay silent.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Iterator, Set, Tuple
+
+import numpy as np
+
+from dasmtl.analysis.mem import leasedep
+
+FAULTS: Tuple[str, ...] = ("leaked_lease", "double_release",
+                           "use_after_release", "retire_alias",
+                           "budget_bust", "raw_hot_alloc")
+
+_ACTIVE: Set[str] = set()
+
+
+def active(name: str) -> bool:
+    return name in _ACTIVE
+
+
+@contextlib.contextmanager
+def inject(name: str) -> Iterator[None]:
+    if name not in FAULTS:
+        raise ValueError(f"unknown fault {name!r}; known: {FAULTS}")
+    _ACTIVE.add(name)
+    try:
+        yield
+    finally:
+        _ACTIVE.discard(name)
+
+
+# -- runtime exercises (leasedep must be armed by the caller) ---------------
+
+def run_lease_exercise() -> None:
+    """Acquire three leases and return them — unless ``leaked_lease``
+    keeps one out past the drain or ``double_release`` returns the
+    first twice."""
+    t = leasedep.tracker("faults.pool")
+    if t is None:
+        return
+    bufs = [np.ones(64, np.float32) for _ in range(3)]
+    for buf in bufs:
+        t.acquired(buf, slot=("fault", 64))
+    returned = bufs[:-1] if active("leaked_lease") else bufs
+    for buf in returned:
+        t.released(buf, slot=("fault", 64))
+    if active("double_release"):
+        t.released(bufs[0], slot=("fault", 64))
+    leasedep.drain_check("fault lease exercise")
+
+
+def run_canary_exercise() -> None:
+    """One acquire/release round trip; ``use_after_release`` writes
+    into the buffer while it sits on the freelist, which the next
+    acquire's canary check must catch."""
+    t = leasedep.tracker("faults.canary")
+    if t is None:
+        return
+    buf = np.ones(256, np.float32)
+    t.acquired(buf)
+    t.released(buf)
+    if active("use_after_release"):
+        buf[buf.size // 2] = 123.0  # the planted freelist write
+    t.acquired(buf)
+    t.released(buf)
+
+
+def run_retirement_exercise() -> None:
+    """Sample a "placed" value, retire its host slot (NaN-fill), and
+    verify the placed value did not move.  ``retire_alias`` makes the
+    placed value the host array itself — the aliasing bug MEM504
+    exists to catch."""
+    t = leasedep.tracker("faults.retire")
+    if t is None:
+        return
+    host = np.ones(64, np.float32)
+    placed = host if active("retire_alias") else host.copy()
+    sample = t.device_sample(placed)
+    host.fill(np.nan)  # retire the host slot
+    t.verify_retirement(sample, placed, "fault retirement exercise")
+
+
+# -- budget fixture ----------------------------------------------------------
+
+#: A committed-baseline stand-in for the budget leg (the real file is
+#: never touched by the self-test).
+BASELINE_DOC = {
+    "version": 1,
+    "comment": "fault-injection budget fixture",
+    "generated_with": {},
+    "tiers": {"faults": {"peak_resident_bytes": 1 << 20,
+                         "peak_outstanding": 4}},
+}
+
+
+def measured_budgets() -> Dict[str, dict]:
+    """In-budget measurements, unless ``budget_bust`` quadruples the
+    footprint."""
+    if active("budget_bust"):
+        return {"faults": {"peak_resident_bytes": 1 << 22,
+                           "peak_outstanding": 16}}
+    return {"faults": {"peak_resident_bytes": 1 << 20,
+                       "peak_outstanding": 4}}
+
+
+# -- static-rule snippet -----------------------------------------------------
+
+def allocation_snippet() -> str:
+    """A hot-path assembler that allocates raw (``raw_hot_alloc``) or
+    through ``stack_leaf`` — DAS401 must flag only the former."""
+    alloc = ("batch = np.stack(parts)" if active("raw_hot_alloc")
+             else "batch = stack_leaf(parts, out=out)")
+    return ("import numpy as np\n\n"
+            "from dasmtl.data.staging import stack_leaf\n\n\n"
+            "def assemble(parts, out):\n"
+            f"    {alloc}\n"
+            "    return batch\n")

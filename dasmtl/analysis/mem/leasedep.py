@@ -1,0 +1,535 @@
+"""Runtime buffer-lease tracking — the memory-discipline twin of lockdep.
+
+Every host staging pool the fleet cares about constructs through the
+factory here (``self._mem = leasedep.tracker("data.StagingBuffers")``).
+Disabled — the default — the factory returns ``None``, so steady-state
+code pays one attribute check per acquire and nothing else.  Armed
+(``Config.mem_track``, the ``DASMTL_MEM_TRACK=1`` env var, or
+:func:`enable`), it returns a :class:`PoolTracker` that records, per
+lease:
+
+- **acquire/release accounting** per pool: outstanding leases, peak
+  outstanding, resident (leased) host bytes and their peak — the
+  numbers the committed ``artifacts/membudget_baseline.json`` budgets
+  (:mod:`dasmtl.analysis.mem.baseline`);
+- **leaks at drain** (MEM501): :func:`drain_check` turns a lease still
+  outstanding after a drain point into a named finding instead of a
+  silently shrinking freelist;
+- **double releases** (MEM502): returning a buffer that holds no lease
+  corrupts the freelist (the same array queued twice hands one buffer
+  to two consumers);
+- **NaN-canary poisoning** (MEM503): released float buffers are filled
+  with NaN, so a use-after-release READ fails loudly downstream (the
+  NaN guards convict it) and a use-after-release WRITE breaks the
+  canary, which the next acquire of that buffer detects;
+- **donation/retirement verification** (MEM504):
+  :meth:`PoolTracker.verify_retirement` samples a placed device value,
+  lets the caller retire/rewrite the host slot, and fails if the
+  device value moved — the "donated or zero-copy-aliased buffer was
+  rewritten under the computation" bug as a named finding.
+
+Findings surface three ways: :func:`snapshot` (the runner / tests),
+:func:`publish` into an obs ``MetricsRegistry`` (``dasmtl_mem_*``
+families via a scrape-time collect hook), and :func:`dump_jsonl`.
+
+Recursion/overhead notes: like lockdep, state lives behind one plain
+guard lock and the obs registry is only touched at scrape time, never
+on the acquire path.  Canary poisoning costs one memset per release
+and retirement verification one small device read per call — debug
+costs, paid only while the tracker is armed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+#: Cap per finding list — a pathological loop must not grow memory
+#: unboundedly; the first occurrences are the diagnostic ones.
+_MAX_FINDINGS = 256
+
+#: Strided sample width for canary verification and device-value
+#: retirement checks — enough positions to convict a rewrite, cheap
+#: enough to run per release.
+_SAMPLE = 8
+
+
+def _leaves(buf) -> List[np.ndarray]:
+    if isinstance(buf, dict):
+        return [buf[k] for k in sorted(buf)]
+    if isinstance(buf, (list, tuple)):
+        return list(buf)
+    return [buf]
+
+
+def _nbytes(buf) -> int:
+    return sum(int(getattr(leaf, "nbytes", 0)) for leaf in _leaves(buf))
+
+
+def _sample_leaf(leaf) -> np.ndarray:
+    """Strided sample of one (host or device) array as a host copy."""
+    arr = np.asarray(leaf).ravel()
+    if arr.size == 0:
+        return arr.copy()
+    step = max(1, arr.size // _SAMPLE)
+    return arr[::step][:_SAMPLE].copy()
+
+
+class _Pool:
+    """Per-pool accounting (guarded by the state's one lock)."""
+
+    __slots__ = ("acquires", "releases", "outstanding", "peak_outstanding",
+                 "resident_bytes", "peak_resident_bytes")
+
+    def __init__(self):
+        self.acquires = 0
+        self.releases = 0
+        self.outstanding = 0
+        self.peak_outstanding = 0
+        self.resident_bytes = 0
+        self.peak_resident_bytes = 0
+
+
+class _State:
+    """Process-wide tracker state.  ``guard`` is a plain leaf lock —
+    nothing is acquired while holding it."""
+
+    def __init__(self, canary: bool = True):
+        self.guard = threading.Lock()
+        self.canary = bool(canary)
+        self.pools: Dict[str, _Pool] = {}
+        # (pool, id(buf)) -> (slot key, nbytes)
+        self.leases: Dict[Tuple[str, int], Tuple[object, int]] = {}
+        # (pool, id(buf)) of buffers poisoned at release, keeping the
+        # poisoned container alive so id() stays unambiguous until the
+        # canary is checked at the next acquire.
+        self.canaried: Dict[Tuple[str, int], object] = {}
+        self.canary_poisons = 0
+        self.leaks: List[dict] = []
+        self.double_releases: List[dict] = []
+        self.canary_hits: List[dict] = []
+        self.retirements: List[dict] = []
+
+    def pool(self, name: str) -> _Pool:
+        p = self.pools.get(name)
+        if p is None:
+            p = self.pools[name] = _Pool()
+        return p
+
+    def _global_resident(self) -> Tuple[int, int]:
+        return (sum(p.outstanding for p in self.pools.values()),
+                sum(p.resident_bytes for p in self.pools.values()))
+
+
+_state: Optional[_State] = None
+
+
+def enabled() -> bool:
+    return _state is not None
+
+
+def enable(canary: Optional[bool] = None, *, reset: bool = True) -> None:
+    """Arm the tracker.  Must run BEFORE the pools it should observe are
+    constructed — the factory consults it at construction time.
+    ``reset=False`` keeps existing accounting (re-arming mid-process)."""
+    global _state
+    if _state is not None and not reset:
+        if canary is not None:
+            _state.canary = bool(canary)
+        _install_publish_hook()
+        return
+    _state = _State(canary if canary is not None else True)
+    _install_publish_hook()
+
+
+def disable() -> None:
+    """Stop recording.  Trackers already constructed keep working as
+    no-ops (their hooks check the state on every call)."""
+    global _state
+    _state = None
+
+
+def configure(config) -> bool:
+    """Arm from a :class:`dasmtl.config.Config` (or a parsed argparse
+    namespace): returns True when tracking came on (``mem_track`` or
+    the env var)."""
+    if getattr(config, "mem_track", False) or _env_on():
+        enable(getattr(config, "mem_canary", None), reset=False)
+        path = getattr(config, "mem_dump_path", None)
+        if path:
+            dump_jsonl_at_exit(path)
+        return True
+    return False
+
+
+def _env_on() -> bool:
+    return os.environ.get("DASMTL_MEM_TRACK", "").lower() in (
+        "1", "true", "on", "yes")
+
+
+# -- the pool-facing API -----------------------------------------------------
+
+class PoolTracker:
+    """Lease hooks for one named pool.  Every method consults the
+    module state, so a tracker outliving :func:`disable` no-ops."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    # -- freelist pools (StagingBuffers) ----------------------------------
+    def acquired(self, buf, slot=None) -> None:
+        """Record a lease; verify the buffer's canary if it was poisoned
+        at its last release (a broken canary = someone WROTE to the
+        buffer while it sat on the freelist — use-after-release)."""
+        st = _state
+        if st is None:
+            return
+        nbytes = _nbytes(buf)
+        key = (self.name, id(buf))
+        with st.guard:
+            poisoned = st.canaried.pop(key, None)
+            p = st.pool(self.name)
+            p.acquires += 1
+            if key not in st.leases:
+                st.leases[key] = (slot, nbytes)
+                p.outstanding += 1
+                p.resident_bytes += nbytes
+                p.peak_outstanding = max(p.peak_outstanding, p.outstanding)
+                p.peak_resident_bytes = max(p.peak_resident_bytes,
+                                            p.resident_bytes)
+        if poisoned is not None:
+            self._check_canary(buf, slot)
+
+    def _check_canary(self, buf, slot) -> None:
+        st = _state
+        if st is None:
+            return
+        for i, leaf in enumerate(_leaves(buf)):
+            if not np.issubdtype(leaf.dtype, np.floating):
+                continue
+            sample = _sample_leaf(leaf)
+            if sample.size and not np.all(np.isnan(sample)):
+                with st.guard:
+                    if len(st.canary_hits) < _MAX_FINDINGS:
+                        st.canary_hits.append({
+                            "kind": "canary", "pool": self.name,
+                            "slot": repr(slot), "leaf": i,
+                            "message": "released buffer was written to "
+                                       "while on the freelist "
+                                       "(use-after-release)"})
+                return
+
+    def released(self, buf, slot=None) -> None:
+        """Return a lease; poison float leaves with the NaN canary.
+        A buffer holding no lease is a double release (MEM502)."""
+        st = _state
+        if st is None:
+            return
+        key = (self.name, id(buf))
+        with st.guard:
+            lease = st.leases.pop(key, None)
+            p = st.pool(self.name)
+            if lease is None:
+                if len(st.double_releases) < _MAX_FINDINGS:
+                    st.double_releases.append({
+                        "kind": "double_release", "pool": self.name,
+                        "slot": repr(slot),
+                        "message": "buffer released without an "
+                                   "outstanding lease (double release, "
+                                   "or release of a foreign buffer)"})
+                return
+            p.releases += 1
+            p.outstanding -= 1
+            p.resident_bytes -= lease[1]
+        if st.canary:
+            poisoned = False
+            for leaf in _leaves(buf):
+                if np.issubdtype(leaf.dtype, np.floating):
+                    leaf.fill(np.nan)
+                    poisoned = True
+            if poisoned:
+                with st.guard:
+                    st.canary_poisons += 1
+                    st.canaried[key] = buf
+
+    def relink(self, old_buf, new_buf) -> None:
+        """Transfer a lease to a replacement buffer — the
+        ``release_placed`` single-array retirement path swaps the leased
+        array for a fresh allocation before releasing it."""
+        st = _state
+        if st is None:
+            return
+        with st.guard:
+            lease = st.leases.pop((self.name, id(old_buf)), None)
+            if lease is not None:
+                st.leases[(self.name, id(new_buf))] = lease
+
+    # -- self-managed pools (ResidentFeed host staging) -------------------
+    def note_resident(self, nbytes: int) -> None:
+        """Set the current resident host bytes of a pool that manages
+        its own buffers (no freelist) — tracked for the budget peaks."""
+        st = _state
+        if st is None:
+            return
+        with st.guard:
+            p = st.pool(self.name)
+            p.resident_bytes = int(nbytes)
+            p.peak_resident_bytes = max(p.peak_resident_bytes,
+                                        p.resident_bytes)
+
+    # -- donation / retirement verification -------------------------------
+    def device_sample(self, placed) -> Optional[List[np.ndarray]]:
+        """Host-side strided samples of every leaf of a placed device
+        pytree (forces the value ready — a debug-mode sync)."""
+        if _state is None:
+            return None
+        try:
+            import jax
+
+            leaves = jax.tree.leaves(placed)
+        except ImportError:
+            leaves = _leaves(placed)
+        return [_sample_leaf(leaf) for leaf in leaves]
+
+    def verify_retirement(self, sample: Optional[List[np.ndarray]],
+                          placed, context: str) -> None:
+        """MEM504: the device value must be unchanged after the host
+        slot behind it was retired/rewritten.  ``sample`` comes from
+        :meth:`device_sample` taken BEFORE the host rewrite."""
+        st = _state
+        if st is None or sample is None:
+            return
+        after = self.device_sample(placed)
+        if after is None:
+            return
+        for i, (a, b) in enumerate(zip(sample, after)):
+            if a.shape != b.shape or not np.array_equal(a, b,
+                                                        equal_nan=True):
+                with st.guard:
+                    if len(st.retirements) < _MAX_FINDINGS:
+                        st.retirements.append({
+                            "kind": "retirement", "pool": self.name,
+                            "context": context, "leaf": i,
+                            "message": "device value changed after its "
+                                       "host slot was retired — the "
+                                       "device still aliased the host "
+                                       "memory (donation/zero-copy "
+                                       "retirement failure)"})
+                return
+
+
+def tracker(name: str) -> Optional[PoolTracker]:
+    """The fleet-facing factory: a :class:`PoolTracker` while armed,
+    ``None`` while disabled — call sites guard with one ``is not None``
+    check, so the steady state pays nothing."""
+    return PoolTracker(name) if _state is not None else None
+
+
+# -- drain watchdog ----------------------------------------------------------
+
+def drain_check(context: str) -> List[dict]:
+    """Leak detection at a drain point: every lease should be back on
+    its freelist.  Records one MEM501-class finding per pool with
+    outstanding leases and returns the new findings (empty while
+    disabled or clean)."""
+    st = _state
+    if st is None:
+        return []
+    found: List[dict] = []
+    with st.guard:
+        by_pool: Dict[str, List[Tuple[object, int]]] = {}
+        for (pool, _ident), lease in st.leases.items():
+            by_pool.setdefault(pool, []).append(lease)
+        for pool, leases in sorted(by_pool.items()):
+            rec = {
+                "kind": "leak", "pool": pool, "context": context,
+                "outstanding": len(leases),
+                "bytes": sum(n for _s, n in leases),
+                "slots": sorted({repr(s) for s, _n in leases}),
+                "message": f"{len(leases)} lease(s) still outstanding "
+                           f"at drain ({context})",
+            }
+            found.append(rec)
+            if len(st.leaks) < _MAX_FINDINGS:
+                st.leaks.append(rec)
+    return found
+
+
+# -- reporting ---------------------------------------------------------------
+
+def snapshot() -> dict:
+    """The current accounting + findings as plain data (empty when
+    off)."""
+    st = _state
+    if st is None:
+        return {"enabled": False, "pools": {}, "acquires": 0,
+                "releases": 0, "outstanding": 0, "peak_outstanding": 0,
+                "resident_bytes": 0, "peak_resident_bytes": 0,
+                "canary_poisons": 0, "leaks": [], "double_releases": [],
+                "canary": [], "retirements": []}
+    with st.guard:
+        pools = {
+            name: {"acquires": p.acquires, "releases": p.releases,
+                   "outstanding": p.outstanding,
+                   "peak_outstanding": p.peak_outstanding,
+                   "resident_bytes": p.resident_bytes,
+                   "peak_resident_bytes": p.peak_resident_bytes}
+            for name, p in sorted(st.pools.items())}
+        outstanding, resident = st._global_resident()
+        return {
+            "enabled": True,
+            "pools": pools,
+            "acquires": sum(p.acquires for p in st.pools.values()),
+            "releases": sum(p.releases for p in st.pools.values()),
+            "outstanding": outstanding,
+            "peak_outstanding": sum(p.peak_outstanding
+                                    for p in st.pools.values()),
+            "resident_bytes": resident,
+            "peak_resident_bytes": sum(p.peak_resident_bytes
+                                       for p in st.pools.values()),
+            "canary_poisons": st.canary_poisons,
+            "leaks": list(st.leaks),
+            "double_releases": list(st.double_releases),
+            "canary": list(st.canary_hits),
+            "retirements": list(st.retirements),
+        }
+
+
+def clean_since(before: dict) -> Tuple[List[str], dict]:
+    """Selftest leg: memory findings newer than an earlier
+    :func:`snapshot`, rendered as failure strings, plus a summary dict.
+    Disabled tracker -> no failures, ``{"enabled": False}`` (the leg is
+    opt-in: CI arms it via DASMTL_MEM_TRACK=1, dasmtl-mem via
+    :func:`enable`)."""
+    snap = snapshot()
+    if not snap["enabled"]:
+        return [], {"enabled": False}
+    msgs: List[str] = []
+    for kind, label in (("leaks", "leaked lease(s)"),
+                        ("double_releases", "double release"),
+                        ("canary", "use-after-release canary"),
+                        ("retirements", "retirement failure")):
+        for f in snap[kind][len(before.get(kind, ())):]:
+            where = f.get("context") or f.get("slot") or f["pool"]
+            msgs.append(f"memtrack: {label} in {f['pool']} ({where}): "
+                        f"{f['message']}")
+    return msgs, {"enabled": True,
+                  "pools": len(snap["pools"]),
+                  "outstanding": snap["outstanding"],
+                  "peak_outstanding": snap["peak_outstanding"],
+                  "peak_resident_bytes": snap["peak_resident_bytes"],
+                  "leaks": len(snap["leaks"])
+                  - len(before.get("leaks", ())),
+                  "double_releases": len(snap["double_releases"])
+                  - len(before.get("double_releases", ())),
+                  "canary": len(snap["canary"])
+                  - len(before.get("canary", ())),
+                  "retirements": len(snap["retirements"])
+                  - len(before.get("retirements", ()))}
+
+
+_publish_hook_installed = False
+
+
+def _install_publish_hook() -> None:
+    """Mirror the accounting into the default obs registry at scrape
+    time, so a mem-tracked server's ``/metrics`` carries the
+    ``dasmtl_mem_*`` families without any tier-specific wiring.  The
+    registry runs collect callbacks outside its own lock, and the
+    callback no-ops once the tracker is disabled."""
+    global _publish_hook_installed
+    if _publish_hook_installed:
+        return
+    try:
+        from dasmtl.obs.registry import default_registry
+    except ImportError:  # interpreter teardown mid-import
+        return
+    default_registry().add_collect_callback(_publish_if_enabled)
+    _publish_hook_installed = True
+
+
+def _publish_if_enabled() -> None:
+    if _state is not None:
+        publish()
+
+
+def publish(registry=None) -> None:
+    """Export ``dasmtl_mem_*`` families into an obs registry.  Called at
+    scrape/dump time, never from the acquire path."""
+    from dasmtl.obs.registry import default_registry
+
+    snap = snapshot()
+    reg = registry if registry is not None else default_registry()
+    reg.counter("dasmtl_mem_acquires_total",
+                "Staging leases handed out since memtrack came on"
+                ).set_total(snap["acquires"])
+    reg.counter("dasmtl_mem_releases_total",
+                "Staging leases returned").set_total(snap["releases"])
+    reg.gauge("dasmtl_mem_outstanding",
+              "Leases currently outstanding across all pools"
+              ).set(snap["outstanding"])
+    reg.gauge("dasmtl_mem_resident_bytes",
+              "Host bytes currently leased/staged across all pools"
+              ).set(snap["resident_bytes"])
+    reg.gauge("dasmtl_mem_peak_resident_bytes",
+              "Peak host staging bytes observed (the membudget number)"
+              ).set(snap["peak_resident_bytes"])
+    reg.counter("dasmtl_mem_leaks_total",
+                "Leases still outstanding at a drain check"
+                ).set_total(len(snap["leaks"]))
+    reg.counter("dasmtl_mem_double_releases_total",
+                "Buffers released without an outstanding lease"
+                ).set_total(len(snap["double_releases"]))
+    reg.counter("dasmtl_mem_canary_hits_total",
+                "Use-after-release writes caught by the NaN canary"
+                ).set_total(len(snap["canary"]))
+    reg.counter("dasmtl_mem_retirement_failures_total",
+                "Device values that changed after host-slot retirement"
+                ).set_total(len(snap["retirements"]))
+    reg.counter("dasmtl_mem_canary_poisons_total",
+                "Released buffers poisoned with the NaN canary"
+                ).set_total(snap["canary_poisons"])
+
+
+def dump_jsonl(path: str) -> int:
+    """Trace-style dump: one JSON record per line (pool stats, then
+    findings).  Returns the record count."""
+    snap = snapshot()
+    records: List[dict] = [
+        {"kind": "pool", "name": name, **stats}
+        for name, stats in snap["pools"].items()]
+    records.extend(snap["leaks"])
+    records.extend(snap["double_releases"])
+    records.extend(snap["canary"])
+    records.extend(snap["retirements"])
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    return len(records)
+
+
+_atexit_registered: Set[str] = set()
+
+
+def dump_jsonl_at_exit(path: str) -> None:
+    import atexit
+
+    if path in _atexit_registered:
+        return
+    _atexit_registered.add(path)
+    atexit.register(lambda: _state is not None and dump_jsonl(path))
+
+
+# CI subprocess legs arm via the environment.  Must stay at module
+# BOTTOM: enable() installs the scrape-time publish hook, defined above.
+if _env_on():
+    enable()

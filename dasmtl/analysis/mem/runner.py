@@ -1,0 +1,392 @@
+"""Orchestration + CLI for the memory suite (``dasmtl-mem``).
+
+Three verbs:
+
+- **exercise run** (default): arm leasedep fresh per tier, drive the
+  staged train pipeline plus the serve + stream selftests in-process
+  (the preset picks which), and report the per-tier footprint plus any
+  runtime findings — leaked leases (MEM501), double releases (MEM502),
+  canary hits (MEM503), retirement failures (MEM504).
+  ``--check-baseline`` additionally diffs the measured peaks against
+  the committed ``artifacts/membudget_baseline.json`` (MEM505 on
+  growth past tolerance or a missing file); ``--update-baseline``
+  regenerates it (unexercised tiers survive — review the diff,
+  commit).
+- ``--self-test``: fault injection — plant a leaked lease, a double
+  release, a freelist write (canary), an aliased retirement, a budget
+  bust, and a raw hot-path allocation
+  (:mod:`dasmtl.analysis.mem.faults`) and verify MEM501-505 / DAS401
+  catch them, each with a clean variant that must stay silent.  A
+  checker that misses its fault fails the run.
+- ``--list-exercises``: print the exercises and presets.
+
+Exit code: 1 on any **error**-severity finding.
+
+Backend handling mirrors the audit CLI: the CPU backend is pinned
+before jax initializes and donation is disabled for the process — an
+analysis tool must never touch this container's TPU tunnel.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from dasmtl.analysis.mem import leasedep
+from dasmtl.analysis.mem.baseline import (DEFAULT_BASELINE_PATH,
+                                          check_budgets, load_baseline,
+                                          update_baseline)
+
+
+def _pin_backend(min_devices: int = 1) -> None:
+    os.environ["DASMTL_DISABLE_DONATION"] = "1"
+    from dasmtl.analysis.audit.runner import _pin_cpu_backend
+
+    _pin_cpu_backend(min_devices)
+
+
+# -- exercises ---------------------------------------------------------------
+
+def _train_exercise(verbose: bool) -> dict:
+    """The staged training input pipeline on a synthetic source:
+    assemble into staging leases, place, release_placed — the
+    train-tier footprint without a model compile."""
+    import jax
+    import numpy as np
+
+    from dasmtl.data.pipeline import BatchAssembler
+    from dasmtl.data.sources import ArraySource
+
+    rng = np.random.default_rng(0)
+    n, channels, window = 48, 4, 32
+    source = ArraySource(
+        rng.standard_normal((n, channels, window)).astype(np.float32),
+        rng.standard_normal((n,)).astype(np.float32),
+        rng.integers(0, 2, size=(n,)).astype(np.int32))
+    assembler = BatchAssembler(source, 8, depth=2)
+    failures: List[str] = []
+    for step in range(6):
+        idx = (np.arange(8) + step * 8) % n
+        staged = assembler.assemble(idx, rng)
+        placed = jax.device_put(staged.data)
+        jax.block_until_ready(placed)
+        staged.release(placed)
+    snap = leasedep.snapshot()
+    if snap["enabled"] and snap["outstanding"]:
+        failures.append(f"{snap['outstanding']} lease(s) outstanding "
+                        f"after the staged epoch")
+    if verbose:
+        print(f"[train] {snap['acquires']} lease(s), peak resident "
+              f"{snap['peak_resident_bytes']}B")
+    return {"passed": not failures, "failures": failures}
+
+
+def _serve_exercise(verbose: bool) -> dict:
+    from dasmtl.serve.selftest import run_selftest
+
+    return run_selftest(verbose=verbose)
+
+
+def _stream_exercise(verbose: bool) -> dict:
+    from dasmtl.stream.selftest import run_selftest
+
+    say = print if verbose else (lambda *_a, **_k: None)
+    return run_selftest(say=say)
+
+
+EXERCISES: Dict[str, Callable[[bool], dict]] = {
+    "train": _train_exercise,
+    "serve": _serve_exercise,
+    "stream": _stream_exercise,
+}
+
+PRESETS: Dict[str, Tuple[str, ...]] = {
+    "quick": ("train",),
+    "ci": ("train", "serve"),
+    "full": ("train", "serve", "stream"),
+}
+
+
+def resolve_exercises(preset: str,
+                      names: Optional[str]) -> List[str]:
+    if names:
+        picked = [n.strip() for n in names.split(",") if n.strip()]
+        unknown = [n for n in picked if n not in EXERCISES]
+        if unknown:
+            raise ValueError(f"unknown exercise(s) {unknown}; known: "
+                             f"{sorted(EXERCISES)}")
+        return picked
+    return list(PRESETS[preset])
+
+
+def run_exercises(names: Sequence[str], *, canary: bool = True,
+                  verbose: bool = True
+                  ) -> Tuple[List[dict], Dict[str, dict]]:
+    """Arm leasedep fresh per tier (the budgets are per-tier peaks),
+    run each exercise, drain-check, and return (findings, measured) —
+    measured feeds the baseline verbs."""
+    findings: List[dict] = []
+    measured: Dict[str, dict] = {}
+    for name in names:
+        leasedep.enable(canary, reset=True)
+        report = EXERCISES[name](verbose)
+        if not report.get("passed", False):
+            findings.append({
+                "id": "MEM500", "severity": "error",
+                "message": f"{name} selftest failed under memtrack: "
+                           f"{report.get('failures')}",
+            })
+        leasedep.drain_check(f"{name} exercise drain")
+        snap = leasedep.snapshot()
+        findings.extend(runtime_findings(snap, exercise=name))
+        measured[name] = {
+            "peak_resident_bytes": snap["peak_resident_bytes"],
+            "peak_outstanding": snap["peak_outstanding"],
+        }
+    return findings, measured
+
+
+def runtime_findings(snap: dict, exercise: str = "") -> List[dict]:
+    """Map a leasedep snapshot's finding lists to MEM50x records."""
+    where = f" [{exercise}]" if exercise else ""
+    out: List[dict] = []
+    for f in snap["leaks"]:
+        out.append({
+            "id": "MEM501", "severity": "error",
+            "message": f"leaked lease(s){where}: {f['message']} — "
+                       f"pool {f['pool']}, slots {f['slots']}, "
+                       f"{f['bytes']}B stranded",
+        })
+    for f in snap["double_releases"]:
+        out.append({
+            "id": "MEM502", "severity": "error",
+            "message": f"double release{where}: pool {f['pool']} slot "
+                       f"{f['slot']} — {f['message']}",
+        })
+    for f in snap["canary"]:
+        out.append({
+            "id": "MEM503", "severity": "error",
+            "message": f"use-after-release{where}: pool {f['pool']} "
+                       f"slot {f['slot']} — {f['message']}",
+        })
+    for f in snap["retirements"]:
+        out.append({
+            "id": "MEM504", "severity": "error",
+            "message": f"retirement failure{where}: pool {f['pool']} "
+                       f"({f['context']}) — {f['message']}",
+        })
+    return out
+
+
+# -- fault-injection self-test ------------------------------------------------
+
+def self_test(verbose: bool = True) -> List[dict]:
+    """Prove each checker catches its fault.  Returns findings for
+    every fault that went UNCAUGHT (empty = the suite works)."""
+    from dasmtl.analysis.lint import lint_source
+    from dasmtl.analysis.mem import faults
+
+    findings: List[dict] = []
+
+    def note(msg: str) -> None:
+        if verbose:
+            print(f"[self-test] {msg}")
+
+    def miss(id_: str, msg: str) -> None:
+        findings.append({"id": id_, "severity": "error", "message": msg})
+
+    def leg(fault: str, exercise: Callable[[], None], key: str,
+            id_: str, what: str) -> None:
+        """Injected variant must record under ``key``; clean must not."""
+        leasedep.enable(reset=True)
+        with faults.inject(fault):
+            exercise()
+        hits = leasedep.snapshot()[key]
+        if hits:
+            note(f"{id_} caught injected {what}: {hits[0]['message']}")
+        else:
+            miss(id_, f"injected {what} was NOT caught — no "
+                      f"{key} finding recorded")
+        leasedep.enable(reset=True)
+        exercise()
+        snap = leasedep.snapshot()
+        if snap[key]:
+            miss(id_, f"clean {what} exercise produced a spurious "
+                      f"finding: {snap[key]}")
+        elif not snap["acquires"] and key != "retirements":
+            miss(id_, f"clean {what} exercise recorded no leases — the "
+                      f"tracker hooks are not reporting")
+        else:
+            note(f"clean {what} exercise: silent")
+
+    leg("leaked_lease", faults.run_lease_exercise, "leaks",
+        "MEM501", "leaked lease")
+    leg("double_release", faults.run_lease_exercise, "double_releases",
+        "MEM502", "double release")
+    leg("use_after_release", faults.run_canary_exercise, "canary",
+        "MEM503", "freelist write (use-after-release)")
+    leg("retire_alias", faults.run_retirement_exercise, "retirements",
+        "MEM504", "aliased retirement")
+
+    # Budget bust: the quadrupled footprint must fail the fixture
+    # baseline; the in-budget measurement must pass it.
+    with faults.inject("budget_bust"):
+        over = check_budgets(faults.measured_budgets(),
+                             faults.BASELINE_DOC, "<fixture>")
+    if any(f["id"] == "MEM505" for f in over):
+        note(f"MEM505 caught injected budget bust: "
+             f"{over[0]['message'].splitlines()[0]}")
+    else:
+        miss("MEM505", "injected budget bust was NOT caught against "
+                       "the fixture baseline")
+    clean = check_budgets(faults.measured_budgets(),
+                          faults.BASELINE_DOC, "<fixture>")
+    if clean:
+        miss("MEM505", f"in-budget measurement tripped the budget "
+                       f"check: {clean}")
+    else:
+        note("in-budget measurement passes the budget check")
+
+    # DAS401: the raw hot-path allocation must lint dirty; the
+    # stack_leaf spelling must lint clean.
+    with faults.inject("raw_hot_alloc"):
+        dirty = faults.allocation_snippet()
+    hits = [f for f in lint_source(dirty, "dasmtl/serve/<mem-self-test>")
+            if f.rule == "DAS401"]
+    if hits:
+        note(f"DAS401 caught injected raw hot-path allocation: "
+             f"{hits[0].message.splitlines()[0]}")
+    else:
+        miss("DAS401", "injected raw np.stack on a hot path was NOT "
+                       "caught by the static rules")
+    hits = [f for f in lint_source(faults.allocation_snippet(),
+                                   "dasmtl/serve/<mem-self-test>")
+            if f.rule.startswith("DAS4")]
+    if hits:
+        miss("DAS401", f"staged snippet tripped the memory rules: "
+                       f"{[f.render() for f in hits]}")
+    else:
+        note("staged snippet lints clean")
+
+    # Leave the tracker the way the process-level switches say.
+    if leasedep._env_on():
+        leasedep.enable(reset=True)
+    else:
+        leasedep.disable()
+    return findings
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def render(f: dict) -> str:
+    return f"{f['id']} [{f['severity']}] {f['message']}"
+
+
+def summary_line(findings: Sequence[dict]) -> str:
+    n_err = sum(1 for f in findings if f["severity"] == "error")
+    n_warn = len(findings) - n_err
+    status = "clean" if not findings else (f"{n_err} error(s), "
+                                           f"{n_warn} warning(s)")
+    return f"mem: {status}"
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dasmtl-mem",
+        description="Memory suite: runtime lease tracking (leaks, "
+                    "double releases, NaN canaries, retirement "
+                    "verification) over the staged train pipeline and "
+                    "the serve + stream selftests, gated by the "
+                    "committed membudget baseline "
+                    "(docs/STATIC_ANALYSIS.md).  The static half, "
+                    "rules DAS401-DAS405, runs under dasmtl-lint.")
+    ap.add_argument("--preset", choices=sorted(PRESETS), default="ci",
+                    help="exercise subset (default: ci)")
+    ap.add_argument("--exercises", type=str, default=None,
+                    help="comma-separated exercise names (overrides "
+                         "--preset; see --list-exercises)")
+    ap.add_argument("--no-canary", action="store_true",
+                    help="skip NaN-poisoning released buffers (keeps "
+                         "use-after-release detection off)")
+    ap.add_argument("--check-baseline", action="store_true",
+                    help="fail on measured footprints over the "
+                         "committed per-tier budgets")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write this run's measured peaks into the "
+                         "baseline (review the diff, commit)")
+    ap.add_argument("--baseline", type=str, default=DEFAULT_BASELINE_PATH)
+    ap.add_argument("--dump", type=str, default=None,
+                    help="write the final tier's pool stats + findings "
+                         "as JSONL")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the fault-injection legs instead of the "
+                         "exercises: each planted fault must be caught")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-exercises", action="store_true",
+                    help="print the exercises and presets, then exit")
+    args = ap.parse_args(argv)
+
+    if args.list_exercises:
+        for name in sorted(EXERCISES):
+            print(name)
+        for name, members in sorted(PRESETS.items()):
+            print(f"preset {name}: {', '.join(members)}")
+        return 0
+
+    if args.self_test:
+        findings = self_test(verbose=args.format == "text")
+        if args.format == "json":
+            print(json.dumps({"findings": findings}))
+        else:
+            for f in findings:
+                print(render(f))
+            print("self-test: "
+                  + ("all injected faults caught" if not findings
+                     else f"{len(findings)} fault(s) NOT caught"),
+                  file=sys.stderr)
+        return 1 if findings else 0
+
+    try:
+        names = resolve_exercises(args.preset, args.exercises)
+    except ValueError as exc:
+        ap.error(str(exc))
+    _pin_backend()
+
+    findings, measured = run_exercises(
+        names, canary=not args.no_canary,
+        verbose=args.format == "text")
+    if args.update_baseline:
+        doc = update_baseline(measured, args.baseline)
+        print(f"baseline written: {args.baseline} "
+              f"({len(doc['tiers'])} tier(s), {len(measured)} measured)",
+              file=sys.stderr)
+    elif args.check_baseline:
+        findings = findings + check_budgets(
+            measured, load_baseline(args.baseline), args.baseline)
+    if args.dump:
+        n = leasedep.dump_jsonl(args.dump)
+        print(f"dumped {n} record(s) to {args.dump}", file=sys.stderr)
+
+    if args.format == "json":
+        print(json.dumps({
+            "exercises": list(names),
+            "measured": measured,
+            "findings": findings,
+        }))
+    else:
+        for tier in names:
+            m = measured[tier]
+            print(f"{tier}: peak_resident_bytes="
+                  f"{m['peak_resident_bytes']} peak_outstanding="
+                  f"{m['peak_outstanding']}")
+        for f in findings:
+            print(render(f))
+        print(summary_line(findings), file=sys.stderr)
+    return 1 if any(f["severity"] == "error" for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

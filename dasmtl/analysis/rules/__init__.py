@@ -55,6 +55,6 @@ def all_rules() -> List[Rule]:
     # this one without a cycle.
     from dasmtl.analysis.rules import (concurrency, donation,  # noqa: F401
                                        dtype, host_sync, hygiene, loops,
-                                       prng, serve_sync, tracing)
+                                       memory, prng, serve_sync, tracing)
 
     return [r for _, r in sorted(_REGISTRY.items())]

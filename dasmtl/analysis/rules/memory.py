@@ -1,0 +1,461 @@
+"""Memory-discipline rules — the static half of ``dasmtl-mem``.
+
+The repo's device-memory story rests on three conventions: per-batch
+host buffers come from the aligned allocator / staging pools
+(``aligned_zeros``, ``StagingBuffers`` — a mis-aligned source silently
+loses zero-copy ``device_put`` and doubles H2D traffic), every staging
+lease goes back to its freelist on every path (a leaked lease shrinks
+the pool until ``acquire`` deadlocks), and a buffer handed to
+``release_placed`` or a donated argnum is DEAD — XLA or the next lease
+holder owns its bytes.  The seed era shipped one bug in exactly this
+class (the async checkpoint save aliasing live donated buffers, fixed
+in PR 1); these rules encode the conventions the way DAS301–305 encode
+the locking ones:
+
+DAS401 — raw ``np.zeros``/``np.empty``/``np.stack`` allocation in a
+  per-batch hot path (a loop body, or a hot-named method like
+  ``assemble``/``append``/``dispatch``) under the staged tiers
+  ``dasmtl/{data,serve,stream,train}/``.  Steady-state allocation
+  belongs to ``aligned_zeros``/``stack_leaf``/staging; cold setup
+  (``__init__``, ``warmup``, ``add_slot``) is exempt.
+DAS402 — ``<staging>.acquire(...)`` in a function that also releases
+  on the same pool, but never inside a ``try/finally`` — the success
+  path returns the lease, the exception arm leaks it.  (A function
+  with no release at all is a hand-off — the lease travels with the
+  buffer — and is clean; this mirrors DAS302's shape.)
+DAS403 — read of a buffer after it was passed to
+  ``release_placed``/``release`` (the lease is gone, the canary or the
+  next lease holder owns it) or to an *inline* donating jitted call
+  ``jax.jit(f, donate_argnums=...)(x)``.  The named-assignment form
+  (``fn = jax.jit(f, donate_argnums=...)``; ``fn(x)``) is DAS107's
+  beat — this rule covers what DAS107 structurally cannot see.
+DAS404 — ``jax.device_put`` of a host array provably from a raw numpy
+  allocator (``np.zeros``/``np.stack``/``np.ascontiguousarray``/...)
+  in the staged tiers.  Unaligned sources forfeit zero-copy placement;
+  route them through ``aligned_zeros`` + ``np.copyto``.  Unknown
+  provenance is clean — false negatives over false positives, the
+  linter's standing contract.
+DAS405 — a function *decorated* donating (``@jax.jit(donate_argnums=
+  ...)`` or ``@functools.partial(jax.jit, donate_argnums=...)``) whose
+  call site re-reads the donated operand without rebinding.  The
+  decorator spelling is the second donation form DAS107's
+  assignment-tracking misses.
+
+Pool recognition is name-based (intra-module): a target assigned from
+``StagingBuffers(...)``/``StagingBuffers.for_buckets(...)``, or any
+receiver whose name contains ``staging``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dasmtl.analysis.lint import ModuleContext
+from dasmtl.analysis.rules import make_finding, rule
+from dasmtl.analysis.rules.donation import _chain
+
+#: Tiers whose per-batch paths must allocate through the aligned
+#: allocator / staging pools.
+_SCOPED_DIRS = ("dasmtl/data/", "dasmtl/serve/", "dasmtl/stream/",
+                "dasmtl/train/")
+
+#: Raw allocators that belong to aligned_zeros/stack_leaf on hot paths.
+_RAW_ALLOCATORS = frozenset({"numpy.zeros", "numpy.empty", "numpy.stack"})
+
+#: Allocators whose output device_put cannot zero-copy (DAS404) — the
+#: hot-path set plus the copy/concat conveniences that also return
+#: unaligned arrays.
+_UNALIGNED_SOURCES = _RAW_ALLOCATORS | frozenset({
+    "numpy.full", "numpy.asarray", "numpy.ascontiguousarray",
+    "numpy.concatenate"})
+
+#: Method names that ARE the per-batch hot path even outside a lexical
+#: loop (their caller loops).
+_HOT_NAMES = frozenset({"assemble", "assemble_into", "append", "dispatch",
+                        "submit", "collect"})
+
+#: Cold setup methods: allocation here is once-per-process, exempt even
+#: when loopy (warmup loops over buckets, not batches).
+_COLD_NAMES = frozenset({"__init__", "__post_init__", "warmup", "add_slot",
+                         "for_buckets"})
+
+
+def _scoped(ctx: ModuleContext) -> bool:
+    path = ctx.path.replace("\\", "/")
+    return any(d in path for d in _SCOPED_DIRS)
+
+
+def _all_functions(ctx: ModuleContext) -> List[ast.AST]:
+    return [fn for fns in ctx.functions.values() for fn in fns]
+
+
+def _is_pool_key(key: Optional[str], pools: Set[str]) -> bool:
+    return key is not None and (key in pools or "staging" in key.lower())
+
+
+def _pool_keys(ctx: ModuleContext) -> Set[str]:
+    """Targets assigned from ``StagingBuffers(...)`` /
+    ``StagingBuffers.for_buckets(...)`` anywhere in the module (literal
+    chain suffix — the class lives outside the resolver's roots)."""
+    pools: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        chain = _chain(node.value.func) or ""
+        if not (chain == "StagingBuffers" or ".StagingBuffers" in chain
+                or chain.endswith("StagingBuffers.for_buckets")):
+            continue
+        for tgt in node.targets:
+            key = _chain(tgt)
+            if key:
+                pools.add(key)
+    return pools
+
+
+# -- DAS401: raw allocation on a per-batch hot path --------------------------
+
+@rule("DAS401", "warning",
+      "raw np.zeros/np.empty/np.stack on a per-batch hot path "
+      "(use aligned_zeros/stack_leaf/staging)")
+def check_hot_allocation(ctx: ModuleContext) -> Iterator:
+    if not _scoped(ctx):
+        return
+    for fn in _all_functions(ctx):
+        name = getattr(fn, "name", "")
+        if name in _COLD_NAMES:
+            continue
+        hot_fn = name in _HOT_NAMES
+        for node, in_loop in _walk_with_loops(fn):
+            if not (in_loop or hot_fn):
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.resolve(node.func)
+            if resolved not in _RAW_ALLOCATORS:
+                continue
+            where = "inside a loop" if in_loop else f"in {name}()"
+            yield make_finding(
+                ctx, "DAS401", node,
+                f"raw {resolved.replace('numpy.', 'np.')} on a per-batch "
+                f"hot path ({where}) — steady-state host allocation "
+                f"belongs to aligned_zeros/stack_leaf or a staging pool "
+                f"(dasmtl/data/staging.py); raw arrays lose zero-copy "
+                f"device_put and churn the allocator every batch")
+
+
+def _walk_with_loops(fn: ast.AST) -> Iterator[Tuple[ast.AST, bool]]:
+    """(node, lexically-inside-a-loop) for the function body, stopping
+    at nested defs (they are visited as their own functions)."""
+
+    def walk(node: ast.AST, in_loop: bool) -> Iterator[Tuple[ast.AST, bool]]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            inner = in_loop or isinstance(
+                node, (ast.For, ast.AsyncFor, ast.While))
+            yield child, inner
+            yield from walk(child, inner)
+
+    yield from walk(fn, False)
+
+
+# -- DAS402: acquire whose release is not exception-safe ---------------------
+
+@rule("DAS402", "error",
+      "staging acquire whose release is not in a try/finally "
+      "(an exception leaks the lease)")
+def check_lease_release(ctx: ModuleContext) -> Iterator:
+    pools = _pool_keys(ctx)
+    for fn in _all_functions(ctx):
+        acquires: List[Tuple[ast.AST, str]] = []
+        releases: Set[str] = set()
+        released_in_finally: Set[str] = set()
+        for node in ctx.body_walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            key = _chain(node.func.value)
+            if not _is_pool_key(key, pools):
+                continue
+            if node.func.attr == "acquire":
+                acquires.append((node, key))
+            elif node.func.attr in ("release", "release_placed"):
+                releases.add(key)
+        if not acquires or not releases:
+            # No acquire, or acquire-and-hand-off (the lease travels
+            # with the returned buffer — StagedBatch's contract).
+            continue
+        for stmt in ctx.body_walk(fn):
+            if not isinstance(stmt, ast.Try):
+                continue
+            for final_stmt in stmt.finalbody:
+                for node in ast.walk(final_stmt):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr in ("release",
+                                                   "release_placed")):
+                        key = _chain(node.func.value)
+                        if _is_pool_key(key, pools):
+                            released_in_finally.add(key)
+        for node, key in acquires:
+            if key in released_in_finally:
+                continue
+            yield make_finding(
+                ctx, "DAS402", node,
+                f"{key}.acquire() is released in this function but not "
+                f"from a finally block — an exception between acquire "
+                f"and release leaks the lease and shrinks the pool "
+                f"until acquire() deadlocks; wrap the leased region in "
+                f"try/finally (mirrors DAS302 for locks)")
+
+
+# -- shared use-after scan for DAS403/DAS405 ---------------------------------
+
+def _scan_use_after(ctx: ModuleContext, fn: ast.AST, rule_id: str,
+                    donors, message) -> Iterator:
+    """DAS107-style event scan: ``donors(call) -> (label, [victims])``
+    marks values dead at the end of the call; a later load without an
+    intervening rebind yields a finding via ``message(victim, label)``."""
+    events: List[Tuple[int, int, int, object]] = []
+    for node in ctx.body_walk(fn):
+        if isinstance(node, ast.Call):
+            hit = donors(node)
+            if hit is not None:
+                label, victims = hit
+                if victims:
+                    events.append((node.end_lineno or node.lineno,
+                                   (node.end_col_offset or 0) + 1, 1,
+                                   (label, victims)))
+        if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                getattr(node, "ctx", None), ast.Load):
+            name = _chain(node)
+            if name:
+                events.append((node.lineno, node.col_offset, 0,
+                               (name, node)))
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for tgt in targets:
+                elts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) \
+                    else [tgt]
+                for e in elts:
+                    name = _chain(e)
+                    if name:
+                        events.append((node.end_lineno or node.lineno,
+                                       10 ** 6, 2, name))
+        if isinstance(node, ast.For):
+            name = _chain(node.target)
+            if name:
+                events.append((node.lineno, 10 ** 6, 2, name))
+    events.sort(key=lambda e: (e[0], e[1], e[2]))
+    dead: Dict[str, str] = {}
+    for _line, _col, kind, payload in events:
+        if kind == 1:
+            label, victims = payload
+            for v in victims:
+                dead[v] = label
+        elif kind == 2:
+            dead.pop(payload, None)
+        else:
+            name, node = payload
+            for victim, label in dead.items():
+                if name == victim or name.startswith(victim + "."):
+                    yield make_finding(ctx, rule_id, node,
+                                       message(victim, label))
+                    dead.pop(victim, None)
+                    break
+
+
+def _inline_donated_victims(node: ast.Call) -> Optional[List[str]]:
+    """Victims of ``jax.jit(f, donate_argnums=...)(x, ...)`` — the
+    donating wrapper called immediately, which DAS107's assignment
+    tracking cannot see.  Resolution is literal (``jax.jit``/
+    ``jit``/``pjit`` chain tails) because the inner call is an
+    expression, not an assignment."""
+    if not isinstance(node.func, ast.Call):
+        return None
+    inner = node.func
+    chain = _chain(inner.func) or ""
+    if not (chain.endswith("jax.jit") or chain == "jit"
+            or chain.endswith("pjit")):
+        return None
+    donated = _donate_argnums(inner.keywords)
+    if not donated:
+        return None
+    victims = []
+    for pos in donated:
+        if pos < len(node.args):
+            victim = _chain(node.args[pos])
+            if victim:
+                victims.append(victim)
+    return victims
+
+
+def _donate_argnums(keywords: List[ast.keyword]) -> Tuple[int, ...]:
+    for kw in keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        if isinstance(kw.value, ast.Constant) and isinstance(
+                kw.value.value, int):
+            return (kw.value.value,)
+        if isinstance(kw.value, (ast.Tuple, ast.List)):
+            return tuple(e.value for e in kw.value.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, int))
+    return ()
+
+
+# -- DAS403: use after release/retire/inline-donate --------------------------
+
+@rule("DAS403", "error",
+      "buffer read after release/release_placed or an inline donating "
+      "call (the lease or the buffers are gone)")
+def check_use_after_retire(ctx: ModuleContext) -> Iterator:
+    pools = _pool_keys(ctx)
+
+    def donors(node: ast.Call):
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("release", "release_placed")
+                and node.args):
+            key = _chain(node.func.value)
+            if _is_pool_key(key, pools):
+                victim = _chain(node.args[0])
+                if victim:
+                    return f"{key}.{node.func.attr}", [victim]
+            return None
+        victims = _inline_donated_victims(node)
+        if victims:
+            return "an inline donating jax.jit call", victims
+        return None
+
+    def message(victim: str, label: str) -> str:
+        return (f"{victim!r} was handed to {label} above — the lease is "
+                f"retired and its bytes belong to the pool canary, the "
+                f"next lease holder, or XLA; read the placed/returned "
+                f"value instead (use-after-retire)")
+
+    for fn in _all_functions(ctx):
+        yield from _scan_use_after(ctx, fn, "DAS403", donors, message)
+
+
+# -- DAS404: device_put of a provably-unaligned host array -------------------
+
+@rule("DAS404", "warning",
+      "device_put of a host array from a raw numpy allocator "
+      "(unaligned source forfeits zero-copy placement)")
+def check_unaligned_device_put(ctx: ModuleContext) -> Iterator:
+    if not _scoped(ctx):
+        return
+    for fn in _all_functions(ctx):
+        # body_walk yields nodes in arbitrary order, so provenance is
+        # replayed positionally: assignment and device_put events sorted
+        # by source location, a dict of name -> allocator updated along
+        # the way (same linear-scan idiom as DAS403/DAS107).
+        events: List[Tuple[int, int, int, object]] = []
+        for node in ctx.body_walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                resolved = ctx.resolve(node.value.func)
+                alloc = (resolved if resolved in _UNALIGNED_SOURCES
+                         else None)
+                for tgt in node.targets:
+                    name = _chain(tgt)
+                    if name is not None:
+                        events.append((node.lineno, node.col_offset, 0,
+                                       (name, alloc)))
+            elif (isinstance(node, ast.Call)
+                  and ctx.resolve(node.func) == "jax.device_put"
+                  and node.args):
+                events.append((node.lineno, node.col_offset, 1, node))
+        provenance: Dict[str, str] = {}
+        for _line, _col, kind, payload in sorted(
+                events, key=lambda e: (e[0], e[1], e[2])):
+            if kind == 0:
+                name, alloc = payload
+                if alloc is not None:
+                    provenance[name] = alloc
+                else:
+                    # Any other reassignment launders the name —
+                    # unknown provenance is clean by contract.
+                    provenance.pop(name, None)
+                continue
+            node = payload
+            src = node.args[0]
+            alloc = None
+            if isinstance(src, ast.Call):
+                resolved = ctx.resolve(src.func)
+                if resolved in _UNALIGNED_SOURCES:
+                    alloc = resolved
+            else:
+                name = _chain(src)
+                if name is not None:
+                    alloc = provenance.get(name)
+            if alloc is None:
+                continue
+            yield make_finding(
+                ctx, "DAS404", node,
+                f"device_put of a {alloc.replace('numpy.', 'np.')} array "
+                f"— raw numpy allocations are not 64-byte aligned, so "
+                f"placement falls off the zero-copy path and copies on "
+                f"host; allocate through aligned_zeros "
+                f"(dasmtl/data/staging.py) and np.copyto into it")
+
+
+# -- DAS405: decorator-declared donation re-read at the call site ------------
+
+def _decorated_donors(ctx: ModuleContext) -> Dict[str, Tuple[int, ...]]:
+    """name -> donated positions for functions *decorated* donating:
+    ``@jax.jit(donate_argnums=...)`` or ``@functools.partial(jax.jit,
+    donate_argnums=...)`` (DAS107 covers the assignment spelling)."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for deco in node.decorator_list:
+            if not isinstance(deco, ast.Call):
+                continue
+            resolved = ctx.resolve(deco.func)
+            donated: Tuple[int, ...] = ()
+            if resolved in ("jax.jit", "jax.pjit",
+                            "jax.experimental.pjit.pjit"):
+                donated = _donate_argnums(deco.keywords)
+            elif resolved == "functools.partial" and deco.args:
+                if ctx.resolve(deco.args[0]) in (
+                        "jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"):
+                    donated = _donate_argnums(deco.keywords)
+            if donated:
+                out[node.name] = donated
+    return out
+
+
+@rule("DAS405", "error",
+      "donated operand re-read after calling a donating-decorated "
+      "function (donate_argnums invalidates its buffers)")
+def check_decorated_donation_reuse(ctx: ModuleContext) -> Iterator:
+    donating = _decorated_donors(ctx)
+    if not donating:
+        return
+
+    def donors(node: ast.Call):
+        name = _chain(node.func)
+        if name not in donating:
+            return None
+        victims = []
+        for pos in donating[name]:
+            if pos < len(node.args):
+                victim = _chain(node.args[pos])
+                if victim:
+                    victims.append(victim)
+        return name, victims
+
+    def message(victim: str, label: str) -> str:
+        return (f"{victim!r} was donated to {label}() above (declared "
+                f"donate_argnums on its decorator) and its buffers are "
+                f"dead; rebind the result ({victim} = {label}(...)) "
+                f"before reading it")
+
+    for fn in _all_functions(ctx):
+        yield from _scan_use_after(ctx, fn, "DAS405", donors, message)

@@ -120,6 +120,20 @@ def conc_main(argv=None) -> int:
     return main(argv)
 
 
+def mem_main(argv=None) -> int:
+    """``dasmtl-mem`` — the memory-discipline suite
+    (dasmtl/analysis/mem/; DAS401-DAS405 + MEM50x in
+    docs/STATIC_ANALYSIS.md).  Drives the staged train pipeline and the
+    serve + stream selftests with runtime lease tracking armed on a CPU
+    backend it pins itself, gates the measured per-tier footprint
+    against the committed membudget baseline, and proves itself by
+    fault injection (--self-test)."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from dasmtl.analysis.mem.runner import main
+
+    return main(argv)
+
+
 def obs_main(argv=None) -> int:
     """``dasmtl-obs`` — the unified telemetry layer's CLI
     (dasmtl/obs/; docs/OBSERVABILITY.md): ``dump`` span records or
@@ -170,6 +184,8 @@ _SUBCOMMANDS = {
                  "runtime SPMD sanitizer suite (dasmtl-sanitize)"),
     "conc": (conc_main, "concurrency suite: runtime lockdep + "
                         "lock-order baseline (dasmtl-conc)"),
+    "mem": (mem_main, "memory suite: runtime lease tracking + "
+                      "membudget baseline (dasmtl-mem)"),
     "obs": (obs_main, "telemetry: trace dump/join, exposition check, "
                       "alert selftest, profiler capture+analyze "
                       "(dasmtl-obs)"),

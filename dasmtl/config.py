@@ -313,6 +313,16 @@ class Config:
     conc_lockdep: bool = False
     conc_hold_warn_ms: float = 200.0
     conc_dump_path: Optional[str] = None  # JSONL findings dump at exit
+    # Runtime lease tracking (dasmtl/analysis/mem/leasedep.py): off by
+    # default — the disabled factory hands pools a None tracker, zero
+    # overhead.  Selftests and the CI mem job arm it (also via
+    # DASMTL_MEM_TRACK=1) to account every staging lease, catch leaks /
+    # double releases / use-after-release (NaN canary) / retirement
+    # failures, and measure the per-tier footprint budgeted by
+    # artifacts/membudget_baseline.json.
+    mem_track: bool = False
+    mem_canary: bool = True  # NaN-poison released buffers while tracking
+    mem_dump_path: Optional[str] = None  # JSONL findings dump at exit
 
     # ---- misc ----
     seed: int = 1
@@ -957,6 +967,21 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
                    default=d.conc_dump_path,
                    help="JSONL path for the lockdep graph + findings "
                         "dump at process exit (requires --conc_lockdep)")
+    p.add_argument("--mem_track", action=argparse.BooleanOptionalAction,
+                   default=d.mem_track,
+                   help="arm runtime staging-lease tracking (leasedep): "
+                        "account every acquire/release, catch leaks, "
+                        "double releases, use-after-release and "
+                        "retirement failures (dasmtl-mem)")
+    p.add_argument("--mem_canary", action=argparse.BooleanOptionalAction,
+                   default=d.mem_canary,
+                   help="NaN-poison released staging buffers while "
+                        "tracking, so use-after-release fails loudly")
+    p.add_argument("--mem_dump_path", type=str,
+                   default=d.mem_dump_path,
+                   help="JSONL path for the leasedep pool stats + "
+                        "findings dump at process exit (requires "
+                        "--mem_track)")
 
 
 def _resolve_compat(ns: argparse.Namespace) -> dict:

@@ -40,6 +40,7 @@ from typing import Any, Dict, Hashable, Optional, Sequence, Tuple
 import numpy as np
 
 from dasmtl.analysis.conc import lockdep
+from dasmtl.analysis.mem import leasedep
 
 #: spec leaf: (shape tuple, numpy dtype)
 SpecLeaf = Tuple[tuple, Any]
@@ -131,11 +132,14 @@ class StagingBuffers:
     """
 
     def __init__(self, specs: Optional[Dict[Hashable, Any]] = None, *,
-                 depth: int = 2):
+                 depth: int = 2, name: str = "StagingBuffers"):
         self.depth = max(1, int(depth))
         self._lock = lockdep.lock("StagingBuffers._lock")
         self._available = lockdep.condition("StagingBuffers._available",
                                             self._lock)
+        # None unless leasedep is armed (dasmtl-mem / DASMTL_MEM_TRACK):
+        # the steady state pays one `is not None` per acquire/release.
+        self._mem = leasedep.tracker(name)
         self._free: Dict[Hashable, list] = {}
         self._specs: Dict[Hashable, Any] = {}
         self._out: Dict[int, Hashable] = {}  # id(buf) -> slot key
@@ -148,7 +152,9 @@ class StagingBuffers:
 
     @classmethod
     def for_buckets(cls, buckets: Sequence[int], input_hw,
-                    depth: int, dtype=np.float32) -> "StagingBuffers":
+                    depth: int, dtype=np.float32, *,
+                    name: str = "StagingBuffers.buckets"
+                    ) -> "StagingBuffers":
         """The serve layout: one ``(bucket, h, w, 1)`` array per
         configured bucket size (the PR 5 constructor, now a classmethod of
         the shared home).  ``dtype`` is the executor's staging dtype —
@@ -158,7 +164,7 @@ class StagingBuffers:
         presets')."""
         h, w = int(input_hw[0]), int(input_hw[1])
         return cls({int(b): ((int(b), h, w, 1), np.dtype(dtype))
-                    for b in buckets}, depth=depth)
+                    for b in buckets}, depth=depth, name=name)
 
     # -- slots ---------------------------------------------------------------
     def add_slot(self, key: Hashable, spec) -> None:
@@ -185,6 +191,8 @@ class StagingBuffers:
             self._out[id(buf)] = key
             self._peak_outstanding = max(self._peak_outstanding,
                                          len(self._out))
+            if self._mem is not None:
+                self._mem.acquired(buf, slot=key)
             return buf
 
     def release(self, buf) -> None:
@@ -194,6 +202,8 @@ class StagingBuffers:
         releases through :meth:`release_placed`)."""
         with self._available:
             key = self._out.pop(id(buf))
+            if self._mem is not None:
+                self._mem.released(buf, slot=key)
             self._free[key].append(buf)
             self._available.notify()
 
@@ -241,10 +251,21 @@ class StagingBuffers:
                 with self._available:
                     key = self._out.pop(id(buf))
                     self._out[id(swaps[0])] = key
+                if self._mem is not None:
+                    self._mem.relink(buf, swaps[0])
                 buf = swaps[0]
         with self._lock:
             self._replaced += replaced
+        # Armed-only MEM504 verification: sample the placed device value
+        # before the release (which retires + canary-poisons the host
+        # leaves) and re-check it after — a changed device value means
+        # it still aliased a host slot this release just rewrote.
+        sample = self._mem.device_sample(placed) \
+            if self._mem is not None else None
         self.release(buf)
+        if self._mem is not None:
+            self._mem.verify_retirement(sample, placed,
+                                        "StagingBuffers.release_placed")
 
     # -- reporting -----------------------------------------------------------
     @property

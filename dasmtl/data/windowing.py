@@ -35,6 +35,7 @@ import numpy as np
 
 from dasmtl.config import INPUT_HEIGHT, INPUT_WIDTH
 from dasmtl.data.pipeline import pad_to_bucket
+from dasmtl.data.staging import aligned_zeros
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,7 +184,9 @@ def window_index_batches(plan: WindowPlan, batch_size: int,
     for b0, n in _batch_ranges(plan, batch_size, process_index,
                                process_count):
         index = np.arange(b0, b0 + n, dtype=np.int64)
-        origin = np.zeros((n, 2), np.int32)
+        # Aligned so the downstream device_put of a full batch stays on
+        # the zero-copy path (partial batches reallocate in pad_to_bucket).
+        origin = aligned_zeros((n, 2), np.int32)
         for j in range(n):
             origin[j] = plan.origin(b0 + j)
         yield pad_to_bucket({"index": index, "origin": origin,
@@ -214,8 +217,8 @@ def window_batches(record: np.ndarray, batch_size: int,
     h, w = plan.window
     for b0, n in _batch_ranges(plan, batch_size, process_index,
                                process_count):
-        x = np.zeros((n, h, w, 1), np.float32)
-        weight = np.zeros((n,), np.float32)
+        x = aligned_zeros((n, h, w, 1), np.float32)
+        weight = aligned_zeros((n,), np.float32)
         for j in range(n):
             win, wt = extract_window(record, plan, b0 + j)
             x[j, :, :, 0] = win

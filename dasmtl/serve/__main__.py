@@ -149,6 +149,24 @@ def main(argv=None) -> int:
                       default=d.conc_dump_path, metavar="PATH",
                       help="write the lockdep graph + findings as JSONL "
                            "at exit")
+    mem = p.add_argument_group("memory leasedep (dasmtl-mem, "
+                               "docs/STATIC_ANALYSIS.md)")
+    mem.add_argument("--mem_track",
+                     action=argparse.BooleanOptionalAction,
+                     default=d.mem_track,
+                     help="arm runtime staging-lease tracking: account "
+                          "every acquire/release, catch leaks, double "
+                          "releases and use-after-release (also "
+                          "DASMTL_MEM_TRACK=1)")
+    mem.add_argument("--mem_canary",
+                     action=argparse.BooleanOptionalAction,
+                     default=d.mem_canary,
+                     help="NaN-poison released staging buffers while "
+                          "tracking")
+    mem.add_argument("--mem_dump_path", type=str,
+                     default=d.mem_dump_path, metavar="PATH",
+                     help="write the leasedep pool stats + findings as "
+                          "JSONL at exit")
     p.add_argument("--parity-check", action="store_true",
                    dest="parity_check",
                    help="run the precision parity gate instead of "
@@ -177,11 +195,14 @@ def main(argv=None) -> int:
 
     apply_device(args.device)
 
-    # Arm lockdep BEFORE any ServeLoop/selftest lock is constructed —
-    # the factories consult the tracker at construction time.
+    # Arm lockdep/leasedep BEFORE any ServeLoop/selftest lock or
+    # staging pool is constructed — the factories consult the trackers
+    # at construction time.
     from dasmtl.analysis.conc import lockdep
+    from dasmtl.analysis.mem import leasedep
 
     lockdep.configure(args)
+    leasedep.configure(args)
 
     if args.selftest:
         from dasmtl.serve.selftest import run_selftest, write_job_summary

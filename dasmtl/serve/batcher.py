@@ -35,7 +35,7 @@ from dasmtl.analysis.conc import lockdep
 from dasmtl.data.pipeline import pad_to_bucket
 #: Re-export: the per-bucket staging freelist started here (PR 5) and now
 #: lives in the shared home both training and serving assemble through.
-from dasmtl.data.staging import StagingBuffers  # noqa: F401
+from dasmtl.data.staging import StagingBuffers, stack_leaf  # noqa: F401
 from dasmtl.obs.trace import TraceRing, make_span, mint_trace_id
 from dasmtl.serve.metrics import ServeMetrics
 from dasmtl.serve.queue import QueueClosed, Request, RequestQueue, ServeResult
@@ -91,7 +91,8 @@ class BatchPlan:
         so a partial batch is shape-identical to a full one (no
         recompiles).  Allocating convenience for non-pipelined callers;
         the serve loop assembles into staging buffers instead."""
-        x = np.stack([np.asarray(r.x, np.float32) for r in self.requests])
+        x = stack_leaf([np.asarray(r.x, np.float32)
+                        for r in self.requests])
         batch = pad_to_bucket({"x": x[..., None]}, self.bucket)
         return batch["x"]
 

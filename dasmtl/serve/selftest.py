@@ -86,12 +86,14 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
     adds the telemetry leg: mid-load /metrics scrapes over a real HTTP
     front end and a seeded SLO breach through the profiler hook."""
     from dasmtl.analysis.conc import lockdep
+    from dasmtl.analysis.mem import leasedep
     from dasmtl.obs.profiler import ProfilerHook
     from dasmtl.serve.executor import ExecutorPool
     from dasmtl.serve.server import (ServeLoop, install_signal_handlers,
                                      make_http_server)
 
     conc0 = lockdep.snapshot()
+    mem0 = leasedep.snapshot()
     executor = ExecutorPool.from_checkpoint(model, None, buckets,
                                             input_hw=input_hw,
                                             devices=devices,
@@ -332,10 +334,24 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
             f"{conc_report['unjoined']} unjoined, "
             f"{conc_report['long_holds']} long hold(s)")
 
+    # Memtrack leg (armed by CI / dasmtl-mem, {"enabled": False}
+    # otherwise): every staging lease the soak took must be back on its
+    # freelist, with no double releases, canary hits, or retirement
+    # failures.
+    leasedep.drain_check("serve selftest drain")
+    mem_failures, mem_report = leasedep.clean_since(mem0)
+    failures.extend(mem_failures)
+    if mem_report["enabled"]:
+        say(f"[serve-selftest] memtrack: {mem_report['pools']} pool(s), "
+            f"{mem_report['outstanding']} outstanding at drain, peak "
+            f"{mem_report['peak_resident_bytes']}B resident, "
+            f"{mem_report['leaks']} leak(s)")
+
     report = {
         "passed": not failures,
         "failures": failures,
         "lockdep": conc_report,
+        "memtrack": mem_report,
         "precision": precision,
         "requests": requests,
         "ok": n_ok,

@@ -379,7 +379,11 @@ class ServeLoop:
             staging = self._staging
             self._outstanding[id(executor)] = \
                 self._outstanding.get(id(executor), 0) + 1
-        buf = staging.acquire(plan.bucket)
+        # Hand-off lease: on success the lease travels through the
+        # completion queue and _collect releases it after device_get; the
+        # except arm below only covers the assemble/dispatch window, so a
+        # try/finally here would double-release every successful batch.
+        buf = staging.acquire(plan.bucket)  # dasmtl: noqa[DAS402]
         t_form = self.clock()
         try:
             plan.assemble_into(buf)
@@ -411,7 +415,10 @@ class ServeLoop:
         with self._cv:
             self._inflight += 1
             self.metrics.observe_inflight(self._inflight)
-        self._completion.put((plan, handle, buf, staging, executor))
+        # The release above lives in an except arm that returns — on this
+        # (success) path the lease is still live and travels to _collect.
+        self._completion.put(
+            (plan, handle, buf, staging, executor))  # dasmtl: noqa[DAS403]
 
     def _executor_done(self, executor) -> None:
         """One batch through ``executor`` finished (collected or failed):

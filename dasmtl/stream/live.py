@@ -41,6 +41,7 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from dasmtl.analysis.conc import lockdep
+from dasmtl.analysis.mem import leasedep
 from dasmtl.obs.alerts import AlertEngine, AlertRule
 from dasmtl.obs.history import MetricsHistory, handle_query
 from dasmtl.obs.registry import (DEFAULT_LATENCY_BUCKETS_S, MetricsRegistry)
@@ -846,6 +847,24 @@ def serve_main(argv=None) -> int:
                       default=d.conc_dump_path, metavar="PATH",
                       help="write the lockdep graph + findings as JSONL "
                            "at exit")
+    mem = p.add_argument_group("memory leasedep (dasmtl-mem, "
+                               "docs/STATIC_ANALYSIS.md)")
+    mem.add_argument("--mem_track",
+                     action=argparse.BooleanOptionalAction,
+                     default=d.mem_track,
+                     help="arm runtime staging-lease tracking: account "
+                          "every acquire/release, catch leaks, double "
+                          "releases and use-after-release (also "
+                          "DASMTL_MEM_TRACK=1)")
+    mem.add_argument("--mem_canary",
+                     action=argparse.BooleanOptionalAction,
+                     default=d.mem_canary,
+                     help="NaN-poison released staging buffers while "
+                          "tracking")
+    mem.add_argument("--mem_dump_path", type=str,
+                     default=d.mem_dump_path, metavar="PATH",
+                     help="write the leasedep pool stats + findings as "
+                          "JSONL at exit")
     p.add_argument("--host", type=str, default=d.serve_host)
     p.add_argument("--port", type=int, default=d.serve_port)
     p.add_argument("--port_file", type=str, default=None, metavar="PATH")
@@ -873,9 +892,11 @@ def serve_main(argv=None) -> int:
 
     apply_device(args.device)
 
-    # Arm lockdep BEFORE any loop/selftest lock is constructed — the
-    # factories consult the tracker at construction time.
+    # Arm lockdep/leasedep BEFORE any loop/selftest lock or staging
+    # pool is constructed — the factories consult the trackers at
+    # construction time.
     lockdep.configure(args)
+    leasedep.configure(args)
 
     if args.selftest:
         from dasmtl.stream.selftest import (run_selftest,

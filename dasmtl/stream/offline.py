@@ -25,6 +25,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from dasmtl.data.staging import aligned_zeros
 from dasmtl.stream.resident import collect_host
 
 EVENT_NAMES = ("striking", "excavating")
@@ -255,8 +256,13 @@ def stream_predict(record: np.ndarray, model_path: Optional[str],
         forward_resident = jax.jit(
             make_resident_forward(body, plan.window))
 
+        # Stage the record through an aligned buffer: a long fiber record
+        # is the largest single H2D transfer of the offline path, and an
+        # unaligned np.asarray result would fall off the zero-copy path.
+        record_host = aligned_zeros(record.shape, np.float32, zero=False)
+        np.copyto(record_host, record)
         record_dev = jax.device_put(
-            np.asarray(record, np.float32),
+            record_host,
             replicated_sharding(mesh_plan) if mesh_plan is not None
             else None)
         batches = window_index_batches(plan, batch_size,

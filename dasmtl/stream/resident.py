@@ -43,6 +43,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from dasmtl.analysis.mem import leasedep
+from dasmtl.data.staging import aligned_zeros
 from dasmtl.export import PROB_Q_SCALE, make_resident_serve_fn
 
 
@@ -127,8 +129,12 @@ class ResidentFeed:
                 ring, chunk, (0, ring.shape[1] - w_c))
 
         self._append_fn = jax.jit(_append, donate_argnums=0)
+        # Aligned source so the initial placement can zero-copy
+        # (DAS404); None unless leasedep is armed — the steady state
+        # pays one `is not None` per append.
+        self._mem = leasedep.tracker("stream.ResidentFeed")
         self.ring = jax.device_put(
-            np.zeros((self.channels, self.ring_samples), self.dtype),
+            aligned_zeros((self.channels, self.ring_samples), self.dtype),
             device)
 
     @property
@@ -147,11 +153,11 @@ class ResidentFeed:
         import jax
 
         z = jax.device_put(
-            np.zeros((self.channels, self.chunk_samples), self.dtype),
+            aligned_zeros((self.channels, self.chunk_samples), self.dtype),
             self.device)
         self.ring = self._append_fn(self.ring, z)
         self.ring = jax.device_put(
-            np.zeros((self.channels, self.ring_samples), self.dtype),
+            aligned_zeros((self.channels, self.ring_samples), self.dtype),
             self.device)
 
     def slot(self, t0: int) -> int:
@@ -188,7 +194,12 @@ class ResidentFeed:
             [self._pending, chunk.astype(self.dtype, copy=False)], axis=1)
         w_c = self.chunk_samples
         while self._pending.shape[1] >= w_c:
-            piece = np.ascontiguousarray(self._pending[:, :w_c])
+            # Aligned staging for the flushed piece (DAS404): an
+            # aligned source lets device_put zero-copy on CPU backends,
+            # where np.ascontiguousarray forfeited it.
+            piece = aligned_zeros((self.channels, w_c), self.dtype,
+                                  zero=False)
+            np.copyto(piece, self._pending[:, :w_c])
             self._pending = self._pending[:, w_c:]
             dev = jax.device_put(piece, self.device)
             self.ring = self._append_fn(self.ring, dev)
@@ -196,6 +207,18 @@ class ResidentFeed:
             self.h2d_bytes += piece.nbytes
             self.h2d_chunks += 1
             self._arrivals.append((self.total, now))
+            if self._mem is not None and np.issubdtype(self.dtype,
+                                                       np.floating):
+                # Armed-only MEM504: once the appended ring is ready,
+                # retiring (rewriting) the staged piece must not move
+                # the device value — catches a ring that still aliases
+                # the host slot.
+                sample = self._mem.device_sample(self.ring)
+                piece.fill(np.nan)
+                self._mem.verify_retirement(sample, self.ring,
+                                            "ResidentFeed.append")
+        if self._mem is not None:
+            self._mem.note_resident(self._pending.nbytes)
         while (len(self._arrivals) > 1
                and self._arrivals[1][0] <= self.oldest):
             self._arrivals.pop(0)

@@ -144,9 +144,11 @@ def run_selftest(*, fibers: int = 3, cycles: int = 140, devices: int = 1,
     dur = 512
 
     from dasmtl.analysis.conc import lockdep
+    from dasmtl.analysis.mem import leasedep
     from dasmtl.serve.server import ServeLoop
 
     conc0 = lockdep.snapshot()
+    mem0 = leasedep.snapshot()
     pool = _oracle_pool(window, buckets, devices)
     say(f"[stream-selftest] warming oracle pool: buckets {list(buckets)} "
         f"x {len(pool.executors)} device(s) ...")
@@ -522,11 +524,25 @@ def run_selftest(*, fibers: int = 3, cycles: int = 140, devices: int = 1,
             f"{conc_report['unjoined']} unjoined, "
             f"{conc_report['long_holds']} long hold(s)")
 
+    # Memtrack leg (armed by CI / dasmtl-mem, {"enabled": False}
+    # otherwise): every staging lease the soak took must be back on its
+    # freelist, with no double releases, canary hits, or retirement
+    # failures.
+    leasedep.drain_check("stream selftest drain")
+    mem_failures, mem_report = leasedep.clean_since(mem0)
+    failures.extend(mem_failures)
+    if mem_report["enabled"]:
+        say(f"[stream-selftest] memtrack: {mem_report['pools']} pool(s), "
+            f"{mem_report['outstanding']} outstanding at drain, peak "
+            f"{mem_report['peak_resident_bytes']}B resident, "
+            f"{mem_report['leaks']} leak(s)")
+
     tstats = stream.stats()["tenants"]
     report = {
         "passed": not failures,
         "failures": failures,
         "lockdep": conc_report,
+        "memtrack": mem_report,
         "fibers": fibers,
         "resident": bool(resident),
         "cycles": cycles,

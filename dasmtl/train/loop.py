@@ -50,6 +50,7 @@ from dasmtl.analysis.sanitize.checks import StepSanitizer
 from dasmtl.analysis.sanitize.divergence import DivergenceMonitor
 from dasmtl.config import Config, mixed_label
 from dasmtl.data.device import DeviceDataset, resident_bytes, unwrap_source
+from dasmtl.data.staging import aligned_zeros
 from dasmtl.data.pipeline import (BatchAssembler, BatchIterator, eval_batches,
                                   prefetch)
 from dasmtl.models.registry import ModelSpec
@@ -75,9 +76,10 @@ def resident_eval_outputs(gather_eval_step, state, data, indices: np.ndarray,
     for start in range(0, n, batch_size):
         chunk = np.asarray(indices[start:start + batch_size])
         k = chunk.shape[0]
-        idx = np.zeros((batch_size,), np.int32)
+        # Aligned so the jitted step's H2D transfer stays zero-copy.
+        idx = aligned_zeros((batch_size,), np.int32)
         idx[:k] = chunk
-        weight = np.zeros((batch_size,), np.float32)
+        weight = aligned_zeros((batch_size,), np.float32)
         weight[:k] = 1.0
         out = jax.device_get(gather_eval_step(state, data, idx, weight))
         out["preds"] = {t: np.asarray(p)[:k]

@@ -212,6 +212,12 @@ def collect() -> dict:
             "conc_dump_path": d.conc_dump_path,
         },
         "lockorder_baseline": _lockorder_baseline_summary(),
+        "mem_defaults": {
+            "mem_track": d.mem_track,
+            "mem_canary": d.mem_canary,
+            "mem_dump_path": d.mem_dump_path,
+        },
+        "membudget_baseline": _membudget_baseline_summary(),
     }
     return info
 
@@ -293,6 +299,28 @@ def _lockorder_baseline_summary() -> dict:
     status = "ok" if gen == _generated_with() else "stale"
     return {"path": path, "status": status,
             "edges": len(data.get("edges", [])), "generated_with": gen}
+
+
+def _membudget_baseline_summary() -> dict:
+    """Status of the memory suite's committed per-tier footprint budgets
+    — metadata only, nothing executed.  ``stale`` means the recording
+    environment drifted (python/jax versions differ from this host):
+    the budgets still gate, but regenerate after justifying the bump."""
+    from dasmtl.analysis.mem.baseline import (DEFAULT_BASELINE_PATH,
+                                              _generated_with,
+                                              load_baseline)
+
+    path = DEFAULT_BASELINE_PATH
+    try:
+        data = load_baseline(path)
+    except (OSError, ValueError) as exc:
+        return {"path": path, "status": f"unreadable ({exc})"}
+    if data is None:
+        return {"path": path, "status": "missing"}
+    gen = data.get("generated_with", {})
+    status = "ok" if gen == _generated_with() else "stale"
+    return {"path": path, "status": status,
+            "tiers": len(data.get("tiers", {})), "generated_with": gen}
 
 
 def check_exported_artifact(path: str, window=None,
@@ -492,6 +520,25 @@ def main(argv=None) -> int:
         print(f"  conc: lock-order baseline "
               f"{lb.get('status', 'missing')} at {lb.get('path')} — "
               f"generate with dasmtl-conc --update-baseline "
+              f"--preset full")
+    print("  mem defaults: " + ", ".join(
+        f"{k}={v}" for k, v in ana.get("mem_defaults", {}).items()))
+    mb = ana.get("membudget_baseline", {})
+    if mb.get("status") == "ok":
+        print(f"  mem: membudget baseline ok — {mb['tiers']} tier(s) "
+              f"in {mb['path']}; verify with dasmtl-mem "
+              f"--check-baseline")
+    elif mb.get("status") == "stale":
+        gen = mb.get("generated_with", {})
+        gen_s = ", ".join(f"{k} {v}" for k, v in sorted(gen.items()))
+        print(f"  mem: membudget baseline STALE — {mb['tiers']} "
+              f"tier(s) in {mb['path']} recorded under {gen_s}; budgets "
+              f"still gate, refresh with dasmtl-mem --update-baseline "
+              f"after justifying the version bump")
+    else:
+        print(f"  mem: membudget baseline "
+              f"{mb.get('status', 'missing')} at {mb.get('path')} — "
+              f"generate with dasmtl-mem --update-baseline "
               f"--preset full")
     return rc
 

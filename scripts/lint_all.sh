@@ -52,6 +52,20 @@ else
     echo "== dasmtl-conc skipped (DASMTL_LINT_SKIP_CONC set)"
 fi
 
+# Memory-discipline suite: the fault-injection self-test (fake buffers +
+# AST snippet, no model compiles — cheap), then the membudget baseline
+# gate on the `quick` preset (one leasedep-armed train exercise).  CI's
+# mem job runs the wider `ci` preset plus standalone DASMTL_MEM_TRACK=1
+# serve/stream selftests.
+if [ "${DASMTL_LINT_SKIP_MEM:-}" = "" ]; then
+    echo "== dasmtl-mem --self-test"
+    python -m dasmtl.analysis.mem --self-test || rc=1
+    echo "== dasmtl-mem --check-baseline --preset quick"
+    python -m dasmtl.analysis.mem --check-baseline --preset quick || rc=1
+else
+    echo "== dasmtl-mem skipped (DASMTL_LINT_SKIP_MEM set)"
+fi
+
 # Online-serving smoke: the in-process selftest (concurrent clients, NaN
 # poisoning, SIGTERM drain, recompile/occupancy invariants) on a reduced
 # window — a few model compiles, so skippable for doc-only edits.

@@ -1,0 +1,712 @@
+"""dasmtl-mem: memory rules DAS401-DAS405 (positive + near-miss
+fixtures, same convention as test_analysis_conc.py), runtime leasedep
+(leaks, double releases, the NaN canary, retirement verification), the
+membudget baseline round-trip, and the fault-injection self-test.
+Fake numpy buffers + pure AST — no jitted compiles, fast."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from dasmtl.analysis.lint import lint_source
+from dasmtl.analysis.mem import baseline as mem_baseline
+from dasmtl.analysis.mem import faults, leasedep
+from dasmtl.analysis.mem.runner import (resolve_exercises,
+                                        runtime_findings, self_test)
+
+#: DAS401/DAS404 are scoped to the data-plane packages — fixtures lint
+#: under a scoped path; the scope tests swap in a models/ path.
+_SCOPED = "dasmtl/data/snippet.py"
+
+
+def ids(src: str, path: str = _SCOPED):
+    return sorted({f.rule for f in lint_source(src, path)})
+
+
+@pytest.fixture(autouse=True)
+def _leasedep_off():
+    """Every test starts and ends with the tracker disarmed."""
+    leasedep.disable()
+    yield
+    leasedep.disable()
+
+
+# -- DAS401: raw allocation on a per-batch hot path ---------------------------
+
+_DAS401_HOT_NAME = """
+import numpy as np
+
+def assemble(parts, out):
+    return np.stack(parts)              # a fresh [B, ...] every batch
+"""
+
+_DAS401_LOOP = """
+import numpy as np
+
+def gather(rows):
+    out = []
+    for row in rows:
+        out.append(np.zeros((8, 4), np.float32))
+    return out
+"""
+
+_DAS401_COLD = """
+import numpy as np
+
+class Pool:
+    def warmup(self, buckets):
+        for b in buckets:               # one-time preallocation: fine
+            self._free[b] = np.zeros((b, 4), np.float32)
+"""
+
+_DAS401_POOLED = """
+import numpy as np
+
+from dasmtl.data.staging import aligned_zeros, stack_leaf
+
+def assemble(parts, out):
+    stack_leaf(parts, out=out)          # pooled: no raw allocator
+    return aligned_zeros((4,), np.float32, zero=False)
+"""
+
+
+def test_das401_flags_raw_alloc_in_hot_function():
+    assert "DAS401" in ids(_DAS401_HOT_NAME)
+
+
+def test_das401_flags_raw_alloc_inside_loop():
+    assert "DAS401" in ids(_DAS401_LOOP)
+
+
+def test_das401_ignores_cold_warmup_loops():
+    assert "DAS401" not in ids(_DAS401_COLD)
+
+
+def test_das401_ignores_pooled_and_aligned_allocation():
+    assert "DAS401" not in ids(_DAS401_POOLED)
+
+
+def test_das401_scoped_to_data_plane_packages():
+    assert "DAS401" not in ids(_DAS401_HOT_NAME,
+                               "dasmtl/models/snippet.py")
+
+
+# -- DAS402: lease released on some paths but not exception-safe --------------
+
+_DAS402_POS = """
+def launch(staging, plan):
+    buf = staging.acquire(plan.bucket)
+    assemble(plan, buf)                 # an exception leaks the lease
+    staging.release(buf)
+"""
+
+_DAS402_NEG = """
+def launch(staging, plan):
+    buf = staging.acquire(plan.bucket)
+    try:
+        assemble(plan, buf)
+    finally:
+        staging.release(buf)
+"""
+
+_DAS402_HANDOFF = """
+def launch(staging, plan, completion):
+    buf = staging.acquire(plan.bucket)
+    completion.put(buf)                 # released later, at collect
+"""
+
+
+def test_das402_flags_release_outside_finally():
+    assert "DAS402" in ids(_DAS402_POS)
+
+
+def test_das402_ignores_try_finally():
+    assert "DAS402" not in ids(_DAS402_NEG)
+
+
+def test_das402_ignores_pure_handoff():
+    assert "DAS402" not in ids(_DAS402_HANDOFF)
+
+
+# -- DAS403: use of a buffer after release/donation retired it ---------------
+
+_DAS403_POS = """
+def collect(staging, buf):
+    staging.release(buf)
+    return buf.sum()                    # the pool canary owns buf now
+"""
+
+_DAS403_NEG = """
+def collect(staging, buf, placed):
+    staging.release(buf)
+    return placed.sum()                 # the placed value is the survivor
+"""
+
+_DAS403_INLINE_DONATE = """
+import jax
+
+def step(params, grads):
+    new = jax.jit(apply, donate_argnums=0)(params, grads)
+    return params["w"]                  # donated: buffer belongs to XLA
+"""
+
+
+def test_das403_flags_read_after_pool_release():
+    assert "DAS403" in ids(_DAS403_POS)
+
+
+def test_das403_ignores_reads_of_the_placed_value():
+    assert "DAS403" not in ids(_DAS403_NEG)
+
+
+def test_das403_flags_read_after_inline_donation():
+    assert "DAS403" in ids(_DAS403_INLINE_DONATE)
+
+
+# -- DAS404: device_put of a known-unaligned host array -----------------------
+
+_DAS404_POS = """
+import jax
+import numpy as np
+
+def push(host):
+    return jax.device_put(np.asarray(host, np.float32))
+"""
+
+_DAS404_PROVENANCE = """
+import jax
+import numpy as np
+
+def push(parts):
+    flat = np.concatenate(parts)
+    return jax.device_put(flat)
+"""
+
+_DAS404_NEG = """
+import jax
+import numpy as np
+
+from dasmtl.data.staging import aligned_zeros
+
+def push(host):
+    buf = aligned_zeros(host.shape, np.float32)
+    np.copyto(buf, host)
+    return jax.device_put(buf)
+"""
+
+_DAS404_LAUNDERED = """
+import jax
+import numpy as np
+
+def push(host):
+    x = np.asarray(host)
+    x = normalize(x)                    # unknown provenance: clean
+    return jax.device_put(x)
+"""
+
+
+def test_das404_flags_device_put_of_raw_asarray():
+    assert "DAS404" in ids(_DAS404_POS)
+
+
+def test_das404_tracks_local_provenance():
+    assert "DAS404" in ids(_DAS404_PROVENANCE)
+
+
+def test_das404_ignores_aligned_staging():
+    assert "DAS404" not in ids(_DAS404_NEG)
+
+
+def test_das404_forgets_reassigned_names():
+    assert "DAS404" not in ids(_DAS404_LAUNDERED)
+
+
+def test_das404_scoped_to_data_plane_packages():
+    assert "DAS404" not in ids(_DAS404_POS, "dasmtl/models/snippet.py")
+
+
+# -- DAS405: declared donation, call site re-reads the operand ----------------
+
+_DAS405_POS = """
+import functools
+
+import jax
+
+@functools.partial(jax.jit, donate_argnums=0)
+def update(state, batch):
+    return state
+
+def step(state, batch):
+    new = update(state, batch)
+    return state.params                 # donated operand re-read
+"""
+
+_DAS405_NEG = """
+import functools
+
+import jax
+
+@functools.partial(jax.jit, donate_argnums=0)
+def update(state, batch):
+    return state
+
+def step(state, batch):
+    state = update(state, batch)        # rebound: the new value
+    return state.params
+"""
+
+_DAS405_DECORATOR_CALL = """
+import jax
+
+@jax.jit(donate_argnums=(0,))
+def update(state, batch):
+    return state
+
+def step(state, batch):
+    out = update(state, batch)
+    return state
+"""
+
+
+def test_das405_flags_reread_of_donated_operand():
+    assert "DAS405" in ids(_DAS405_POS)
+
+
+def test_das405_ignores_rebound_operand():
+    assert "DAS405" not in ids(_DAS405_NEG)
+
+
+def test_das405_handles_jit_call_decorator_form():
+    assert "DAS405" in ids(_DAS405_DECORATOR_CALL)
+
+
+# -- leasedep: leases, leaks, the canary, retirement verification -------------
+
+def test_leasedep_disabled_is_invisible():
+    assert not leasedep.enabled()
+    assert leasedep.tracker("t.pool") is None
+    assert leasedep.snapshot()["enabled"] is False
+    assert leasedep.drain_check("off") == []
+    msgs, summary = leasedep.clean_since(leasedep.snapshot())
+    assert msgs == [] and summary == {"enabled": False}
+
+
+def test_leasedep_accounts_acquire_release_cycle():
+    leasedep.enable(reset=True)
+    tr = leasedep.tracker("t.pool")
+    buf = np.ones((16,), np.float32)
+    tr.acquired(buf, slot="a")
+    snap = leasedep.snapshot()
+    assert snap["outstanding"] == 1
+    assert snap["resident_bytes"] == buf.nbytes
+    tr.released(buf, slot="a")
+    snap = leasedep.snapshot()
+    assert snap["outstanding"] == 0 and snap["resident_bytes"] == 0
+    assert snap["peak_outstanding"] == 1
+    assert snap["peak_resident_bytes"] == buf.nbytes
+    assert snap["pools"]["t.pool"]["acquires"] == 1
+    assert leasedep.drain_check("clean drain") == []
+
+
+def test_leasedep_drain_check_flags_leaked_lease():
+    leasedep.enable(reset=True)
+    tr = leasedep.tracker("t.pool")
+    tr.acquired(np.ones((8,), np.float32), slot=("b", 8))
+    found = leasedep.drain_check("test drain")
+    assert len(found) == 1
+    assert found[0]["kind"] == "leak" and found[0]["outstanding"] == 1
+    assert leasedep.snapshot()["leaks"] == found
+
+
+def test_leasedep_flags_double_release():
+    leasedep.enable(reset=True)
+    tr = leasedep.tracker("t.pool")
+    buf = np.ones((8,), np.float32)
+    tr.acquired(buf)
+    tr.released(buf)
+    tr.released(buf)                    # second return of the same lease
+    snap = leasedep.snapshot()
+    assert len(snap["double_releases"]) == 1
+    assert snap["double_releases"][0]["kind"] == "double_release"
+
+
+def test_leasedep_canary_poisons_and_catches_freelist_writes():
+    leasedep.enable(canary=True, reset=True)
+    tr = leasedep.tracker("t.pool")
+    buf = np.ones((64,), np.float32)
+    tr.acquired(buf)
+    tr.released(buf)
+    assert np.isnan(buf).all()          # poisoned on the freelist
+    tr.acquired(buf)                    # clean reuse: canary intact
+    assert leasedep.snapshot()["canary"] == []
+    tr.released(buf)
+    buf[0] = 123.0                      # use-after-release write
+    tr.acquired(buf)
+    snap = leasedep.snapshot()
+    assert len(snap["canary"]) == 1
+    assert snap["canary"][0]["kind"] == "canary"
+    assert snap["canary_poisons"] >= 2
+
+
+def test_leasedep_canary_skips_integer_buffers():
+    leasedep.enable(canary=True, reset=True)
+    tr = leasedep.tracker("t.pool")
+    buf = np.arange(8, dtype=np.int32)
+    tr.acquired(buf)
+    tr.released(buf)
+    assert buf.tolist() == list(range(8))   # no NaN fill possible
+    tr.acquired(buf)
+    assert leasedep.snapshot()["canary"] == []
+
+
+def test_leasedep_relink_transfers_the_lease():
+    leasedep.enable(reset=True)
+    tr = leasedep.tracker("t.pool")
+    old = np.ones((8,), np.float32)
+    new = np.ones((8,), np.float32)
+    tr.acquired(old)
+    tr.relink(old, new)                 # release_placed slot swap
+    tr.released(new)
+    snap = leasedep.snapshot()
+    assert snap["outstanding"] == 0 and snap["double_releases"] == []
+
+
+def test_leasedep_verify_retirement_catches_aliased_host_slot():
+    leasedep.enable(reset=True)
+    tr = leasedep.tracker("t.retire")
+    host = np.arange(64, dtype=np.float32)
+    placed = host                       # "device" still aliases the slot
+    sample = tr.device_sample(placed)
+    host.fill(np.nan)                   # retire/rewrite the host slot
+    tr.verify_retirement(sample, placed, "test retire")
+    snap = leasedep.snapshot()
+    assert len(snap["retirements"]) == 1
+    assert snap["retirements"][0]["context"] == "test retire"
+
+
+def test_leasedep_verify_retirement_silent_on_real_copy():
+    leasedep.enable(reset=True)
+    tr = leasedep.tracker("t.retire")
+    host = np.arange(64, dtype=np.float32)
+    placed = host.copy()                # a true H2D copy: independent
+    sample = tr.device_sample(placed)
+    host.fill(np.nan)
+    tr.verify_retirement(sample, placed, "test retire")
+    assert leasedep.snapshot()["retirements"] == []
+
+
+def test_leasedep_note_resident_tracks_self_managed_pools():
+    leasedep.enable(reset=True)
+    tr = leasedep.tracker("t.feed")
+    tr.note_resident(4096)
+    tr.note_resident(1024)
+    pool = leasedep.snapshot()["pools"]["t.feed"]
+    assert pool["resident_bytes"] == 1024
+    assert pool["peak_resident_bytes"] == 4096
+
+
+def test_leasedep_is_thread_safe_under_contention():
+    leasedep.enable(canary=False, reset=True)
+    tr = leasedep.tracker("t.pool")
+
+    def churn():
+        buf = np.ones((4,), np.float32)
+        for _ in range(200):
+            tr.acquired(buf)
+            tr.released(buf)
+
+    threads = [threading.Thread(target=churn) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = leasedep.snapshot()
+    assert snap["acquires"] == snap["releases"]
+    assert leasedep.drain_check("contention drain") == []
+
+
+def test_clean_since_reports_only_new_findings():
+    leasedep.enable(reset=True)
+    tr = leasedep.tracker("t.pool")
+    leased = np.ones((8,), np.float32)   # held: ids stay unambiguous
+    tr.acquired(leased)
+    leasedep.drain_check("early drain")     # pre-existing finding
+    before = leasedep.snapshot()
+    msgs, summary = leasedep.clean_since(before)
+    assert msgs == [] and summary["enabled"]
+    foreign = np.ones((8,), np.float32)
+    tr.released(foreign)                    # never leased
+    msgs, summary = leasedep.clean_since(before)
+    assert len(msgs) == 1 and "double release" in msgs[0]
+    assert summary["double_releases"] == 1 and summary["leaks"] == 0
+
+
+def test_runtime_findings_map_snapshot_to_mem_ids():
+    leasedep.enable(reset=True)
+    tr = leasedep.tracker("t.pool")
+    buf = np.ones((8,), np.float32)
+    tr.acquired(buf)
+    tr.released(buf)
+    tr.released(buf)
+    tr.acquired(np.ones((4,), np.float32))
+    leasedep.drain_check("test drain")
+    found = runtime_findings(leasedep.snapshot(), exercise="t")
+    by_id = {f["id"] for f in found}
+    assert {"MEM501", "MEM502"} <= by_id
+    assert all(f["severity"] == "error" for f in found)
+
+
+def test_publish_exports_mem_families():
+    from dasmtl.obs.registry import MetricsRegistry
+
+    leasedep.enable(reset=True)
+    tr = leasedep.tracker("t.pool")
+    buf = np.ones((8,), np.float32)
+    tr.acquired(buf)
+    tr.released(buf)
+    reg = MetricsRegistry()
+    leasedep.publish(reg)
+    text = reg.render()
+    assert "dasmtl_mem_acquires_total 1" in text
+    assert "dasmtl_mem_releases_total 1" in text
+    assert "dasmtl_mem_leaks_total 0" in text
+
+
+def test_enable_hooks_default_registry_scrape():
+    # Arming leasedep must surface dasmtl_mem_* on the DEFAULT registry's
+    # render (the live /metrics path) with no tier-specific wiring.
+    from dasmtl.obs.registry import default_registry
+
+    leasedep.enable(reset=True)
+    tr = leasedep.tracker("t.hook")
+    buf = np.ones((8,), np.float32)
+    tr.acquired(buf)
+    tr.released(buf)
+    assert "dasmtl_mem_acquires_total" in default_registry().render()
+
+
+def test_dump_jsonl_writes_pools_and_findings(tmp_path):
+    leasedep.enable(reset=True)
+    tr = leasedep.tracker("t.pool")
+    tr.acquired(np.ones((8,), np.float32))
+    leasedep.drain_check("dump drain")
+    path = tmp_path / "mem" / "dump.jsonl"
+    n = leasedep.dump_jsonl(str(path))
+    recs = [json.loads(line) for line in path.read_text().splitlines()]
+    assert len(recs) == n
+    kinds = {r["kind"] for r in recs}
+    assert {"pool", "leak"} <= kinds
+
+
+def test_staging_buffers_report_to_leasedep():
+    leasedep.enable(reset=True)
+    from dasmtl.data.staging import StagingBuffers
+
+    pool = StagingBuffers({1: ((1, 4), np.float32)}, depth=2,
+                          name="t.staging")
+    buf = pool.acquire(1)
+    snap = leasedep.snapshot()
+    assert snap["pools"]["t.staging"]["outstanding"] == 1
+    pool.release(buf)
+    assert leasedep.drain_check("staging drain") == []
+    assert leasedep.snapshot()["double_releases"] == []
+
+
+def test_staging_release_placed_verifies_retirement():
+    leasedep.enable(reset=True)
+    import jax
+
+    from dasmtl.data.staging import StagingBuffers
+
+    pool = StagingBuffers({1: ((1, 4), np.float32)}, depth=2,
+                          name="t.staging")
+    buf = pool.acquire(1)
+    buf[:] = 1.0
+    placed = jax.device_put(buf)
+    pool.release_placed(buf, placed)
+    snap = leasedep.snapshot()
+    assert snap["retirements"] == []    # retirement held: no aliasing
+    assert np.asarray(placed).tolist() == [[1.0] * 4]
+    assert leasedep.drain_check("placed drain") == []
+
+
+# -- membudget baseline round-trip --------------------------------------------
+
+def test_baseline_round_trip_and_growth_fails(tmp_path):
+    path = str(tmp_path / "membudget_baseline.json")
+    measured = {"train": {"peak_resident_bytes": 1 << 20,
+                          "peak_outstanding": 2}}
+    doc = mem_baseline.update_baseline(measured, path)
+    assert doc["version"] == 1
+    loaded = mem_baseline.load_baseline(path)
+    assert loaded["tiers"]["train"]["peak_outstanding"] == 2
+    # In budget (shrinking is headroom, not an error): clean.
+    ok = {"train": {"peak_resident_bytes": 1 << 19,
+                    "peak_outstanding": 1}}
+    assert mem_baseline.check_budgets(ok, loaded, path) == []
+    # Growth past tolerance + slack fails MEM505 naming tier and metric.
+    fat = {"train": {"peak_resident_bytes": 1 << 22,
+                     "peak_outstanding": 2}}
+    found = mem_baseline.check_budgets(fat, loaded, path)
+    assert [f["id"] for f in found] == ["MEM505"]
+    assert found[0]["tier"] == "train"
+    assert found[0]["metric"] == "peak_resident_bytes"
+
+
+def test_baseline_missing_file_is_mem505(tmp_path):
+    path = str(tmp_path / "nope.json")
+    found = mem_baseline.check_budgets(
+        {"train": {"peak_resident_bytes": 1, "peak_outstanding": 1}},
+        None, path)
+    assert [f["id"] for f in found] == ["MEM505"]
+    assert "update-baseline" in found[0]["message"]
+
+
+def test_baseline_missing_tier_is_mem505(tmp_path):
+    path = str(tmp_path / "membudget_baseline.json")
+    mem_baseline.update_baseline(
+        {"train": {"peak_resident_bytes": 1, "peak_outstanding": 1}},
+        path)
+    loaded = mem_baseline.load_baseline(path)
+    found = mem_baseline.check_budgets(
+        {"serve": {"peak_resident_bytes": 1, "peak_outstanding": 1}},
+        loaded, path)
+    assert [f["id"] for f in found] == ["MEM505"]
+    assert "'serve'" in found[0]["message"]
+
+
+def test_baseline_update_merges_tiers_and_keeps_comment(tmp_path):
+    path = str(tmp_path / "membudget_baseline.json")
+    mem_baseline.update_baseline(
+        {"train": {"peak_resident_bytes": 10, "peak_outstanding": 1}},
+        path)
+    doc = json.loads(open(path).read())
+    doc["comment"] = "hand-edited review note"
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    merged = mem_baseline.update_baseline(
+        {"serve": {"peak_resident_bytes": 20, "peak_outstanding": 2}},
+        path)
+    assert sorted(merged["tiers"]) == ["serve", "train"]
+    assert merged["tiers"]["train"]["peak_resident_bytes"] == 10
+    assert merged["comment"] == "hand-edited review note"
+
+
+def test_committed_baseline_exists_and_parses():
+    data = mem_baseline.load_baseline()
+    assert data is not None, (
+        "artifacts/membudget_baseline.json must be committed — "
+        "regenerate with dasmtl-mem --update-baseline --preset full")
+    assert data["version"] == 1
+    assert {"train", "serve", "stream"} <= set(data["tiers"])
+    for tier, stats in data["tiers"].items():
+        assert stats["peak_resident_bytes"] > 0, tier
+        assert stats["peak_outstanding"] > 0, tier
+
+
+# -- fault injection + self-test ---------------------------------------------
+
+def test_fault_registry_rejects_unknown_names():
+    with pytest.raises(ValueError):
+        with faults.inject("nonsense"):
+            pass
+    assert not faults.active("leaked_lease")
+    with faults.inject("leaked_lease"):
+        assert faults.active("leaked_lease")
+    assert not faults.active("leaked_lease")
+
+
+def test_allocation_snippet_toggles_with_fault():
+    clean = faults.allocation_snippet()
+    assert "DAS401" not in ids(clean, "dasmtl/serve/snippet.py")
+    with faults.inject("raw_hot_alloc"):
+        dirty = faults.allocation_snippet()
+    assert "DAS401" in ids(dirty, "dasmtl/serve/snippet.py")
+
+
+def test_self_test_catches_all_injected_faults():
+    assert self_test(verbose=False) == []
+
+
+def test_resolve_exercises():
+    assert resolve_exercises("ci", None) == ["train", "serve"]
+    assert resolve_exercises("full", None) == ["train", "serve",
+                                               "stream"]
+    assert resolve_exercises("quick", "stream") == ["stream"]
+    with pytest.raises(ValueError):
+        resolve_exercises("ci", "bogus")
+
+
+# -- regressions for the DAS401-405 sweep fixes ------------------------------
+
+#: Files touched by the sweep: the linter must stay clean on them (their
+#: noqa suppressions are pinned separately below).
+_SWEPT = ("dasmtl/serve/batcher.py", "dasmtl/serve/server.py",
+          "dasmtl/data/pipeline.py", "dasmtl/data/windowing.py",
+          "dasmtl/stream/resident.py", "dasmtl/stream/offline.py",
+          "dasmtl/train/loop.py")
+
+
+@pytest.mark.parametrize("rel", _SWEPT)
+def test_swept_sources_lint_clean(rel):
+    import os
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    path = os.path.join(root, rel)
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    found = [f for f in lint_source(src, rel)
+             if f.rule.startswith("DAS4")]
+    assert found == [], [f"{f.rule}:{f.line}" for f in found]
+
+
+def test_exactly_three_das4xx_suppressions():
+    """The sweep left exactly three documented exceptions (the serve
+    hand-off lease + its completion-queue read, and the if/else release
+    arms of StagedBatch.release).  A new `# dasmtl: noqa[DAS4..]` must
+    be argued here, not slipped in."""
+    import os
+    import re
+
+    root = os.path.join(os.path.dirname(__file__), "..", "dasmtl")
+    hits = []
+    for dirpath, _dirs, files in os.walk(root):
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path, encoding="utf-8") as f:
+                for i, line in enumerate(f, 1):
+                    if re.search(r"dasmtl: noqa\[DAS4\d\d\]", line):
+                        hits.append(f"{name}:{i}")
+    assert len(hits) == 3, hits
+
+
+def test_window_batches_yield_aligned_full_batches():
+    """PR fix regression: a full batch out of window_batches passes
+    through pad_to_bucket untouched, so its arrays keep the 64-byte
+    alignment that downstream device_put needs for zero-copy."""
+    from dasmtl.data.windowing import plan_windows, window_batches
+
+    record = np.random.default_rng(0).standard_normal((8, 32)).astype(
+        np.float32)
+    plan = plan_windows(record.shape, window=(4, 8))
+    batches = list(window_batches(record, 4, plan))
+    assert batches, "expected at least one batch"
+    full = batches[0]
+    assert full["x"].shape[0] == 4
+    assert full["x"].ctypes.data % 64 == 0
+    assert full["weight"].ctypes.data % 64 == 0
+
+
+def test_batch_plan_assembles_without_raw_stack():
+    """PR fix regression: the serve hot path stacks request windows
+    through stack_leaf (single preallocatable output), not np.stack."""
+    import inspect
+
+    from dasmtl.serve.batcher import BatchPlan
+
+    src = inspect.getsource(BatchPlan.assemble)
+    assert "stack_leaf" in src and "np.stack(" not in src
