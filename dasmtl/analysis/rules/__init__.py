@@ -55,6 +55,7 @@ def all_rules() -> List[Rule]:
     # this one without a cycle.
     from dasmtl.analysis.rules import (concurrency, donation,  # noqa: F401
                                        dtype, host_sync, hygiene, loops,
-                                       memory, prng, serve_sync, tracing)
+                                       memory, prng, serve_sync, surface,
+                                       tracing)
 
     return [r for _, r in sorted(_REGISTRY.items())]

@@ -1,0 +1,321 @@
+"""Interface-contract rules — the static half of ``dasmtl-surface``.
+
+The fleet's processes speak informal HTTP contracts (serve replica,
+router tier, stream front end); these rules diff what the handlers
+provably do (:mod:`dasmtl.analysis.surface.extract`) against what the
+reviewed contract says they may do
+(:mod:`dasmtl.analysis.surface.model`), the OBSERVABILITY.md metric
+catalog, the Config/CLI parity invariant, and the refusal-shape
+protocol.  Contract drift regresses as a red lint line before it is a
+fleet incident (docs/STATIC_ANALYSIS.md "Interface contracts").
+
+DAS501 — a front-end handler replies outside its declared wire
+contract: an undeclared endpoint, a JSON key or status code absent
+from the contract entry, an undeclared raw body — or a contract
+endpoint no handler serves anymore (the break that strands every
+client).  Anchored to the three front-end modules.
+
+DAS502 — a ``dasmtl_*`` metric family registered in code but absent
+from the ``docs/OBSERVABILITY.md`` catalog (any module; ``noqa`` at
+the registration line marks an intentionally internal family, e.g. a
+selftest seed).  The reverse direction — documented but never
+registered (dead docs) — is a repo-global check anchored to
+``dasmtl/obs/registry.py``, the module every registration goes
+through.
+
+DAS503 — a ``Config`` dataclass field with no ``--<field>`` CLI flag.
+The parity invariant that used to live as N hand-written test blocks
+in ``tests/test_config.py`` is this rule; the tests now drive the same
+extractor.  Anchored to ``dasmtl/config.py``.
+
+DAS504 — a server-emitted refusal shape (``error="<shape>"``,
+``_refuse(req, "<shape>")``, outcome-map keys) that no client path
+(RouterCore normalization, stream tenant, selftests) dispatches on.
+An unhandled shape is a silent drop on the client side.  ``noqa`` at
+the emit site marks a terminal outcome clients handle by status code
+alone (``bad_request``, ``timeout``).  Anchored to the emitter
+modules.
+
+DAS505 — a ``METHOD /path`` endpoint cited in the operator docs
+(SERVING/STREAMING/OBSERVABILITY/OPERATIONS) that no front end serves
+(dead docs).  Repo-global, anchored to ``dasmtl/serve/server.py``.
+"""
+
+from __future__ import annotations
+
+import os
+from types import SimpleNamespace
+from typing import Dict, List, Optional, Set, Tuple
+
+from dasmtl.analysis.lint import ModuleContext
+from dasmtl.analysis.rules import make_finding, rule
+from dasmtl.analysis.surface import extract, model
+
+#: Test seams — ``dasmtl.analysis.surface.faults`` points these at
+#: doctored documents during ``--self-test`` so the repo-global
+#: directions (DAS502 reverse, DAS505) can be proven to fire without
+#: touching the real docs.  None = read the repo's files.
+_CATALOG_TEXT_OVERRIDE: Optional[str] = None
+_DOC_TEXTS_OVERRIDE: Optional[Dict[str, str]] = None
+
+_FRONTEND_RELS: Dict[str, str] = {
+    rel.replace(os.sep, "/"): tier
+    for tier, rel in extract.FRONTEND_FILES.items()
+}
+_EMITTER_RELS: Tuple[str, ...] = tuple(
+    rel.replace(os.sep, "/") for rel in extract.EMITTER_FILES)
+
+_REGISTRY_REL = "dasmtl/obs/registry.py"
+_CONFIG_REL = "dasmtl/config.py"
+_SERVER_REL = "dasmtl/serve/server.py"
+
+
+def _norm(path: str) -> str:
+    return path.replace("\\", "/")
+
+
+def _anchor(path: str, rel: str) -> bool:
+    p = _norm(path)
+    return p == rel or p.endswith("/" + rel)
+
+
+def _line(lineno: int) -> SimpleNamespace:
+    return SimpleNamespace(lineno=lineno, col_offset=0)
+
+
+# -- repo-root discovery + per-root caches ------------------------------------
+
+_ROOT_CACHE: Dict[str, Optional[str]] = {}
+
+
+def _repo_root(path: str) -> Optional[str]:
+    """Nearest ancestor of ``path`` holding both the package and the
+    docs tree; None for synthetic sources outside any checkout."""
+    d = os.path.dirname(os.path.abspath(path))
+    if d in _ROOT_CACHE:
+        return _ROOT_CACHE[d]
+    start = d
+    root: Optional[str] = None
+    while True:
+        if (os.path.isdir(os.path.join(d, "dasmtl"))
+                and os.path.exists(os.path.join(d, extract.CATALOG_PATH))):
+            root = d
+            break
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    _ROOT_CACHE[start] = root
+    return root
+
+
+_CACHE: Dict[Tuple[str, str], object] = {}
+
+
+def _cached(root: str, what: str, build):
+    key = (root, what)
+    if key not in _CACHE:
+        _CACHE[key] = build()
+    return _CACHE[key]
+
+
+def _catalog(root: str) -> Dict[str, int]:
+    if _CATALOG_TEXT_OVERRIDE is not None:
+        return extract.extract_catalog_from_text(_CATALOG_TEXT_OVERRIDE)
+    return _cached(root, "catalog", lambda: extract.extract_catalog(root))
+
+
+def _all_prefixes(root: str) -> Set[str]:
+    def build() -> Set[str]:
+        import ast as _ast
+        out: Set[str] = set()
+        for path in extract._iter_py_files(root):
+            with open(path, encoding="utf-8") as f:
+                try:
+                    out |= extract._prefix_values(_ast.parse(f.read()))
+                except SyntaxError:
+                    continue
+        return out
+    return _cached(root, "prefixes", build)
+
+
+def _registered_families(root: str) -> Set[str]:
+    return _cached(root, "registered", lambda: {
+        r.family for r in extract.extract_registrations(root)})
+
+
+def _dispatched(root: str) -> Set[str]:
+    return _cached(root, "dispatched",
+                   lambda: extract.extract_dispatched_refusals(root))
+
+
+def _served_endpoints(root: str) -> Set[str]:
+    def build() -> Set[str]:
+        out: Set[str] = set()
+        for eps in extract.extract_frontends(root).values():
+            out |= {ep.name for ep in eps}
+        return out
+    return _cached(root, "served", build)
+
+
+def _doc_endpoints(root: str) -> Dict[str, List[Tuple[str, str, int]]]:
+    if _DOC_TEXTS_OVERRIDE is not None:
+        return {rel: extract.extract_documented_endpoints_from_text(text)
+                for rel, text in _DOC_TEXTS_OVERRIDE.items()}
+    return _cached(root, "doc_endpoints",
+                   lambda: extract.extract_documented_endpoints(root))
+
+
+# -- DAS501 -------------------------------------------------------------------
+
+@rule("DAS501", "error",
+      "front-end handler reply outside the declared wire contract")
+def check_wire_contract(ctx: ModuleContext):
+    tier = next((t for rel, t in _FRONTEND_RELS.items()
+                 if _anchor(ctx.path, rel)), None)
+    if tier is None:
+        return
+    endpoints = extract.extract_endpoints_from_source(ctx.source, tier)
+    contract = model.WIRE_CONTRACT[tier]
+    served = {ep.name for ep in endpoints}
+    for ep in endpoints:
+        entry = contract.get(ep.name)
+        node = _line(ep.line)
+        if entry is None:
+            yield make_finding(
+                ctx, "DAS501", node,
+                f"{tier} serves undeclared endpoint {ep.name}: add it to "
+                f"the wire contract (dasmtl/analysis/surface/model.py) "
+                f"and re-run --update-baseline")
+            continue
+        bad_keys = sorted(ep.keys - entry["keys"])
+        if bad_keys:
+            yield make_finding(
+                ctx, "DAS501", node,
+                f"{tier} {ep.name} replies with JSON key(s) "
+                f"{bad_keys} absent from its contract entry — a client "
+                f"will silently drop them; declare them in "
+                f"model.WIRE_CONTRACT first")
+        bad_statuses = sorted(ep.statuses - entry["statuses"])
+        if bad_statuses:
+            yield make_finding(
+                ctx, "DAS501", node,
+                f"{tier} {ep.name} answers with undeclared status "
+                f"code(s) {bad_statuses}; declare them in "
+                f"model.WIRE_CONTRACT first")
+        if ep.raw_body and not entry["raw_body"]:
+            yield make_finding(
+                ctx, "DAS501", node,
+                f"{tier} {ep.name} sends a raw (non-JSON-object) body "
+                f"but its contract entry does not declare raw_body")
+    for name in sorted(set(contract) - served):
+        yield make_finding(
+            ctx, "DAS501", _line(1),
+            f"contract endpoint {tier} {name} is unreachable: no "
+            f"handler branch serves it anymore — every client of the "
+            f"declared surface breaks (remove it from "
+            f"model.WIRE_CONTRACT only with a reviewed "
+            f"--update-baseline)")
+
+
+# -- DAS502 -------------------------------------------------------------------
+
+@rule("DAS502", "error",
+      "metric family out of sync with the OBSERVABILITY.md catalog")
+def check_metric_catalog(ctx: ModuleContext):
+    root = _repo_root(ctx.path)
+    if root is None:
+        return
+    catalog = _catalog(root)
+    regs = extract.extract_registrations_from_source(
+        ctx.source, ctx.path, extra_prefixes=_all_prefixes(root))
+    seen: Set[Tuple[str, int]] = set()
+    for r in regs:
+        if r.family in catalog or (r.family, r.line) in seen:
+            continue
+        seen.add((r.family, r.line))
+        yield make_finding(
+            ctx, "DAS502", _line(r.line),
+            f"metric family {r.family!r} is registered here but absent "
+            f"from the docs/OBSERVABILITY.md catalog — document it (or "
+            f"noqa this line if it is intentionally internal)")
+    if _anchor(ctx.path, _REGISTRY_REL):
+        registered = _registered_families(root)
+        for fam, doc_line in sorted(_catalog(root).items()):
+            if fam not in registered:
+                yield make_finding(
+                    ctx, "DAS502", _line(1),
+                    f"metric family {fam!r} is documented at "
+                    f"docs/OBSERVABILITY.md:{doc_line} but never "
+                    f"registered anywhere in the package (dead docs)")
+
+
+# -- DAS503 -------------------------------------------------------------------
+
+@rule("DAS503", "error", "Config field without a matching CLI flag")
+def check_config_parity(ctx: ModuleContext):
+    if not _anchor(ctx.path, _CONFIG_REL):
+        return
+    schema = extract.extract_config_schema_from_source(ctx.source)
+    flags = set(schema["flags"])
+    for field in schema["fields"]:
+        if field not in flags:
+            yield make_finding(
+                ctx, "DAS503", _line(schema["field_lines"][field]),
+                f"Config field {field!r} has no matching --{field} CLI "
+                f"flag — every field must be reachable from the command "
+                f"line (add the flag, aliasing any legacy spelling)")
+
+
+# -- DAS504 -------------------------------------------------------------------
+
+@rule("DAS504", "error",
+      "server-emitted refusal shape no client dispatches on")
+def check_refusal_dispatch(ctx: ModuleContext):
+    if not any(_anchor(ctx.path, rel) for rel in _EMITTER_RELS):
+        return
+    root = _repo_root(ctx.path)
+    if root is None:
+        return
+    dispatched = _dispatched(root)
+    seen: Set[Tuple[str, int]] = set()
+    for shape, line in extract.extract_emitted_refusals_from_source(
+            ctx.source, ctx.path):
+        if shape in dispatched or (shape, line) in seen:
+            continue
+        seen.add((shape, line))
+        if shape in model.REFUSAL_SHAPES:
+            yield make_finding(
+                ctx, "DAS504", _line(line),
+                f"refusal shape {shape!r} is emitted here but no client "
+                f"path (router normalization, stream tenant, selftests) "
+                f"dispatches on it — the refusal is silently dropped")
+        else:
+            yield make_finding(
+                ctx, "DAS504", _line(line),
+                f"emitted shape {shape!r} is outside the declared "
+                f"refusal vocabulary (model.REFUSAL_SHAPES) and no "
+                f"client dispatches on it — add it to the protocol and "
+                f"a client dispatch path, or noqa a terminal outcome "
+                f"clients handle by status code alone")
+
+
+# -- DAS505 -------------------------------------------------------------------
+
+@rule("DAS505", "error", "documented endpoint with no handler")
+def check_doc_endpoints(ctx: ModuleContext):
+    if not _anchor(ctx.path, _SERVER_REL):
+        return
+    root = _repo_root(ctx.path)
+    if root is None:
+        return
+    served = _served_endpoints(root)
+    for rel, entries in sorted(_doc_endpoints(root).items()):
+        for method, path, doc_line in entries:
+            name = f"{method} {path}"
+            if name not in served:
+                yield make_finding(
+                    ctx, "DAS505", _line(1),
+                    f"{rel}:{doc_line} documents {name} but no front "
+                    f"end serves it (dead docs — fix the doc or restore "
+                    f"the handler)")

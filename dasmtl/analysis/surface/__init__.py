@@ -1,0 +1,38 @@
+"""dasmtl-surface: interface-contract analysis for the process fleet.
+
+The sixth member of the analysis family (lint / audit / sanitize /
+conc / mem / surface).  The fleet is several processes speaking
+informal HTTP contracts — the serve replica, the router tier, and the
+live-stream front end each expose ``/infer`` ``/healthz`` ``/metrics``
+``/query`` surfaces, and the router drives replicas through the
+shed/``closed``/``/readyz`` refusal protocol.  This suite pins those
+contracts the way the audit pins FLOPs and the conc suite pins lock
+order:
+
+- **Static half** (:mod:`dasmtl.analysis.surface.extract`): an AST
+  extractor walks the three front ends' ``do_GET``/``do_POST``
+  handlers into a structured surface model (method, path, status
+  codes, JSON reply keys), harvests every metric-family registration
+  (``registry.counter/gauge/histogram`` call sites, prefix-
+  parameterized staging families included), and reads the ``Config``
+  dataclass + ``_add_shared_args`` flag set.  Rules DAS501-DAS505
+  (:mod:`dasmtl.analysis.rules.surface`, run by ``dasmtl-lint``)
+  diff all of it against the declared wire contract
+  (:mod:`dasmtl.analysis.surface.model`), the OBSERVABILITY.md metric
+  catalog, and the client dispatch sites.
+- **Runtime half** (:mod:`dasmtl.analysis.surface.probe`,
+  ``dasmtl-surface probe``): boots real front ends — a fresh-init
+  serve loop, a router over one replica, a synthetic-fiber stream —
+  and validates every live response (status, JSON keys, metric
+  exposition families) against the same contract (SRF604-SRF606).
+- **Baseline** (:mod:`dasmtl.analysis.surface.baseline`): the
+  committed ``artifacts/surface_baseline.json`` pins endpoints,
+  per-endpoint key/status sets, the metric-family catalog, and the
+  config schema; ``--check-baseline`` fails SRF601-SRF603 on a
+  missing file, a removal/shape change, or an addition that has not
+  been reviewed through ``--update-baseline``.
+
+CLI: ``dasmtl-surface`` / ``dasmtl surface`` /
+``python -m dasmtl.analysis.surface``
+(:mod:`dasmtl.analysis.surface.runner`).
+"""

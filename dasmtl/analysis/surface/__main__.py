@@ -1,0 +1,9 @@
+"""``python -m dasmtl.analysis.surface`` — the interface-contract
+suite CLI (same entry as ``dasmtl-surface`` / ``dasmtl surface``)."""
+
+import sys
+
+from dasmtl.analysis.surface.runner import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,149 @@
+"""The committed wire-surface baseline (``artifacts/surface_baseline.json``).
+
+Pins the complete extracted surface — per-frontend endpoints with
+their key/status sets, the metric-family catalog, and the config
+schema — so contract drift in ANY tier is a reviewable JSON diff
+before it is a fleet incident:
+
+- **SRF601** — no baseline file at all: run ``dasmtl-surface
+  --update-baseline`` and commit the reviewed surface.
+- **SRF602** — a removal or shape change: an endpoint, reply key,
+  status code, metric family, config field/flag that the baseline
+  pins has disappeared, or an endpoint's dynamic/raw flags flipped.
+  Removals break deployed clients; they never pass silently.
+- **SRF603** — an addition the baseline has not reviewed: new
+  endpoint, key, status, family, field, or flag.  Additions are
+  cheap to wave through and expensive to retract — they go through an
+  explicit ``--update-baseline`` diff, same as removals.
+
+A hand-edited ``comment`` survives ``--update-baseline`` (the
+established analysis-family convention; mem/conc/audit baselines
+behave identically).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+DEFAULT_BASELINE_PATH = os.path.join("artifacts", "surface_baseline.json")
+
+_COMMENT = ("The committed wire surface of the fleet: per-frontend "
+            "endpoints (statuses, JSON keys, dynamic/raw flags), the "
+            "dasmtl_* metric-family catalog, and the Config/CLI "
+            "schema, as extracted by dasmtl-surface.  Any removal or "
+            "shape change fails SRF602; additions need a reviewed "
+            "`dasmtl-surface --update-baseline` diff (docs/"
+            "STATIC_ANALYSIS.md 'Interface contracts').")
+
+
+def _generated_with() -> dict:
+    import platform
+
+    from dasmtl.analysis.audit.runner import (
+        _generated_with as _deps_versions)
+
+    out = _deps_versions()
+    out["python"] = platform.python_version()
+    return out
+
+
+def load_baseline(path: str = DEFAULT_BASELINE_PATH) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def update_baseline(surface: dict,
+                    path: str = DEFAULT_BASELINE_PATH) -> dict:
+    """Write/refresh the baseline from a full extracted surface.  The
+    extraction is always complete (static), so the surface replaces
+    wholesale; a hand-edited comment survives."""
+    prev = load_baseline(path)
+    comment = _COMMENT
+    if prev is not None:
+        comment = prev.get("comment", _COMMENT)
+    doc = {
+        "version": 1,
+        "comment": comment,
+        "generated_with": _generated_with(),
+        "surface": surface,
+    }
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def _finding(id_: str, message: str) -> dict:
+    return {"id": id_, "severity": "error", "message": message}
+
+
+_UPDATE_HINT = ("review the change, then `dasmtl-surface "
+                "--update-baseline` and commit the diff")
+
+
+def _diff_sets(findings: List[dict], what: str, pinned, current) -> None:
+    """SRF602 for pinned-but-gone entries, SRF603 for unreviewed new
+    ones."""
+    removed = sorted(set(pinned) - set(current))
+    added = sorted(set(current) - set(pinned))
+    if removed:
+        findings.append(_finding(
+            "SRF602",
+            f"{what}: {removed} pinned in the baseline but gone from "
+            f"the extracted surface — a removal breaks deployed "
+            f"clients; {_UPDATE_HINT}"))
+    if added:
+        findings.append(_finding(
+            "SRF603",
+            f"{what}: {added} extracted but not in the baseline — "
+            f"additions need an explicit review; {_UPDATE_HINT}"))
+
+
+def check_surface(surface: dict, baseline: Optional[dict],
+                  path: str = DEFAULT_BASELINE_PATH) -> List[dict]:
+    """Diff the extracted surface against the committed baseline."""
+    if baseline is None:
+        return [_finding(
+            "SRF601",
+            f"no surface baseline at {path} — run `dasmtl-surface "
+            f"--update-baseline` and commit the reviewed surface")]
+    pinned = baseline.get("surface", {})
+    findings: List[dict] = []
+
+    pinned_eps: Dict[str, dict] = pinned.get("endpoints", {})
+    current_eps: Dict[str, dict] = surface.get("endpoints", {})
+    for tier in sorted(set(pinned_eps) | set(current_eps)):
+        p_tier = pinned_eps.get(tier, {})
+        c_tier = current_eps.get(tier, {})
+        _diff_sets(findings, f"{tier} endpoints", p_tier, c_tier)
+        for name in sorted(set(p_tier) & set(c_tier)):
+            p, c = p_tier[name], c_tier[name]
+            _diff_sets(findings, f"{tier} {name} keys",
+                       p.get("keys", []), c.get("keys", []))
+            _diff_sets(findings, f"{tier} {name} statuses",
+                       p.get("statuses", []), c.get("statuses", []))
+            for flag in ("dynamic_keys", "dynamic_status", "raw_body"):
+                if bool(p.get(flag)) != bool(c.get(flag)):
+                    findings.append(_finding(
+                        "SRF602",
+                        f"{tier} {name}: {flag} flipped "
+                        f"{bool(p.get(flag))} -> {bool(c.get(flag))} — "
+                        f"a reply-shape change; {_UPDATE_HINT}"))
+
+    _diff_sets(findings, "metric families",
+               pinned.get("metric_families", []),
+               surface.get("metric_families", []))
+    p_cfg = pinned.get("config", {})
+    c_cfg = surface.get("config", {})
+    _diff_sets(findings, "config fields",
+               p_cfg.get("fields", []), c_cfg.get("fields", []))
+    _diff_sets(findings, "config flags",
+               p_cfg.get("flags", []), c_cfg.get("flags", []))
+    return findings

@@ -1,0 +1,821 @@
+"""AST surface extractor: the static half of ``dasmtl-surface``.
+
+Walks the three HTTP front ends' handler classes into a structured
+endpoint model, harvests every metric-family registration in the
+package, and reads the Config dataclass + ``_add_shared_args`` flag
+set.  Everything here is plain ``ast`` over source text — no imports
+of the analyzed modules, no jax, safe anywhere (the same contract as
+``dasmtl-lint``).
+
+The extraction is deliberately conservative: a reply whose payload or
+status cannot be resolved to literals is marked *dynamic* rather than
+guessed (false negatives over false positives — the linter's standing
+contract).  Dynamic keys are the runtime probe's beat
+(:mod:`dasmtl.analysis.surface.probe`); the static rules only judge
+what the AST proves.
+
+Handler idioms covered (dasmtl/serve/server.py, dasmtl/serve/
+router.py, dasmtl/stream/live.py):
+
+- ``if url.path == "/x": ...`` / ``elif`` chains (``urlsplit`` and
+  ``urlparse`` spellings both end in an ``.path`` attribute compare);
+- the guard form ``if self.path != "/infer": <404>; return`` — the
+  statements *after* the guard belong to ``/infer``;
+- replies through ``self._reply(code, payload)``,
+  ``self._reply_raw(code, body, ctype)`` and ``self._send(code,
+  body)`` — dict-literal payloads, ``json.dumps({...})`` bodies,
+  local names resolved through straight-line dataflow
+  (``payload = {...}``; ``payload["k"] = v``), and producer calls
+  (``loop.healthz()``) resolved to the dict-literal returns of
+  same-named methods in the producer modules;
+- status codes as int constants, ``A if cond else B`` conditionals,
+  and the ``{...}.get(key, default)`` outcome-map idiom.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: The three HTTP front ends, by tier name (repo-relative paths).
+FRONTEND_FILES: Dict[str, str] = {
+    "serve": os.path.join("dasmtl", "serve", "server.py"),
+    "router": os.path.join("dasmtl", "serve", "router.py"),
+    "stream": os.path.join("dasmtl", "stream", "live.py"),
+}
+
+#: Modules whose same-named methods/functions resolve producer calls
+#: (``loop.healthz()`` → the dict-literal return of ``healthz``).
+PRODUCER_FILES: Tuple[str, ...] = (
+    os.path.join("dasmtl", "serve", "server.py"),
+    os.path.join("dasmtl", "serve", "router.py"),
+    os.path.join("dasmtl", "stream", "live.py"),
+)
+
+#: Reply helper method names on the handler classes.
+_REPLY_JSON = ("_reply",)
+_REPLY_RAW = ("_reply_raw", "_send")
+
+
+@dataclasses.dataclass
+class Endpoint:
+    """One (method, path) surface on one front end."""
+
+    frontend: str
+    method: str  # "GET" | "POST"
+    path: str
+    statuses: Set[int] = dataclasses.field(default_factory=set)
+    keys: Set[str] = dataclasses.field(default_factory=set)
+    #: at least one reply site whose payload keys the AST cannot prove
+    dynamic_keys: bool = False
+    #: at least one reply site whose status code is not a literal
+    dynamic_status: bool = False
+    #: a raw (non-JSON-object) body reply exists (text exposition, ndjson,
+    #: JSON arrays)
+    raw_body: bool = False
+    line: int = 0
+
+    @property
+    def name(self) -> str:
+        return f"{self.method} {self.path}"
+
+    def to_doc(self) -> dict:
+        return {
+            "statuses": sorted(self.statuses),
+            "keys": sorted(self.keys),
+            "dynamic_keys": self.dynamic_keys,
+            "dynamic_status": self.dynamic_status,
+            "raw_body": self.raw_body,
+        }
+
+
+def _read(root: str, rel: str) -> Tuple[str, str]:
+    path = os.path.join(root, rel)
+    with open(path, encoding="utf-8") as f:
+        return path, f.read()
+
+
+def _path_compare(test: ast.AST) -> Optional[Tuple[str, str]]:
+    """``("==", "/x")`` / ``("!=", "/x")`` for a ``<chain>.path ==
+    "/x"`` compare; None otherwise."""
+    if not (isinstance(test, ast.Compare) and len(test.ops) == 1
+            and len(test.comparators) == 1):
+        return None
+    left, comp = test.left, test.comparators[0]
+    if not (isinstance(left, ast.Attribute) and left.attr == "path"):
+        return None
+    if not (isinstance(comp, ast.Constant)
+            and isinstance(comp.value, str) and comp.value.startswith("/")):
+        return None
+    if isinstance(test.ops[0], ast.Eq):
+        return "==", comp.value
+    if isinstance(test.ops[0], ast.NotEq):
+        return "!=", comp.value
+    return None
+
+
+def _int_constants(node: ast.AST) -> Tuple[Set[int], bool]:
+    """Status codes provable from a status expression: ``(codes,
+    dynamic)`` — ``dynamic`` when part of the expression is opaque."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}, False
+    if isinstance(node, ast.IfExp):
+        a, da = _int_constants(node.body)
+        b, db = _int_constants(node.orelse)
+        return a | b, da or db
+    # The outcome-map idiom: {None: 200, "shed": 503, ...}.get(x, 500)
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"
+            and isinstance(node.func.value, ast.Dict)):
+        out: Set[int] = set()
+        dyn = False
+        for v in node.func.value.values:
+            got, d = _int_constants(v)
+            out |= got
+            dyn = dyn or d
+        if len(node.args) > 1:
+            got, d = _int_constants(node.args[1])
+            out |= got
+            dyn = dyn or d
+        return out, dyn
+    return set(), True
+
+
+def _dict_literal_keys(node: ast.AST) -> Optional[Set[str]]:
+    """String keys of a dict literal; None when the node is not one or
+    carries non-constant keys / ``**`` splats."""
+    if not isinstance(node, ast.Dict):
+        return None
+    keys: Set[str] = set()
+    for k in node.keys:
+        if k is None:  # ** splat
+            return None
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return None
+        keys.add(k.value)
+    return keys
+
+
+def _producer_key_table(sources: Iterable[str]) -> Dict[str, Optional[Set[str]]]:
+    """``method/function name -> provable return-dict keys`` across the
+    producer modules.  A function whose returns are all dict literals
+    (or dict literals plus plain ``return``) proves its keys; anything
+    else maps to None (dynamic).  Later modules never overwrite an
+    earlier resolution with a weaker one."""
+    table: Dict[str, Optional[Set[str]]] = {}
+    for source in sources:
+        tree = ast.parse(source)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            keys: Optional[Set[str]] = set()
+            saw_return = False
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and sub is not node:
+                    continue
+                if not isinstance(sub, ast.Return) or sub.value is None:
+                    continue
+                saw_return = True
+                got = _dict_literal_keys(sub.value)
+                if got is None:
+                    keys = None
+                    break
+                keys |= got
+            if not saw_return:
+                keys = None
+            prev = table.get(node.name, "absent")
+            if prev == "absent" or (prev is None and keys is not None):
+                table[node.name] = keys
+    return table
+
+
+class _HandlerWalk:
+    """One ``do_GET``/``do_POST`` body → reply sites grouped by path."""
+
+    def __init__(self, fn: ast.AST, method: str, frontend: str,
+                 producers: Dict[str, Optional[Set[str]]]):
+        self.fn = fn
+        self.method = method
+        self.frontend = frontend
+        self.producers = producers
+        # Straight-line local dataflow: name -> (keys | None) for dict
+        # payloads, name -> (codes, dynamic) for status ints.
+        self.locals: Dict[str, Optional[Set[str]]] = {}
+        self.int_locals: Dict[str, Tuple[Set[int], bool]] = {}
+        self.endpoints: Dict[str, Endpoint] = {}
+
+    def run(self) -> List[Endpoint]:
+        self._walk_block(self.fn.body, path=None)
+        return list(self.endpoints.values())
+
+    # -- payload resolution --------------------------------------------------
+
+    def _note_assignment(self, stmt: ast.AST) -> None:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            tgt = stmt.targets[0]
+            if isinstance(tgt, ast.Name):
+                self.locals[tgt.id] = self._payload_keys(stmt.value)
+                codes, dyn = _int_constants(stmt.value)
+                if codes:
+                    self.int_locals[tgt.id] = (codes, dyn)
+                else:
+                    self.int_locals.pop(tgt.id, None)
+            elif (isinstance(tgt, ast.Subscript)
+                  and isinstance(tgt.value, ast.Name)
+                  and isinstance(tgt.slice, ast.Constant)
+                  and isinstance(tgt.slice.value, str)):
+                known = self.locals.get(tgt.value.id)
+                if known is not None:
+                    known.add(tgt.slice.value)
+
+    def _payload_keys(self, node: ast.AST) -> Optional[Set[str]]:
+        """Provable JSON-object keys of a payload expression."""
+        keys = _dict_literal_keys(node)
+        if keys is not None:
+            return set(keys)
+        if isinstance(node, ast.Name):
+            got = self.locals.get(node.id)
+            return set(got) if got is not None else None
+        if isinstance(node, ast.Call):
+            name = None
+            if isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                name = node.func.id
+            if name is not None:
+                got = self.producers.get(name)
+                if got is not None:
+                    return set(got)
+        return None
+
+    def _status_codes(self, node: ast.AST) -> Tuple[Set[int], bool]:
+        """Status codes for a reply's first argument, resolving a
+        local assigned from a provable int expression
+        (``code = 409 if pending else 202``)."""
+        if isinstance(node, ast.Name) and node.id in self.int_locals:
+            codes, dyn = self.int_locals[node.id]
+            return set(codes), dyn
+        return _int_constants(node)
+
+    def _body_keys(self, node: ast.AST) -> Tuple[Optional[Set[str]], bool]:
+        """Keys provable from a raw-body expression (``json.dumps({...}
+        ).encode()``); ``(keys | None, is_json_object)``."""
+        # Unwrap .encode()
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "encode"):
+            node = node.func.value
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "dumps"):
+            inner = node.args[0] if node.args else None
+            if isinstance(inner, (ast.List, ast.ListComp)):
+                return None, False  # JSON array body — raw, not an object
+            keys = self._payload_keys(inner) if inner is not None else None
+            return keys, True
+        return None, False
+
+    # -- structure walk ------------------------------------------------------
+
+    def _endpoint(self, path: str, line: int) -> Endpoint:
+        ep = self.endpoints.get(path)
+        if ep is None:
+            ep = Endpoint(frontend=self.frontend, method=self.method,
+                          path=path, line=line)
+            self.endpoints[path] = ep
+        return ep
+
+    def _walk_block(self, stmts: Sequence[ast.AST],
+                    path: Optional[str]) -> None:
+        i = 0
+        while i < len(stmts):
+            stmt = stmts[i]
+            self._note_assignment(stmt)
+            cmp = _path_compare(stmt.test) if isinstance(stmt, ast.If) \
+                else None
+            if cmp is not None:
+                op, cmp_path = cmp
+                if op == "==":
+                    self._walk_block(stmt.body, cmp_path)
+                    self._walk_block(stmt.orelse, path)
+                else:
+                    # Guard form: the if-body is the 404 fallback; the
+                    # rest of THIS block is the guarded endpoint.
+                    self._walk_block(stmt.body, None)
+                    self._walk_block(stmts[i + 1:], cmp_path)
+                    return
+                i += 1
+                continue
+            # Structural recursion: the stream handler wraps its whole
+            # if-chain in try/except, so compound statements must be
+            # descended with the current path intact.
+            if isinstance(stmt, ast.Try):
+                self._walk_block(stmt.body, path)
+                for handler in stmt.handlers:
+                    self._walk_block(handler.body, path)
+                self._walk_block(stmt.orelse, path)
+                self._walk_block(stmt.finalbody, path)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._walk_block(stmt.body, path)
+            elif isinstance(stmt, (ast.If, ast.For, ast.While)):
+                self._walk_block(stmt.body, path)
+                self._walk_block(stmt.orelse, path)
+            else:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call):
+                        self._visit_call(sub, path)
+            i += 1
+
+    def _visit_call(self, call: ast.Call, path: Optional[str]) -> None:
+        if not isinstance(call.func, ast.Attribute):
+            return
+        attr = call.func.attr
+        if attr not in _REPLY_JSON + _REPLY_RAW or len(call.args) < 1:
+            return
+        if path is None:
+            return  # fallback 404 / error replies are not endpoints
+        ep = self._endpoint(path, call.lineno)
+        codes, dyn = self._status_codes(call.args[0])
+        ep.statuses |= codes
+        ep.dynamic_status = ep.dynamic_status or dyn
+        if attr in _REPLY_JSON:
+            keys = (self._payload_keys(call.args[1])
+                    if len(call.args) > 1 else None)
+            if keys is None:
+                ep.dynamic_keys = True
+            else:
+                ep.keys |= keys
+        else:
+            keys, is_json = ((None, False) if len(call.args) < 2
+                             else self._body_keys(call.args[1]))
+            if keys is not None:
+                ep.keys |= keys
+            elif is_json:
+                ep.dynamic_keys = True
+            else:
+                ep.raw_body = True
+
+
+def extract_endpoints_from_source(
+        source: str, frontend: str,
+        producers: Optional[Dict[str, Optional[Set[str]]]] = None,
+) -> List[Endpoint]:
+    """All endpoints served by the handler classes in ``source`` — any
+    class defining ``do_GET``/``do_POST`` counts as a handler."""
+    if producers is None:
+        producers = _producer_key_table([source])
+    tree = ast.parse(source)
+    out: List[Endpoint] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if node.name not in ("do_GET", "do_POST"):
+            continue
+        method = node.name.split("_")[1]
+        out.extend(_HandlerWalk(node, method, frontend, producers).run())
+    return out
+
+
+def _merge_producers(
+        own: Dict[str, Optional[Set[str]]],
+        others: Sequence[Dict[str, Optional[Set[str]]]],
+) -> Dict[str, Optional[Set[str]]]:
+    """Per-frontend producer view: the front end's own module always
+    wins; a name defined in several *other* modules with differing key
+    sets is ambiguous and resolves to dynamic (``healthz`` exists on
+    both the serve loop and the router core with different shapes)."""
+    merged: Dict[str, Optional[Set[str]]] = {}
+    for table in others:
+        for name, keys in table.items():
+            if name in merged and merged[name] != keys:
+                merged[name] = None
+            elif name not in merged:
+                merged[name] = keys
+    merged.update(own)
+    return merged
+
+
+def extract_frontends(root: str = ".") -> Dict[str, List[Endpoint]]:
+    """Endpoint model for the three real front ends.  Producer calls
+    (``loop.healthz()``) resolve against the front end's own module
+    first, then unambiguous cross-module names (the stream handler
+    replies with the serve loop's ``stats()``)."""
+    sources: Dict[str, str] = {}
+    for tier, rel in FRONTEND_FILES.items():
+        _, sources[tier] = _read(root, rel)
+    extra_sources: List[str] = []
+    for rel in PRODUCER_FILES:
+        if rel not in FRONTEND_FILES.values():
+            _, src = _read(root, rel)
+            extra_sources.append(src)
+    tables = {tier: _producer_key_table([src])
+              for tier, src in sources.items()}
+    extra_tables = [_producer_key_table([src]) for src in extra_sources]
+    out: Dict[str, List[Endpoint]] = {}
+    for tier, src in sources.items():
+        others = [t for name, t in tables.items() if name != tier]
+        producers = _merge_producers(tables[tier], others + extra_tables)
+        out[tier] = extract_endpoints_from_source(src, tier, producers)
+    return out
+
+
+# -- metric-family harvest ----------------------------------------------------
+
+_REGISTRAR_ATTRS = ("counter", "gauge", "histogram")
+
+
+def _iter_py_files(root: str, package: str = "dasmtl") -> Iterable[str]:
+    top = os.path.join(root, package)
+    for dirpath, dirnames, filenames in os.walk(top):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__", ".git"))
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def _fstring_family(node: ast.JoinedStr) -> Optional[str]:
+    """``f"{prefix}_suffix"`` → ``"{prefix}_suffix"`` template when the
+    f-string is exactly one formatted name + one literal tail."""
+    if len(node.values) != 2:
+        return None
+    head, tail = node.values
+    if not (isinstance(head, ast.FormattedValue)
+            and isinstance(head.value, ast.Name)
+            and isinstance(tail, ast.Constant)
+            and isinstance(tail.value, str)):
+        return None
+    return "{%s}%s" % (head.value.id, tail.value)
+
+
+#: The only function whose ``prefix`` parameter names metric families
+#: (``tempfile.mkdtemp(prefix=...)`` and friends must not leak in).
+_PREFIXED_PUBLISHER = "publish_metrics"
+
+
+def _prefix_values(tree: ast.Module) -> Set[str]:
+    """Literal values the metric publisher's ``prefix`` parameter takes
+    in this module: the ``publish_metrics`` declared default plus any
+    ``prefix="..."`` keyword on a ``publish_metrics`` call."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name != _PREFIXED_PUBLISHER:
+                continue
+            args = node.args
+            names = args.posonlyargs + args.args + args.kwonlyargs
+            defaults = ([None] * (len(args.posonlyargs + args.args)
+                                  - len(args.defaults)) + list(args.defaults)
+                        + list(args.kw_defaults))
+            for a, d in zip(names, defaults):
+                if (a.arg == "prefix" and isinstance(d, ast.Constant)
+                        and isinstance(d.value, str)):
+                    out.add(d.value)
+        elif isinstance(node, ast.Call):
+            fname = node.func.attr if isinstance(node.func, ast.Attribute) \
+                else (node.func.id if isinstance(node.func, ast.Name)
+                      else None)
+            if fname != _PREFIXED_PUBLISHER:
+                continue
+            for kw in node.keywords:
+                if (kw.arg == "prefix" and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)):
+                    out.add(kw.value.value)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Registration:
+    family: str
+    kind: str  # counter | gauge | histogram
+    path: str
+    line: int
+
+
+def extract_registrations_from_source(
+        source: str, path: str = "<string>",
+        extra_prefixes: Iterable[str] = ()) -> List[Registration]:
+    tree = ast.parse(source)
+    prefixes = _prefix_values(tree) | set(extra_prefixes)
+    out: List[Registration] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _REGISTRAR_ATTRS and node.args):
+            continue
+        arg0 = node.args[0]
+        fams: List[str] = []
+        if isinstance(arg0, ast.Constant) and isinstance(arg0.value, str):
+            if arg0.value.startswith("dasmtl_"):
+                fams = [arg0.value]
+        elif isinstance(arg0, ast.JoinedStr):
+            template = _fstring_family(arg0)
+            if template is not None:
+                fams = [template.format(prefix=p) for p in sorted(prefixes)
+                        if p.startswith("dasmtl_")]
+        for fam in fams:
+            out.append(Registration(family=fam, kind=node.func.attr,
+                                    path=path, line=node.lineno))
+    return out
+
+
+def extract_registrations(root: str = ".") -> List[Registration]:
+    """Every ``dasmtl_*`` metric-family registration in the package.
+    Prefix-parameterized families (``f"{prefix}_acquires_total"``) are
+    expanded with every literal prefix the package passes anywhere."""
+    # Collect cross-module prefixes first (server.py passes
+    # prefix="dasmtl_serve_staging" into staging.py's publish_metrics).
+    prefixes: Set[str] = set()
+    sources: List[Tuple[str, str]] = []
+    for path in _iter_py_files(root):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        sources.append((path, source))
+        try:
+            prefixes |= _prefix_values(ast.parse(source))
+        except SyntaxError:
+            continue
+    out: List[Registration] = []
+    for path, source in sources:
+        rel = os.path.relpath(path, root)
+        try:
+            out.extend(extract_registrations_from_source(
+                source, rel, extra_prefixes=prefixes))
+        except SyntaxError:
+            continue
+    return out
+
+
+# -- OBSERVABILITY.md metric catalog ------------------------------------------
+
+_FAMILY_RE = re.compile(r"\bdasmtl_[a-z0-9_]+\b")
+
+CATALOG_PATH = os.path.join("docs", "OBSERVABILITY.md")
+
+
+def extract_catalog_from_text(text: str) -> Dict[str, int]:
+    """``family -> first line`` for every ``dasmtl_*`` token in the
+    catalog document.  A family name anywhere in OBSERVABILITY.md
+    counts as documented — the catalog tables list full names (the
+    DAS502 reconciliation normalized the merged rows).  Prefix-glob
+    prose like ``dasmtl_stream_resident_*`` is not a family."""
+    out: Dict[str, int] = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        for m in _FAMILY_RE.finditer(line):
+            if m.group(0).endswith("_"):
+                continue
+            out.setdefault(m.group(0), i)
+    return out
+
+
+def extract_catalog(root: str = ".") -> Dict[str, int]:
+    _, text = _read(root, CATALOG_PATH)
+    return extract_catalog_from_text(text)
+
+
+# -- documented endpoints (DAS505) --------------------------------------------
+
+_DOC_ENDPOINT_RE = re.compile(r"\b(GET|POST)\s+(/[a-z_]+)\b")
+
+#: Docs whose ``METHOD /path`` mentions must name a live handler.
+DOC_FILES: Tuple[str, ...] = (
+    os.path.join("docs", "SERVING.md"),
+    os.path.join("docs", "STREAMING.md"),
+    os.path.join("docs", "OBSERVABILITY.md"),
+    os.path.join("docs", "OPERATIONS.md"),
+)
+
+
+def extract_documented_endpoints_from_text(
+        text: str) -> List[Tuple[str, str, int]]:
+    """``(method, path, line)`` for every explicit ``GET /x`` /
+    ``POST /x`` mention."""
+    out: List[Tuple[str, str, int]] = []
+    for i, line in enumerate(text.splitlines(), start=1):
+        for m in _DOC_ENDPOINT_RE.finditer(line):
+            out.append((m.group(1), m.group(2), i))
+    return out
+
+
+def extract_documented_endpoints(
+        root: str = ".") -> Dict[str, List[Tuple[str, str, int]]]:
+    out: Dict[str, List[Tuple[str, str, int]]] = {}
+    for rel in DOC_FILES:
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            continue
+        with open(path, encoding="utf-8") as f:
+            out[rel] = extract_documented_endpoints_from_text(f.read())
+    return out
+
+
+# -- config schema (DAS503) ---------------------------------------------------
+
+CONFIG_PATH = os.path.join("dasmtl", "config.py")
+
+
+def extract_config_schema_from_source(source: str) -> Dict[str, object]:
+    """``{"fields": [...], "flags": [...]}`` from a config module:
+    annotated fields of the ``Config`` dataclass (underscore-private
+    and ClassVar/constant names excluded) and every ``--flag`` that
+    ``_add_shared_args`` (plus the per-CLI ``parse_*_args`` bodies)
+    registers."""
+    tree = ast.parse(source)
+    fields: List[str] = []
+    field_lines: Dict[str, int] = {}
+    flags: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            for stmt in node.body:
+                if (isinstance(stmt, ast.AnnAssign)
+                        and isinstance(stmt.target, ast.Name)
+                        and not stmt.target.id.startswith("_")):
+                    ann = ast.unparse(stmt.annotation)
+                    if "ClassVar" in ann:
+                        continue
+                    fields.append(stmt.target.id)
+                    field_lines[stmt.target.id] = stmt.lineno
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "add_argument"):
+            for arg in node.args:
+                if (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)
+                        and arg.value.startswith("--")):
+                    flags.add(arg.value[2:])
+    return {"fields": fields, "flags": sorted(flags),
+            "field_lines": field_lines}
+
+
+def extract_config_schema(root: str = ".") -> Dict[str, object]:
+    _, source = _read(root, CONFIG_PATH)
+    return extract_config_schema_from_source(source)
+
+
+# -- refusal shapes (DAS504) --------------------------------------------------
+
+#: Server-side modules that EMIT refusal shapes.
+EMITTER_FILES: Tuple[str, ...] = (
+    os.path.join("dasmtl", "serve", "batcher.py"),
+    os.path.join("dasmtl", "serve", "server.py"),
+    os.path.join("dasmtl", "serve", "router.py"),
+)
+
+#: Client-side modules whose dispatch sites must understand every
+#: emitted shape (the router is both a server and the replicas'
+#: client; the selftests are the contract's reference consumers).
+CLIENT_FILES: Tuple[str, ...] = (
+    os.path.join("dasmtl", "serve", "router.py"),
+    os.path.join("dasmtl", "serve", "replica.py"),
+    os.path.join("dasmtl", "serve", "selftest.py"),
+    os.path.join("dasmtl", "serve", "selftest_router.py"),
+    os.path.join("dasmtl", "stream", "live.py"),
+)
+
+#: Success/err outcomes that are not refusal *shapes* (``ok`` is the
+#: happy path; ``error`` is the catch-all 500, not a protocol word).
+_NON_REFUSALS = frozenset({"ok", "error"})
+
+
+def extract_emitted_refusals_from_source(
+        source: str, path: str = "<string>") -> List[Tuple[str, int]]:
+    """Refusal shapes this module emits: ``_refuse(req, "<shape>")``
+    second arguments, ``error="<shape>"`` keywords, ``"error":
+    "<shape>"`` dict entries, and string keys of a status outcome-map
+    (``{"shed": 503, ...}``)."""
+    tree = ast.parse(source)
+    out: List[Tuple[str, int]] = []
+
+    def emit(value: object, line: int) -> None:
+        if (isinstance(value, str) and value
+                and value not in _NON_REFUSALS
+                and re.fullmatch(r"[a-z_]+", value)):
+            out.append((value, line))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fname = node.func.attr if isinstance(node.func, ast.Attribute) \
+                else (node.func.id if isinstance(node.func, ast.Name)
+                      else None)
+            if fname == "_refuse" and len(node.args) >= 2 \
+                    and isinstance(node.args[1], ast.Constant):
+                emit(node.args[1].value, node.lineno)
+            for kw in node.keywords:
+                if kw.arg == "error" and isinstance(kw.value, ast.Constant):
+                    emit(kw.value.value, kw.value.lineno)
+        elif isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if (isinstance(k, ast.Constant) and k.value == "error"
+                        and isinstance(v, ast.Constant)):
+                    emit(v.value, v.lineno)
+                # Outcome-map: string key -> int status constant.
+                if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, int) and 100 <= v.value < 600):
+                    emit(k.value, k.lineno if hasattr(k, "lineno")
+                         else node.lineno)
+    return out
+
+
+def _string_elts(node: ast.AST) -> Optional[Set[str]]:
+    """All-string elements of a tuple/list/set literal; None when the
+    node is not one or carries a non-string element."""
+    if not isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return None
+    out: Set[str] = set()
+    for e in node.elts:
+        if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+            return None
+        out.add(e.value)
+    return out
+
+
+def extract_dispatched_refusals_from_source(source: str) -> Set[str]:
+    """Shapes a client module dispatches on:
+
+    - string constants compared (``==`` / ``in``-tuple) against an
+      expression involving ``error`` (``res.error``,
+      ``payload.get("error")``, a bare ``error`` local) — including a
+      comparator Name resolved to a module-level all-string tuple
+      (``error in ROUTER_OUTCOMES``);
+    - string elements of a literal tuple a ``for`` loop enumerates
+      (the selftests' ``for bad in ("no_replica", "unreachable", ...)``
+      outcome sweeps).
+    """
+    tree = ast.parse(source)
+    out: Set[str] = set()
+
+    # Module-level all-string tuple constants (ROUTER_OUTCOMES).
+    consts: Dict[str, Set[str]] = {}
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            elts = _string_elts(stmt.value)
+            if elts is not None:
+                consts[stmt.targets[0].id] = elts
+
+    def mentions_error(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and sub.attr == "error":
+                return True
+            if isinstance(sub, ast.Name) and sub.id == "error":
+                return True
+            if (isinstance(sub, ast.Constant) and sub.value == "error"):
+                return True
+        return False
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.For):
+            elts = _string_elts(node.iter)
+            if elts is not None:
+                out |= elts
+            continue
+        if not isinstance(node, ast.Compare):
+            continue
+        if not mentions_error(node.left):
+            continue
+        for comp in node.comparators:
+            if isinstance(comp, ast.Constant) and isinstance(comp.value, str):
+                out.add(comp.value)
+            elif isinstance(comp, ast.Name) and comp.id in consts:
+                out |= consts[comp.id]
+            else:
+                elts = _string_elts(comp)
+                if elts is not None:
+                    out |= elts
+    return out
+
+
+def extract_dispatched_refusals(root: str = ".") -> Set[str]:
+    out: Set[str] = set()
+    for rel in CLIENT_FILES:
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            continue
+        with open(path, encoding="utf-8") as f:
+            out |= extract_dispatched_refusals_from_source(f.read())
+    return out - _NON_REFUSALS
+
+
+# -- the full surface ---------------------------------------------------------
+
+def extract_surface(root: str = ".") -> dict:
+    """The complete extracted surface model — what the baseline pins
+    and ``--dump`` prints."""
+    endpoints = extract_frontends(root)
+    regs = extract_registrations(root)
+    config = extract_config_schema(root)
+    return {
+        "endpoints": {
+            tier: {ep.name: ep.to_doc()
+                   for ep in sorted(eps, key=lambda e: e.name)}
+            for tier, eps in sorted(endpoints.items())
+        },
+        "metric_families": sorted({r.family for r in regs}),
+        "config": {
+            "fields": sorted(config["fields"]),
+            "flags": sorted(config["flags"]),
+        },
+    }

@@ -1,0 +1,258 @@
+"""Fault injection for the surface suite: deliberately plant each
+interface-contract defect and verify the checkers catch it
+(``dasmtl-surface --self-test``).  A contract checker that silently
+misses its drift class is worse than none — it licenses trust.
+
+Static-rule faults (linted snippets / doctored documents):
+``das501_extra_key`` (a handler replies an undeclared JSON key),
+``das501_unreachable`` (a contract endpoint loses its handler branch),
+``das502_unregistered`` (a metric family registered but undocumented),
+``das502_dead_doc`` (documented but never registered),
+``das503_missing_flag`` (a Config field with no CLI flag),
+``das504_unhandled_refusal`` (an emitted refusal no client dispatches
+on), ``das505_dead_doc_endpoint`` (docs cite an endpoint no front end
+serves).
+
+Baseline faults (pure fixtures through
+:func:`~dasmtl.analysis.surface.baseline.check_surface`):
+``srf601_missing_baseline``, ``srf602_removal`` (a pinned reply key
+disappears), ``srf603_addition`` (an unreviewed key appears).
+
+Probe faults (pure fixtures through the live-reply validators):
+``srf604_dead_port`` (transport failure), ``srf605_bad_status`` /
+``srf605_missing_key`` / ``srf605_extra_key`` (live reply off
+contract), ``srf606_missing_family`` (exposition loses a required
+family).
+
+Each exercise has a clean variant that must stay silent; the repo-
+global document faults go through the
+:mod:`dasmtl.analysis.rules.surface` override seams so the real docs
+are never touched.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+from typing import Iterator, Optional, Set, Tuple
+
+FAULTS: Tuple[str, ...] = (
+    "das501_extra_key", "das501_unreachable", "das502_unregistered",
+    "das502_dead_doc", "das503_missing_flag", "das504_unhandled_refusal",
+    "das505_dead_doc_endpoint", "srf601_missing_baseline",
+    "srf602_removal", "srf603_addition", "srf604_dead_port",
+    "srf605_bad_status", "srf605_missing_key", "srf605_extra_key",
+    "srf606_missing_family",
+)
+
+_ACTIVE: Set[str] = set()
+
+#: The checkout the snippets anchor into (faults.py lives at
+#: ``<root>/dasmtl/analysis/surface/faults.py``).
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def active(name: str) -> bool:
+    return name in _ACTIVE
+
+
+@contextlib.contextmanager
+def inject(name: str) -> Iterator[None]:
+    if name not in FAULTS:
+        raise ValueError(f"unknown fault {name!r}; known: {FAULTS}")
+    from dasmtl.analysis.rules import surface as rules_surface
+
+    _ACTIVE.add(name)
+    try:
+        if name == "das502_dead_doc":
+            real = _read(os.path.join(_ROOT, "docs", "OBSERVABILITY.md"))
+            rules_surface._CATALOG_TEXT_OVERRIDE = (
+                real + "\n`dasmtl_phantom_documented_total`\n")
+        if name == "das505_dead_doc_endpoint":
+            rules_surface._DOC_TEXTS_OVERRIDE = {
+                "docs/SERVING.md":
+                    "Poll GET /phantom_probe for the planted state.\n"}
+        yield
+    finally:
+        _ACTIVE.discard(name)
+        rules_surface._CATALOG_TEXT_OVERRIDE = None
+        rules_surface._DOC_TEXTS_OVERRIDE = None
+
+
+def anchor(rel: str) -> str:
+    """An absolute path inside the checkout so the anchored rules and
+    repo-root discovery treat a snippet as the named module."""
+    return os.path.join(_ROOT, *rel.split("/"))
+
+
+def _read(path: str) -> str:
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+# -- static-rule snippets -----------------------------------------------------
+
+def handler_snippet() -> str:
+    """The real serve front end plus one appended handler class whose
+    ``GET /swap`` reply carries an undeclared key (``das501_extra_key``)
+    or stays inside the contract (clean)."""
+    extra = (', "surprise_debug": 3' if active("das501_extra_key") else "")
+    return _read(anchor("dasmtl/serve/server.py")) + (
+        "\n\nclass _FaultProbeHandler:\n"
+        "    def do_GET(self):\n"
+        "        url = urlsplit(self.path)\n"
+        "        if url.path == \"/swap\":\n"
+        "            self._reply(200, {\"swap\": 1, \"generation\": 2"
+        f"{extra}}})\n")
+
+
+def routing_snippet() -> str:
+    """The real serve front end with the ``/readyz`` branch renamed
+    away (``das501_unreachable``) — the contract endpoint loses its
+    handler and an undeclared path appears, both DAS501."""
+    src = _read(anchor("dasmtl/serve/server.py"))
+    if active("das501_unreachable"):
+        src = src.replace('"/readyz"', '"/readyz_gone"')
+    return src
+
+
+def registration_snippet() -> str:
+    """A module registering one family: undocumented
+    (``das502_unregistered``) or straight from the catalog (clean)."""
+    fam = ("dasmtl_phantom_probe_total" if active("das502_unregistered")
+           else "dasmtl_serve_submitted_total")
+    return ("from dasmtl.obs.registry import MetricsRegistry\n\n"
+            "reg = MetricsRegistry()\n"
+            f"c = reg.counter(\"{fam}\", \"fault-injection probe\")\n")
+
+
+def config_snippet() -> str:
+    """A Config dataclass + parser: the ``phantom_knob`` field loses
+    its flag under ``das503_missing_flag``."""
+    flag = ("" if active("das503_missing_flag") else
+            "    p.add_argument(\"--phantom_knob\", type=int, default=0)\n")
+    return ("from dataclasses import dataclass\n\n\n"
+            "@dataclass\n"
+            "class Config:\n"
+            "    phantom_knob: int = 0\n\n\n"
+            "def build_parser(p):\n"
+            f"{flag}"
+            "    p.add_argument(\"--other_knob\", type=int, default=1)\n"
+            "    return p\n")
+
+
+def refusal_snippet() -> str:
+    """An emitter replying one refusal shape: ``wedged`` (nobody
+    dispatches on it — ``das504_unhandled_refusal``) or ``shed``
+    (dispatched by the router and stream tiers — clean)."""
+    shape = "wedged" if active("das504_unhandled_refusal") else "shed"
+    return ("class _FaultEmitter:\n"
+            "    def handle(self):\n"
+            f"        self._reply(503, {{\"error\": \"{shape}\"}})\n")
+
+
+# -- baseline fixtures --------------------------------------------------------
+
+#: A miniature but shape-complete surface for the baseline legs (the
+#: real ``artifacts/surface_baseline.json`` is never touched by the
+#: self-test).
+SURFACE_FIXTURE = {
+    "endpoints": {"serve": {
+        "GET /healthz": {"statuses": [200, 503],
+                         "keys": ["ready", "status"],
+                         "dynamic_keys": False, "dynamic_status": False,
+                         "raw_body": False},
+        "GET /metrics": {"statuses": [200], "keys": [],
+                         "dynamic_keys": False, "dynamic_status": False,
+                         "raw_body": True},
+    }},
+    "metric_families": ["dasmtl_serve_submitted_total"],
+    "config": {"fields": ["epochs"], "flags": ["epochs"]},
+}
+
+BASELINE_FIXTURE = {"version": 1, "comment": "fault-injection fixture",
+                    "generated_with": {}, "surface": SURFACE_FIXTURE}
+
+
+def baseline_doc() -> Optional[dict]:
+    """The committed-baseline stand-in; ``srf601_missing_baseline``
+    makes it vanish."""
+    if active("srf601_missing_baseline"):
+        return None
+    return json.loads(json.dumps(BASELINE_FIXTURE))
+
+
+def extracted_surface() -> dict:
+    """What 'the extractor saw': the fixture verbatim, with a pinned
+    reply key dropped (``srf602_removal``) or an unreviewed one added
+    (``srf603_addition``)."""
+    doc = json.loads(json.dumps(SURFACE_FIXTURE))
+    keys = doc["endpoints"]["serve"]["GET /healthz"]["keys"]
+    if active("srf602_removal"):
+        keys.remove("ready")
+    if active("srf603_addition"):
+        keys.append("debug_blob")
+    return doc
+
+
+# -- probe fixtures -----------------------------------------------------------
+
+def live_reply() -> Tuple[int, bytes]:
+    """A (status, body) pair for serve ``GET /healthz`` as the probe
+    would see it, bent off contract by the ``srf605_*`` faults."""
+    status = 418 if active("srf605_bad_status") else 200
+    payload = {"status": "serving", "ready": True, "warm": [1, 2]}
+    if active("srf605_missing_key"):
+        payload.pop("ready")
+    if active("srf605_extra_key"):
+        payload["debug_blob"] = {"rss": 1}
+    return status, json.dumps(payload).encode("utf-8")
+
+
+def exposition_text(required) -> str:
+    """A minimal live exposition carrying every required family —
+    minus the first one under ``srf606_missing_family``."""
+    fams = list(required)
+    if active("srf606_missing_family"):
+        fams = fams[1:]
+    return "".join(f"# TYPE {f} counter\n{f} 0\n" for f in fams)
+
+
+@contextlib.contextmanager
+def dummy_frontend() -> Iterator[str]:
+    """A throwaway HTTP server answering the router ``GET /healthz``
+    contract — the clean transport target for the SRF604/SRF605 legs.
+    Under ``srf604_dead_port`` it yields an address nothing listens
+    on."""
+    import socket
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args) -> None:
+            pass
+
+        def do_GET(self) -> None:  # noqa: N802 — http.server API shape
+            body = json.dumps({"status": "ok", "replicas": 1,
+                               "in_rotation": 1, "ready": True}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    if active("srf604_dead_port"):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()  # nothing listens here anymore
+        yield f"127.0.0.1:{port}"
+        return
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        yield "%s:%d" % httpd.server_address[:2]
+    finally:
+        httpd.shutdown()

@@ -1,0 +1,179 @@
+"""The declared wire contract: what the fleet's HTTP surfaces promise.
+
+This module is the reviewed source of truth the rest of the suite
+diffs against.  The extractor (:mod:`dasmtl.analysis.surface.extract`)
+proves what the handlers *do*; this file declares what they *may* do.
+DAS501 fails when a handler provably replies outside its contract
+entry — or when a contract endpoint has no handler left.  The runtime
+probe (:mod:`dasmtl.analysis.surface.probe`) validates live responses
+against the same entries (SRF605).
+
+Contract entry fields (see :func:`endpoint`):
+
+``statuses``
+    Every status code the endpoint may answer with.  The catch-all
+    ``500`` handlers emit on an internal bug are deliberately absent
+    except where ``500`` is part of the protocol (the serve
+    ``POST /infer`` outcome map) — a probe seeing an undeclared 500
+    *should* fail.
+``keys``
+    The full allowed top-level JSON key set.
+``required``
+    Keys present in every JSON reply regardless of outcome (the probe
+    asserts these on live responses; conditional keys like
+    ``log_probs`` or ``detail`` stay out of this set).
+``exhaustive``
+    True when ``keys`` is complete — a live reply carrying an
+    undeclared key is then a contract break.  False for payloads with
+    open-ended dynamic sections (``GET /stats`` metric snapshots,
+    rollout state) where ``keys`` lists the known stable keys only.
+``raw_body``
+    The endpoint answers (at least sometimes) with a non-JSON-object
+    body: Prometheus text exposition, ndjson traces, JSON arrays, or
+    a verbatim forwarded replica body.
+
+Growing the surface is a two-step review: extend the contract here,
+then ``dasmtl-surface --update-baseline`` to pin the new shape in
+``artifacts/surface_baseline.json``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Tuple
+
+
+def endpoint(statuses: Tuple[int, ...],
+             keys: Tuple[str, ...] = (),
+             required: Tuple[str, ...] = (),
+             exhaustive: bool = True,
+             raw_body: bool = False) -> dict:
+    """One contract entry; ``required`` must be a subset of ``keys``."""
+    keyset = frozenset(keys)
+    req = frozenset(required)
+    if not req <= keyset:
+        raise ValueError(f"required keys {sorted(req - keyset)} "
+                         "not declared in keys")
+    return {"statuses": frozenset(statuses), "keys": keyset,
+            "required": req, "exhaustive": exhaustive,
+            "raw_body": raw_body}
+
+
+#: The refusal vocabulary of the fleet protocol: every shape a server
+#: may put in an ``error`` field short of the catch-all ``"error"``.
+#: DAS504 requires each to be dispatched on by at least one client
+#: path (RouterCore retry/evict, the stream tenant, the selftests).
+REFUSAL_SHAPES: Tuple[str, ...] = (
+    "shed", "closed", "no_replica", "unreachable", "nonfinite",
+)
+
+#: ``ServeLoop.healthz()`` — the liveness snapshot every tier builds on.
+_HEALTHZ_KEYS: Tuple[str, ...] = (
+    "status", "ready", "warm", "queue_depth", "inflight", "generation",
+    "source", "precision", "swap", "post_warmup_recompiles",
+)
+
+#: ``dasmtl.obs.history.handle_query`` — shared by all three tiers.
+_QUERY = endpoint(
+    statuses=(200, 400, 404),
+    keys=("error", "families", "snapshots", "capacity",
+          "family", "since", "points"),
+)
+
+#: Prometheus text exposition.
+_METRICS = endpoint(statuses=(200,), raw_body=True)
+
+#: ``GET /trace`` — ndjson span dump, JSON error when tracing is off.
+_TRACE = endpoint(statuses=(200, 404), keys=("error",),
+                  raw_body=True)
+
+#: The serve replica's ``POST /infer`` reply shape (also what the
+#: router forwards verbatim, so the router entry reuses these keys).
+_INFER_KEYS: Tuple[str, ...] = (
+    "ok", "predictions", "log_probs", "request_id", "trace_id",
+    "latency_ms", "bucket", "error", "detail",
+)
+
+WIRE_CONTRACT: Dict[str, Dict[str, dict]] = {
+    "serve": {
+        "GET /healthz": endpoint(
+            statuses=(200, 503), keys=_HEALTHZ_KEYS,
+            required=("status", "ready")),
+        "GET /readyz": endpoint(
+            statuses=(200, 503), keys=_HEALTHZ_KEYS,
+            required=("status", "ready")),
+        "GET /metrics": _METRICS,
+        "GET /query": _QUERY,
+        "GET /stats": endpoint(
+            statuses=(200,),
+            keys=("queue", "executor", "warmup_s", "staging",
+                  "trace", "profiler"),
+            required=("queue", "executor"), exhaustive=False),
+        "GET /swap": endpoint(
+            statuses=(200,), keys=("swap", "generation"),
+            required=("swap", "generation")),
+        "GET /trace": _TRACE,
+        "POST /infer": endpoint(
+            statuses=(200, 400, 422, 500, 503, 504),
+            keys=_INFER_KEYS, required=("ok",)),
+        "POST /profile": endpoint(
+            statuses=(200, 503),
+            keys=("triggered", "capture_dir", "profiler", "reason"),
+            required=("triggered",)),
+        "POST /swap": endpoint(
+            statuses=(202, 400, 409, 503),
+            keys=("swap", "generation", "error", "detail")),
+    },
+    "router": {
+        "GET /healthz": endpoint(
+            statuses=(200,),
+            keys=("status", "replicas", "in_rotation", "ready"),
+            required=("status", "replicas", "in_rotation", "ready")),
+        "GET /readyz": endpoint(
+            statuses=(200, 503),
+            keys=("status", "replicas", "in_rotation", "ready"),
+            required=("status", "replicas", "in_rotation", "ready")),
+        "GET /metrics": _METRICS,
+        "GET /query": _QUERY,
+        "GET /rollout": endpoint(
+            statuses=(200,),
+            keys=("state", "version", "policy", "steps", "started_t",
+                  "detail"),
+            required=("state",), exhaustive=False),
+        "GET /stats": endpoint(
+            statuses=(200,),
+            keys=("replicas", "in_rotation", "retry_budget", "rollout",
+                  "rollouts"),
+            required=("replicas", "in_rotation", "retry_budget",
+                      "rollout", "rollouts")),
+        "GET /trace": _TRACE,
+        # The router forwards the winning replica's body verbatim
+        # (raw), adds 503 no_replica / 502 unreachable of its own.
+        "POST /infer": endpoint(
+            statuses=(200, 400, 422, 500, 502, 503, 504),
+            keys=_INFER_KEYS, exhaustive=False, raw_body=True),
+        "POST /rollout": endpoint(
+            statuses=(202, 400, 409),
+            keys=("rollout", "error", "detail")),
+    },
+    "stream": {
+        "GET /events": endpoint(statuses=(200,), raw_body=True),
+        "GET /healthz": endpoint(
+            statuses=(200,), keys=_HEALTHZ_KEYS + ("stream",),
+            required=("status", "stream")),
+        "GET /metrics": _METRICS,
+        "GET /query": _QUERY,
+        "GET /stats": endpoint(
+            statuses=(200,),
+            keys=("cycles", "resident", "tenants", "events_held",
+                  "alerts"),
+            required=("cycles", "tenants"), exhaustive=False),
+    },
+}
+
+
+def contract_keys(tier: str, name: str) -> FrozenSet[str]:
+    return WIRE_CONTRACT[tier][name]["keys"]
+
+
+def contract_statuses(tier: str, name: str) -> FrozenSet[int]:
+    return WIRE_CONTRACT[tier][name]["statuses"]

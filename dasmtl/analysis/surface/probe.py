@@ -1,0 +1,382 @@
+"""The runtime half: boot the REAL front ends and hold their live
+replies to the declared wire contract.
+
+Each exercise boots one tier in-process on an ephemeral port — a
+fresh-init serve replica, a router fronting one live replica, a
+streaming loop over a synthetic fiber — fires the request plan below
+through real HTTP, and validates every reply against
+:data:`dasmtl.analysis.surface.model.WIRE_CONTRACT`:
+
+- **SRF604** — the tier failed to boot, or an endpoint failed at the
+  transport level (connection refused, timeout, non-HTTP garbage).
+- **SRF605** — a live reply violated the contract: a status code the
+  contract does not declare, a required JSON key missing, or (for
+  exhaustive endpoints) a key the contract does not declare.
+- **SRF606** — a ``GET /metrics`` exposition missing a required
+  metric family (the serve/stream selftests' required lists; the
+  router's own aggregation families).
+
+The static extractor (``extract.py``) proves the handlers *mention*
+the right statuses and keys; this half proves the booted process
+*sends* them.  The validators (:func:`validate_response`,
+:func:`check_exposition`) are pure functions over (status, body) so
+the self-test and unit tests can drive them against fixtures without
+booting JAX.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from dasmtl.analysis.surface.model import WIRE_CONTRACT
+
+#: Families the router's own aggregation layer must expose on
+#: ``GET /metrics`` (registered at Router init; replica families ride
+#: along re-labeled).  The serve/stream lists live with their
+#: selftests and are imported lazily in the exercises.
+REQUIRED_ROUTER_METRIC_FAMILIES = (
+    "dasmtl_router_requests_total",
+    "dasmtl_router_retries_total",
+    "dasmtl_router_evictions_total",
+    "dasmtl_router_probes_total",
+    "dasmtl_router_replicas_in_rotation",
+    "dasmtl_router_rollouts_total",
+)
+
+
+def _finding(id_: str, message: str) -> dict:
+    return {"id": id_, "severity": "error", "message": message}
+
+
+# -- pure validators ----------------------------------------------------------
+
+def validate_response(tier: str, name: str, status: int,
+                      body: bytes) -> List[dict]:
+    """SRF605 findings for one live reply held against the contract."""
+    entry = WIRE_CONTRACT[tier][name]
+    out: List[dict] = []
+    if status not in entry["statuses"]:
+        out.append(_finding(
+            "SRF605",
+            f"{tier} {name}: live status {status} not in declared "
+            f"{sorted(entry['statuses'])}"))
+    if entry["raw_body"]:
+        return out
+    try:
+        payload = json.loads(body.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        out.append(_finding(
+            "SRF605",
+            f"{tier} {name}: reply body is not JSON but the contract "
+            f"declares a JSON object"))
+        return out
+    if not isinstance(payload, dict):
+        out.append(_finding(
+            "SRF605",
+            f"{tier} {name}: reply is {type(payload).__name__}, "
+            f"contract declares a JSON object"))
+        return out
+    missing = sorted(entry["required"] - set(payload))
+    if missing:
+        out.append(_finding(
+            "SRF605",
+            f"{tier} {name}: required keys {missing} missing from "
+            f"live reply (got {sorted(payload)})"))
+    if entry["exhaustive"]:
+        extra = sorted(set(payload) - entry["keys"])
+        if extra:
+            out.append(_finding(
+                "SRF605",
+                f"{tier} {name}: live reply carries undeclared keys "
+                f"{extra} — declare them in surface/model.py (and the "
+                f"handler, for DAS501) or stop sending them"))
+    return out
+
+
+def check_exposition(tier: str, text: str,
+                     required: Sequence[str]) -> List[dict]:
+    """SRF606 findings: required metric families absent from a live
+    ``GET /metrics`` exposition."""
+    missing = sorted(f for f in required if f not in text)
+    if missing:
+        return [_finding(
+            "SRF606",
+            f"{tier} GET /metrics: required families {missing} absent "
+            f"from the live exposition")]
+    return []
+
+
+# -- transport ----------------------------------------------------------------
+
+def _request(base: str, method: str, path: str,
+             body: Optional[dict] = None,
+             timeout: float = 30.0) -> Tuple[int, bytes]:
+    data = None
+    if body is not None:
+        data = json.dumps(body).encode("utf-8")
+    req = urllib.request.Request(f"http://{base}{path}", data=data,
+                                 method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read()
+
+
+def check_endpoint(base: str, tier: str, name: str,
+                   body: Optional[dict] = None, path: Optional[str] = None,
+                   timeout: float = 30.0) -> List[dict]:
+    """One live request validated end to end: SRF604 if the transport
+    fails, SRF605 from :func:`validate_response` otherwise.  ``body``
+    of ``...raw...`` is sent verbatim; ``path`` overrides the
+    contract path (query strings, deliberately bad bodies)."""
+    method, _, contract_path = name.partition(" ")
+    try:
+        status, raw = _request(base, method, path or contract_path,
+                               body=body, timeout=timeout)
+    except Exception as exc:  # noqa: BLE001 — any transport failure is SRF604
+        return [_finding(
+            "SRF604",
+            f"{tier} {name}: request to {base} failed at the "
+            f"transport level: {type(exc).__name__}: {exc}")]
+    return validate_response(tier, name, status, raw)
+
+
+def _boot_finding(tier: str, exc: BaseException) -> dict:
+    return _finding(
+        "SRF604",
+        f"{tier}: front end failed to boot: "
+        f"{type(exc).__name__}: {exc}")
+
+
+def _serve_http(loop, history=None, swap_builder=None):
+    from dasmtl.serve.server import make_http_server
+
+    httpd = make_http_server(loop, "127.0.0.1", 0, history=history,
+                             swap_builder=swap_builder)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, "%s:%d" % httpd.server_address[:2]
+
+
+def _boot_serve_loop(buckets=(1, 2), input_hw=(52, 64)):
+    from dasmtl.serve.executor import ExecutorPool
+    from dasmtl.serve.server import ServeLoop
+
+    executor = ExecutorPool.from_checkpoint("MTL", None, buckets,
+                                            input_hw=input_hw,
+                                            devices=1, precision="f32")
+    loop = ServeLoop(executor, buckets=buckets, max_wait_s=0.002,
+                     queue_depth=64, inflight=2)
+    loop.start()
+    return loop
+
+
+def _window(loop) -> list:
+    import numpy as np
+
+    h, w = loop.executor.input_hw
+    rng = np.random.default_rng(0)
+    return rng.normal(size=(h, w)).astype(np.float32).tolist()
+
+
+# -- exercises ----------------------------------------------------------------
+
+def probe_serve(verbose: bool = True) -> Tuple[List[dict], dict]:
+    """Fresh-init serve replica: every GET endpoint, a real inference,
+    and each refusal the handler can produce without a peer."""
+    from dasmtl.obs.history import MetricsHistory
+    from dasmtl.serve.selftest import REQUIRED_METRIC_FAMILIES
+
+    say = print if verbose else (lambda *_a, **_k: None)
+    try:
+        loop = _boot_serve_loop()
+        httpd, base = _serve_http(loop, history=MetricsHistory(64))
+    except Exception as exc:  # noqa: BLE001
+        return [_boot_finding("serve", exc)], {}
+    say(f"[surface-probe] serve replica live at {base} "
+        f"(warmup {loop.stats()['warmup_s']:.2f}s)")
+    findings: List[dict] = []
+    try:
+        plan = [
+            ("GET /healthz", None, None),
+            ("GET /readyz", None, None),
+            ("GET /swap", None, None),
+            ("GET /stats", None, None),
+            ("GET /metrics", None, None),
+            ("GET /trace", None, None),
+            ("GET /query", None, None),
+            ("GET /query", None, "/query?family=nope"),
+            ("POST /infer", {"x": _window(loop)}, None),
+            ("POST /infer", {"not_x": 1}, None),          # -> 400
+            ("POST /profile", {}, None),                  # no hook -> 503
+            ("POST /swap", {"version": "v1"}, None),      # no builder -> 503
+        ]
+        for name, body, path in plan:
+            findings += check_endpoint(base, "serve", name,
+                                       body=body, path=path)
+        status, text = _request(base, "GET", "/metrics")
+        findings += check_exposition("serve", text.decode("utf-8"),
+                                     REQUIRED_METRIC_FAMILIES)
+        checked = len(plan) + 1
+    finally:
+        httpd.shutdown()
+        loop.drain(timeout=60.0)
+        loop.close()
+    return findings, {"serve": {"endpoints_checked": checked,
+                                "base": base}}
+
+
+def probe_router(verbose: bool = True) -> Tuple[List[dict], dict]:
+    """Router fronting ONE live in-process replica: placement, probe
+    rotation, and the aggregated exposition, all over real HTTP."""
+    import time
+
+    from dasmtl.serve.router import (ReplicaHandle, Router,
+                                     make_router_http_server)
+
+    say = print if verbose else (lambda *_a, **_k: None)
+    try:
+        loop = _boot_serve_loop(buckets=(1,))
+        rep_httpd, rep_base = _serve_http(loop)
+        handles = [ReplicaHandle("r0", rep_base, probe_interval_s=0.1,
+                                 backoff_max_s=2.0)]
+        router = Router(handles, retry_budget=1, request_timeout_s=60.0,
+                        probe_tick_s=0.02).start()
+        httpd = make_router_http_server(router, "127.0.0.1", 0)
+        base = "%s:%d" % httpd.server_address[:2]
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        deadline = time.monotonic() + 30.0
+        while not router.core.in_rotation():
+            if time.monotonic() > deadline:
+                raise TimeoutError("replica never entered rotation")
+            time.sleep(0.02)
+    except Exception as exc:  # noqa: BLE001
+        return [_boot_finding("router", exc)], {}
+    say(f"[surface-probe] router live at {base} fronting replica "
+        f"{rep_base}")
+    findings: List[dict] = []
+    try:
+        plan = [
+            ("GET /healthz", None, None),
+            ("GET /readyz", None, None),
+            ("GET /stats", None, None),
+            ("GET /rollout", None, None),
+            ("GET /metrics", None, None),
+            ("GET /trace", None, None),
+            ("GET /query", None, None),
+            ("POST /infer", {"x": _window(loop)}, None),
+            ("POST /infer", {"not_x": 1}, None),          # -> 400 upstream
+            ("POST /rollout", {"policy": "bogus"}, None),  # -> 400, no side effects
+        ]
+        for name, body, path in plan:
+            findings += check_endpoint(base, "router", name,
+                                       body=body, path=path, timeout=60.0)
+        status, text = _request(base, "GET", "/metrics")
+        findings += check_exposition("router", text.decode("utf-8"),
+                                     REQUIRED_ROUTER_METRIC_FAMILIES)
+        checked = len(plan) + 1
+    finally:
+        httpd.shutdown()
+        router.close()
+        rep_httpd.shutdown()
+        loop.drain(timeout=60.0)
+        loop.close()
+    return findings, {"router": {"endpoints_checked": checked,
+                                 "base": base, "replica": rep_base}}
+
+
+def probe_stream(verbose: bool = True) -> Tuple[List[dict], dict]:
+    """Streaming front end over one synthetic fiber, using the stream
+    selftest's analytic-oracle pool (guaranteed head-compatible)."""
+    import itertools
+
+    from dasmtl.serve.server import ServeLoop
+    from dasmtl.stream.feed import SyntheticSource
+    from dasmtl.stream.live import (REQUIRED_STREAM_METRIC_FAMILIES,
+                                    StreamLoop, StreamTenant,
+                                    make_stream_http_server)
+    from dasmtl.stream.selftest import N_DISTANCE_BINS, _oracle_pool
+
+    say = print if verbose else (lambda *_a, **_k: None)
+    window = (64, 64)
+    try:
+        pool = _oracle_pool(window, (1, 2), 1)
+        loop = ServeLoop(pool, buckets=(1, 2), max_wait_s=0.002,
+                         queue_depth=64, inflight=2)
+        loop.start()
+        tenants = [StreamTenant("fiber0", SyntheticSource(160, seed=0),
+                                window=window, stride_time=32,
+                                stride_channels=48, ring_samples=4096,
+                                chunk_samples=64,
+                                n_distance_bins=N_DISTANCE_BINS,
+                                track_ids=itertools.count(1))]
+        stream = StreamLoop(loop, tenants, cycle_budget=16,
+                            max_wait_s=0.002)
+        for _ in range(4):  # a few real cycles so counters move
+            stream.run_cycle()
+        httpd = make_stream_http_server(stream, "127.0.0.1", 0)
+        base = "%s:%d" % httpd.server_address[:2]
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    except Exception as exc:  # noqa: BLE001
+        return [_boot_finding("stream", exc)], {}
+    say(f"[surface-probe] stream front end live at {base} (1 fiber)")
+    findings: List[dict] = []
+    try:
+        plan = [
+            ("GET /healthz", None, None),
+            ("GET /stats", None, None),
+            ("GET /events", None, None),
+            ("GET /metrics", None, None),
+            ("GET /query", None, None),
+        ]
+        for name, body, path in plan:
+            findings += check_endpoint(base, "stream", name,
+                                       body=body, path=path)
+        status, text = _request(base, "GET", "/metrics")
+        findings += check_exposition("stream", text.decode("utf-8"),
+                                     REQUIRED_STREAM_METRIC_FAMILIES)
+        checked = len(plan) + 1
+    finally:
+        httpd.shutdown()
+        stream.drain(timeout=60.0)
+        loop.drain(timeout=60.0)
+        stream.close()
+        loop.close()
+    return findings, {"stream": {"endpoints_checked": checked,
+                                 "base": base}}
+
+
+EXERCISES: Dict[str, dict] = {
+    "serve": {"fn": probe_serve,
+              "doc": "fresh-init serve replica, all 9 endpoints + "
+                     "refusal paths + required exposition families"},
+    "router": {"fn": probe_router,
+               "doc": "router fronting one live in-process replica, "
+                      "all 9 endpoints + aggregated exposition"},
+    "stream": {"fn": probe_stream,
+               "doc": "streaming front end over one synthetic fiber, "
+                      "all 5 endpoints + stream exposition families"},
+}
+
+PRESETS: Dict[str, Tuple[str, ...]] = {
+    "quick": ("serve",),
+    "ci": ("serve", "router", "stream"),
+    "full": ("serve", "router", "stream"),
+}
+
+
+def run_probes(names: Sequence[str],
+               verbose: bool = True) -> Tuple[List[dict], dict]:
+    findings: List[dict] = []
+    measured: dict = {}
+    for name in names:
+        f, m = EXERCISES[name]["fn"](verbose=verbose)
+        findings += f
+        measured.update(m)
+    return findings, measured

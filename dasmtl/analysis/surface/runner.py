@@ -1,0 +1,300 @@
+"""``dasmtl-surface`` — interface-contract suite CLI.
+
+Three verbs in one tool, mirroring the rest of the analysis family
+(``dasmtl-audit`` / ``dasmtl-sanitize`` / ``dasmtl-conc`` /
+``dasmtl-mem``):
+
+- **default (static)** — extract the complete wire surface of the
+  checkout (front-end endpoints, metric families, Config/CLI schema)
+  and gate it against the committed
+  ``artifacts/surface_baseline.json`` (``--check-baseline`` →
+  SRF601-603; ``--update-baseline`` rewrites it for review).  The
+  per-handler contract rules DAS501-DAS505 run under ``dasmtl-lint``.
+- **probe** — boot the REAL front ends in-process on ephemeral ports
+  (fresh-init serve replica, router + one live replica, streaming
+  loop over a synthetic fiber) and hold their live replies to the
+  declared contract (SRF604-606; ``--preset quick|ci|full``).
+- **--self-test** — fault injection: plant every defect class the
+  suite claims to catch (:mod:`dasmtl.analysis.surface.faults`) and
+  verify each check fires, with a clean variant that must stay
+  silent.
+
+Exit status 1 on any error finding — the CI gate shape shared by the
+whole family (docs/STATIC_ANALYSIS.md "Interface contracts").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence, Tuple
+
+from dasmtl.analysis.surface.baseline import (DEFAULT_BASELINE_PATH,
+                                              check_surface, load_baseline,
+                                              update_baseline)
+
+
+def _pin_backend(min_devices: int = 1) -> None:
+    os.environ["DASMTL_DISABLE_DONATION"] = "1"
+    from dasmtl.analysis.audit.runner import _pin_cpu_backend
+
+    _pin_cpu_backend(min_devices)
+
+
+def resolve_exercises(preset: str,
+                      names: Optional[str]) -> Tuple[str, ...]:
+    from dasmtl.analysis.surface.probe import EXERCISES, PRESETS
+
+    if names:
+        out = tuple(n.strip() for n in names.split(",") if n.strip())
+        unknown = [n for n in out if n not in EXERCISES]
+        if unknown:
+            raise ValueError(f"unknown exercise(s) {unknown}; known: "
+                             f"{sorted(EXERCISES)}")
+        return out
+    return PRESETS[preset]
+
+
+# -- self-test ----------------------------------------------------------------
+
+def self_test(verbose: bool = True) -> List[dict]:
+    """Plant every fault in :data:`faults.FAULTS`; each must be caught
+    by exactly its check, and the clean variant must stay silent.
+    Returns findings for every MISSED fault (empty = suite proven)."""
+    from dasmtl.analysis.lint import lint_source
+    from dasmtl.analysis.surface import faults, probe
+    from dasmtl.analysis.surface.probe import (
+        REQUIRED_ROUTER_METRIC_FAMILIES)
+
+    say = print if verbose else (lambda *_a, **_k: None)
+    findings: List[dict] = []
+
+    def note(msg: str) -> None:
+        say(f"[surface-self-test] {msg}")
+
+    def miss(check: str, msg: str) -> None:
+        findings.append({"id": check, "severity": "error",
+                         "message": msg})
+
+    def leg(fault: str, expect: str, run) -> None:
+        with faults.inject(fault):
+            dirty = run()
+        clean = run()
+        if expect in dirty:
+            note(f"{expect} caught injected {fault}")
+        else:
+            miss(expect, f"injected fault {fault!r} was NOT caught "
+                         f"({expect} stayed silent)")
+        if expect in clean:
+            miss(expect, f"clean variant of {fault!r} tripped {expect} "
+                         f"— the check over-fires")
+        else:
+            note(f"clean variant of {fault} stays silent")
+
+    def lint_ids(source: str, path: str, rule: str) -> List[str]:
+        return [f.rule for f in lint_source(source, path, select=[rule])]
+
+    def srf_ids(found: List[dict]) -> List[str]:
+        return [f["id"] for f in found]
+
+    server_anchor = faults.anchor("dasmtl/serve/server.py")
+    registry_anchor = faults.anchor("dasmtl/obs/registry.py")
+
+    # Static rules: linted snippets / doctored documents.
+    leg("das501_extra_key", "DAS501",
+        lambda: lint_ids(faults.handler_snippet(), server_anchor,
+                         "DAS501"))
+    leg("das501_unreachable", "DAS501",
+        lambda: lint_ids(faults.routing_snippet(), server_anchor,
+                         "DAS501"))
+    leg("das502_unregistered", "DAS502",
+        lambda: lint_ids(faults.registration_snippet(),
+                         faults.anchor("dasmtl/obs/_surface_probe.py"),
+                         "DAS502"))
+    leg("das502_dead_doc", "DAS502",
+        lambda: lint_ids(faults._read(registry_anchor), registry_anchor,
+                         "DAS502"))
+    leg("das503_missing_flag", "DAS503",
+        lambda: lint_ids(faults.config_snippet(),
+                         faults.anchor("dasmtl/config.py"), "DAS503"))
+    leg("das504_unhandled_refusal", "DAS504",
+        lambda: lint_ids(faults.refusal_snippet(),
+                         faults.anchor("dasmtl/serve/batcher.py"),
+                         "DAS504"))
+    leg("das505_dead_doc_endpoint", "DAS505",
+        lambda: lint_ids(faults._read(server_anchor), server_anchor,
+                         "DAS505"))
+
+    # Baseline gate: pure fixtures through check_surface.
+    def baseline_run() -> List[str]:
+        return srf_ids(check_surface(faults.extracted_surface(),
+                                     faults.baseline_doc(), "<fixture>"))
+
+    leg("srf601_missing_baseline", "SRF601", baseline_run)
+    leg("srf602_removal", "SRF602", baseline_run)
+    leg("srf603_addition", "SRF603", baseline_run)
+
+    # Probe validators: fixtures + a throwaway HTTP server.
+    def transport_run() -> List[str]:
+        with faults.dummy_frontend() as base:
+            return srf_ids(probe.check_endpoint(base, "router",
+                                                "GET /healthz",
+                                                timeout=5.0))
+
+    def reply_run() -> List[str]:
+        status, body = faults.live_reply()
+        return srf_ids(probe.validate_response("serve", "GET /healthz",
+                                               status, body))
+
+    def exposition_run() -> List[str]:
+        text = faults.exposition_text(REQUIRED_ROUTER_METRIC_FAMILIES)
+        return srf_ids(probe.check_exposition(
+            "router", text, REQUIRED_ROUTER_METRIC_FAMILIES))
+
+    leg("srf604_dead_port", "SRF604", transport_run)
+    leg("srf605_bad_status", "SRF605", reply_run)
+    leg("srf605_missing_key", "SRF605", reply_run)
+    leg("srf605_extra_key", "SRF605", reply_run)
+    leg("srf606_missing_family", "SRF606", exposition_run)
+
+    return findings
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def render(f: dict) -> str:
+    return f"{f['id']} [{f['severity']}] {f['message']}"
+
+
+def summary_line(findings: Sequence[dict]) -> str:
+    n_err = sum(1 for f in findings if f["severity"] == "error")
+    n_warn = len(findings) - n_err
+    status = "clean" if not findings else (f"{n_err} error(s), "
+                                           f"{n_warn} warning(s)")
+    return f"surface: {status}"
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    from dasmtl.analysis.surface.probe import EXERCISES, PRESETS
+
+    ap = argparse.ArgumentParser(
+        prog="dasmtl-surface",
+        description="Interface-contract suite: static wire-surface "
+                    "extraction gated by the committed "
+                    "artifacts/surface_baseline.json (SRF601-603), and "
+                    "a runtime probe that boots the real front ends on "
+                    "ephemeral ports and validates live replies "
+                    "(SRF604-606).  The per-handler contract rules "
+                    "DAS501-DAS505 run under dasmtl-lint "
+                    "(docs/STATIC_ANALYSIS.md 'Interface contracts').")
+    ap.add_argument("verb", nargs="?", choices=("probe",), default=None,
+                    help="probe = boot serve/router/stream front ends "
+                         "and validate live replies (default: static "
+                         "extraction + baseline gate)")
+    ap.add_argument("--preset", choices=sorted(PRESETS), default="ci",
+                    help="probe exercise subset (default: ci)")
+    ap.add_argument("--exercises", type=str, default=None,
+                    help="comma-separated probe exercise names "
+                         "(overrides --preset; see --list-exercises)")
+    ap.add_argument("--root", type=str, default=".",
+                    help="checkout to extract (default: .)")
+    ap.add_argument("--check-baseline", action="store_true",
+                    help="fail on surface drift against the committed "
+                         "baseline (SRF601-603)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from this extraction "
+                         "(review the diff, commit)")
+    ap.add_argument("--baseline", type=str, default=DEFAULT_BASELINE_PATH)
+    ap.add_argument("--dump", type=str, default=None,
+                    help="write the extracted surface as JSON")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the fault-injection legs instead: each "
+                         "planted contract defect must be caught")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-exercises", action="store_true",
+                    help="print the probe exercises and presets, then "
+                         "exit")
+    args = ap.parse_args(argv)
+
+    if args.list_exercises:
+        for name in sorted(EXERCISES):
+            print(f"{name}: {EXERCISES[name]['doc']}")
+        for name, members in sorted(PRESETS.items()):
+            print(f"preset {name}: {', '.join(members)}")
+        return 0
+
+    if args.self_test:
+        findings = self_test(verbose=args.format == "text")
+        if args.format == "json":
+            print(json.dumps({"findings": findings}))
+        else:
+            for f in findings:
+                print(render(f))
+            print("self-test: "
+                  + ("all injected faults caught" if not findings
+                     else f"{len(findings)} fault(s) NOT caught"),
+                  file=sys.stderr)
+        return 1 if findings else 0
+
+    if args.verb == "probe":
+        from dasmtl.analysis.surface.probe import run_probes
+
+        try:
+            names = resolve_exercises(args.preset, args.exercises)
+        except ValueError as exc:
+            ap.error(str(exc))
+        _pin_backend()
+        findings, measured = run_probes(names,
+                                        verbose=args.format == "text")
+        if args.format == "json":
+            print(json.dumps({"exercises": list(names),
+                              "measured": measured,
+                              "findings": findings}))
+        else:
+            for tier in names:
+                m = measured.get(tier, {})
+                print(f"{tier}: endpoints_checked="
+                      f"{m.get('endpoints_checked', 0)}")
+            for f in findings:
+                print(render(f))
+            print(summary_line(findings), file=sys.stderr)
+        return 1 if any(f["severity"] == "error" for f in findings) else 0
+
+    # Static: extract + baseline gate.
+    from dasmtl.analysis.surface.extract import extract_surface
+
+    surface = extract_surface(args.root)
+    findings = []
+    if args.update_baseline:
+        doc = update_baseline(surface, args.baseline)
+        n_eps = sum(len(v) for v in doc["surface"]["endpoints"].values())
+        print(f"baseline written: {args.baseline} ({n_eps} endpoint(s), "
+              f"{len(doc['surface']['metric_families'])} metric "
+              f"family(ies))", file=sys.stderr)
+    elif args.check_baseline:
+        findings = check_surface(surface, load_baseline(args.baseline),
+                                 args.baseline)
+    if args.dump:
+        with open(args.dump, "w", encoding="utf-8") as f:
+            json.dump(surface, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"surface dumped to {args.dump}", file=sys.stderr)
+
+    if args.format == "json":
+        print(json.dumps({"surface": surface, "findings": findings}))
+    else:
+        for tier, eps in sorted(surface["endpoints"].items()):
+            print(f"{tier}: {len(eps)} endpoint(s)")
+        print(f"metric families: {len(surface['metric_families'])}")
+        print(f"config: {len(surface['config']['fields'])} field(s), "
+              f"{len(surface['config']['flags'])} flag(s)")
+        for f in findings:
+            print(render(f))
+        print(summary_line(findings), file=sys.stderr)
+    return 1 if any(f["severity"] == "error" for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

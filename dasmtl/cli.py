@@ -134,6 +134,20 @@ def mem_main(argv=None) -> int:
     return main(argv)
 
 
+def surface_main(argv=None) -> int:
+    """``dasmtl-surface`` — the interface-contract suite
+    (dasmtl/analysis/surface/; DAS501-DAS505 + SRF60x in
+    docs/STATIC_ANALYSIS.md).  Statically extracts the fleet's wire
+    surface (front-end endpoints, metric families, Config/CLI schema)
+    and gates it against the committed surface baseline; ``probe``
+    boots the real front ends on ephemeral ports and validates live
+    replies; proves itself by fault injection (--self-test)."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from dasmtl.analysis.surface.runner import main
+
+    return main(argv)
+
+
 def obs_main(argv=None) -> int:
     """``dasmtl-obs`` — the unified telemetry layer's CLI
     (dasmtl/obs/; docs/OBSERVABILITY.md): ``dump`` span records or
@@ -186,6 +200,9 @@ _SUBCOMMANDS = {
                         "lock-order baseline (dasmtl-conc)"),
     "mem": (mem_main, "memory suite: runtime lease tracking + "
                       "membudget baseline (dasmtl-mem)"),
+    "surface": (surface_main, "interface-contract suite: wire-surface "
+                              "baseline + live front-end probe "
+                              "(dasmtl-surface)"),
     "obs": (obs_main, "telemetry: trace dump/join, exposition check, "
                       "alert selftest, profiler capture+analyze "
                       "(dasmtl-obs)"),

@@ -673,9 +673,11 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dataset_ram", action=_CompatBoolAction,
                    default=d.dataset_ram,
                    help="preload all .mat files into host RAM")
-    p.add_argument("--trainVal_set_striking", dest="trainval_set_striking",
+    p.add_argument("--trainval_set_striking", "--trainVal_set_striking",
+                   dest="trainval_set_striking",
                    type=str, default=d.trainval_set_striking)
-    p.add_argument("--trainVal_set_excavating", dest="trainval_set_excavating",
+    p.add_argument("--trainval_set_excavating", "--trainVal_set_excavating",
+                   dest="trainval_set_excavating",
                    type=str, default=d.trainval_set_excavating)
     p.add_argument("--test_set_striking", type=str, default=d.test_set_striking)
     p.add_argument("--test_set_excavating", type=str,

@@ -285,8 +285,8 @@ class StagingBuffers:
                 "replaced_aliased": self._replaced,
             }
 
-    def publish_metrics(self, registry, prefix: str = "dasmtl_staging"
-                        ) -> None:
+    def publish_metrics(self, registry,
+                        prefix: str = "dasmtl_serve_staging") -> None:
         """Mirror :meth:`stats` onto a metrics registry
         (:mod:`dasmtl.obs.registry`) at scrape time: the monotone fields
         (acquires / blocked_acquires / replaced_aliased) as counters —

@@ -593,7 +593,7 @@ def run_alert_selftest(say: Callable[[str], None] = print) -> int:
     stderr_buf = io.StringIO()
     webhook = WebhookSink(url, retries=3, backoff_s=0.01)
     reg = MetricsRegistry()
-    p99 = reg.gauge("dasmtl_serve_p99_ms", "seeded SLO gauge")
+    p99 = reg.gauge("dasmtl_serve_p99_ms", "seeded SLO gauge")  # dasmtl: noqa[DAS502] — selftest fixture, never scraped
     shed = reg.counter("dasmtl_stream_shed_total", "seeded burn counter",
                        labelnames=("fiber",))
 

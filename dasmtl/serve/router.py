@@ -635,7 +635,7 @@ def _make_router_handler(router: Router):
                         version=body.get("version"),
                         policy=body.get("policy", "drain"))
                 except (ValueError, json.JSONDecodeError) as exc:
-                    self._reply(400, {"error": "bad_request",
+                    self._reply(400, {"error": "bad_request",  # dasmtl: noqa[DAS504] — terminal 400, clients dispatch on status
                                       "detail": str(exc)})
                     return
                 code = 409 if status.get("state") == "refused" else 202

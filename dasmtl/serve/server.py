@@ -766,7 +766,7 @@ def _make_handler(loop: ServeLoop, request_timeout_s: float,
                     body = json.loads(self.rfile.read(n)) if n else {}
                     version = body.get("version")
                 except (ValueError, json.JSONDecodeError) as exc:
-                    self._reply(400, {"error": "bad_request",
+                    self._reply(400, {"error": "bad_request",  # dasmtl: noqa[DAS504] — terminal 400, clients dispatch on status
                                       "detail": f"expected JSON "
                                                 f'{{"version": ...}}: '
                                                 f"{exc}"})
@@ -800,7 +800,7 @@ def _make_handler(loop: ServeLoop, request_timeout_s: float,
                 x = np.asarray(body["x"], np.float32)
                 want_log_probs = bool(body.get("log_probs", False))
             except (ValueError, KeyError, json.JSONDecodeError) as exc:
-                self._reply(400, {"ok": False, "error": "bad_request",
+                self._reply(400, {"ok": False, "error": "bad_request",  # dasmtl: noqa[DAS504] — terminal 400, clients dispatch on status
                                   "detail": f"expected JSON "
                                             f'{{"x": [[...]]}}: {exc}'},
                             echo)
@@ -810,7 +810,7 @@ def _make_handler(loop: ServeLoop, request_timeout_s: float,
                 x = x[..., 0]
             if x.shape != (h, w):
                 self._reply(400, {
-                    "ok": False, "error": "bad_request",
+                    "ok": False, "error": "bad_request",  # dasmtl: noqa[DAS504] — terminal 400, clients dispatch on status
                     "detail": f"window must be {h}x{w}, got "
                               f"{list(x.shape)}"}, echo)
                 return
@@ -819,7 +819,7 @@ def _make_handler(loop: ServeLoop, request_timeout_s: float,
                                   want_log_probs=want_log_probs,
                                   trace_id=inbound_trace)
             except FuturesTimeoutError:
-                self._reply(504, {"ok": False, "error": "timeout",
+                self._reply(504, {"ok": False, "error": "timeout",  # dasmtl: noqa[DAS504] — terminal 504, clients dispatch on status
                                   "detail": f"no response within "
                                             f"{request_timeout_s}s"},
                             echo)

@@ -218,6 +218,7 @@ def collect() -> dict:
             "mem_dump_path": d.mem_dump_path,
         },
         "membudget_baseline": _membudget_baseline_summary(),
+        "surface_baseline": _surface_baseline_summary(),
     }
     return info
 
@@ -321,6 +322,35 @@ def _membudget_baseline_summary() -> dict:
     status = "ok" if gen == _generated_with() else "stale"
     return {"path": path, "status": status,
             "tiers": len(data.get("tiers", {})), "generated_with": gen}
+
+
+def _surface_baseline_summary() -> dict:
+    """Status of the interface-contract suite's committed wire surface
+    — metadata only, nothing extracted or booted here.  ``stale`` means
+    the recording environment drifted (python/jax versions differ from
+    this host): the surface still gates, but regenerate after
+    justifying the bump."""
+    from dasmtl.analysis.surface.baseline import (DEFAULT_BASELINE_PATH,
+                                                  _generated_with,
+                                                  load_baseline)
+
+    path = DEFAULT_BASELINE_PATH
+    try:
+        data = load_baseline(path)
+    except (OSError, ValueError) as exc:
+        return {"path": path, "status": f"unreadable ({exc})"}
+    if data is None:
+        return {"path": path, "status": "missing"}
+    gen = data.get("generated_with", {})
+    status = "ok" if gen == _generated_with() else "stale"
+    surface = data.get("surface", {})
+    return {"path": path, "status": status,
+            "endpoints": sum(len(v) for v in
+                             surface.get("endpoints", {}).values()),
+            "metric_families": len(surface.get("metric_families", [])),
+            "config_fields": len(surface.get("config", {})
+                                 .get("fields", [])),
+            "generated_with": gen}
 
 
 def check_exported_artifact(path: str, window=None,
@@ -540,6 +570,25 @@ def main(argv=None) -> int:
               f"{mb.get('status', 'missing')} at {mb.get('path')} — "
               f"generate with dasmtl-mem --update-baseline "
               f"--preset full")
+    sb = ana.get("surface_baseline", {})
+    if sb.get("status") == "ok":
+        print(f"  surface: wire-surface baseline ok — "
+              f"{sb['endpoints']} endpoint(s), {sb['metric_families']} "
+              f"metric family(ies), {sb['config_fields']} config "
+              f"field(s) in {sb['path']}; verify with dasmtl-surface "
+              f"--check-baseline")
+    elif sb.get("status") == "stale":
+        gen = sb.get("generated_with", {})
+        gen_s = ", ".join(f"{k} {v}" for k, v in sorted(gen.items()))
+        print(f"  surface: wire-surface baseline STALE — "
+              f"{sb['endpoints']} endpoint(s) in {sb['path']} recorded "
+              f"under {gen_s}; the surface still gates, refresh with "
+              f"dasmtl-surface --update-baseline after justifying the "
+              f"version bump")
+    else:
+        print(f"  surface: wire-surface baseline "
+              f"{sb.get('status', 'missing')} at {sb.get('path')} — "
+              f"generate with dasmtl-surface --update-baseline")
     return rc
 
 

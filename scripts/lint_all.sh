@@ -66,6 +66,20 @@ else
     echo "== dasmtl-mem skipped (DASMTL_LINT_SKIP_MEM set)"
 fi
 
+# Interface-contract suite: the fault-injection self-test (AST snippets
+# + pure fixtures, no model compiles — cheap), then the wire-surface
+# baseline gate (pure static extraction — cheap).  The per-handler
+# rules DAS501-DAS505 already ran under dasmtl-lint above; CI's
+# surface job adds the live probe (boots the real front ends).
+if [ "${DASMTL_LINT_SKIP_SURFACE:-}" = "" ]; then
+    echo "== dasmtl-surface --self-test"
+    python -m dasmtl.analysis.surface --self-test || rc=1
+    echo "== dasmtl-surface --check-baseline"
+    python -m dasmtl.analysis.surface --check-baseline || rc=1
+else
+    echo "== dasmtl-surface skipped (DASMTL_LINT_SKIP_SURFACE set)"
+fi
+
 # Online-serving smoke: the in-process selftest (concurrent clients, NaN
 # poisoning, SIGTERM drain, recompile/occupancy invariants) on a reduced
 # window — a few model compiles, so skippable for doc-only edits.

@@ -1,0 +1,375 @@
+"""Interface-contract suite (dasmtl/analysis/surface/ + rules
+DAS501-DAS505 + SRF60x): extractor fidelity on the real tree and on
+synthetic handlers, each rule firing/staying-silent through the fault
+snippets, the committed wire-surface baseline round trip, the live
+probe validators, and the suite's own fault-injection self-test."""
+
+import json
+import os
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read(rel: str) -> str:
+    with open(os.path.join(ROOT, rel), encoding="utf-8") as f:
+        return f.read()
+
+
+def _lint_ids(source: str, path: str, rule: str):
+    from dasmtl.analysis.lint import lint_source
+
+    return [f for f in lint_source(source, path, select=[rule])]
+
+
+# -- extractor: the real tree -------------------------------------------------
+
+def test_extracted_endpoints_match_contract():
+    """The static extractor recovers exactly the declared endpoint set
+    for every front end — no phantom endpoints, none missing."""
+    from dasmtl.analysis.surface.extract import extract_frontends
+    from dasmtl.analysis.surface.model import WIRE_CONTRACT
+
+    fronts = extract_frontends(ROOT)
+    assert set(fronts) == set(WIRE_CONTRACT)
+    for tier, eps in fronts.items():
+        assert {e.name for e in eps} == set(WIRE_CONTRACT[tier]), tier
+
+
+def test_extracted_serve_infer_shape():
+    """POST /infer on the serve tier: the status ladder including the
+    outcome map and locals-resolved codes, and the reply keys."""
+    from dasmtl.analysis.surface.extract import extract_frontends
+
+    eps = {e.name: e for e in extract_frontends(ROOT)["serve"]}
+    infer = eps["POST /infer"]
+    assert infer.statuses == {200, 400, 422, 500, 503, 504}
+    assert {"ok", "predictions", "error", "detail"} <= infer.keys
+    health = eps["GET /healthz"]
+    assert health.statuses == {200, 503}
+    assert {"status", "ready"} <= health.keys
+
+
+def test_extractor_synthetic_handler():
+    """Path-guard forms, IfExp statuses, int-local resolution, and
+    dict-literal keys on a handler the extractor has never seen."""
+    from dasmtl.analysis.surface.extract import (
+        extract_endpoints_from_source)
+
+    src = (
+        "from urllib.parse import urlsplit\n"
+        "class H:\n"
+        "    def do_GET(self):\n"
+        "        url = urlsplit(self.path)\n"
+        "        if url.path != '/thing':\n"
+        "            self._reply(404, {'error': 'nope'})\n"
+        "            return\n"
+        "        code = 200 if self.ok else 503\n"
+        "        self._reply(code, {'thing': 1, 'spare': 2})\n")
+    eps = {e.name: e for e in
+           extract_endpoints_from_source(src, "serve")}
+    assert eps["GET /thing"].statuses == {200, 503}
+    assert eps["GET /thing"].keys == {"thing", "spare"}
+
+
+def test_extractor_try_wrapped_chain():
+    """The stream front end's idiom: the if/elif chain lives inside a
+    try/except — structural recursion must still find every branch."""
+    from dasmtl.analysis.surface.extract import (
+        extract_endpoints_from_source)
+
+    src = (
+        "from urllib.parse import urlsplit\n"
+        "class H:\n"
+        "    def do_GET(self):\n"
+        "        url = urlsplit(self.path)\n"
+        "        try:\n"
+        "            if url.path == '/a':\n"
+        "                self._reply(200, {'a': 1})\n"
+        "            elif url.path == '/b':\n"
+        "                self._reply(200, {'b': 1})\n"
+        "        except Exception:\n"
+        "            self._reply(500, {'error': 'boom'})\n")
+    eps = {e.name for e in extract_endpoints_from_source(src, "stream")}
+    assert eps == {"GET /a", "GET /b"}
+
+
+def test_metric_catalog_reconciled():
+    """Satellite 1's end state, asserted structurally: the
+    OBSERVABILITY.md catalog and the registered families agree exactly,
+    modulo the single pinned-internal family (noqa'd at its
+    registration site in dasmtl/obs/alerts.py)."""
+    from dasmtl.analysis.surface.extract import (extract_catalog,
+                                                 extract_registrations)
+
+    registered = {r.family for r in extract_registrations(ROOT)}
+    catalog = set(extract_catalog(ROOT))
+    assert catalog - registered == set()  # no dead docs
+    assert registered - catalog == {"dasmtl_serve_p99_ms"}
+
+
+def test_config_schema_extraction():
+    """The DAS503 extractor sees the full Config surface, including
+    the snake_case aliases added for the parity fix."""
+    from dasmtl.analysis.surface.extract import (
+        extract_config_schema_from_source)
+
+    schema = extract_config_schema_from_source(_read("dasmtl/config.py"))
+    assert "trainval_set_striking" in schema["fields"]
+    assert "trainval_set_striking" in schema["flags"]
+    assert set(schema["fields"]) <= set(schema["flags"])
+    assert len(schema["fields"]) > 80
+
+
+# -- rules: fire on the fault, silent on the clean variant --------------------
+
+_RULE_LEGS = [
+    ("das501_extra_key", "DAS501", "handler_snippet",
+     "dasmtl/serve/server.py"),
+    ("das501_unreachable", "DAS501", "routing_snippet",
+     "dasmtl/serve/server.py"),
+    ("das502_unregistered", "DAS502", "registration_snippet",
+     "dasmtl/obs/_surface_probe.py"),
+    ("das503_missing_flag", "DAS503", "config_snippet",
+     "dasmtl/config.py"),
+    ("das504_unhandled_refusal", "DAS504", "refusal_snippet",
+     "dasmtl/serve/batcher.py"),
+]
+
+
+@pytest.mark.parametrize("fault,rule,snippet,anchor_rel", _RULE_LEGS)
+def test_rule_positive_and_negative(fault, rule, snippet, anchor_rel):
+    from dasmtl.analysis.surface import faults
+
+    fn = getattr(faults, snippet)
+    path = faults.anchor(anchor_rel)
+    with faults.inject(fault):
+        assert any(f.rule == rule for f in _lint_ids(fn(), path, rule)), \
+            f"{rule} silent on injected {fault}"
+    assert not _lint_ids(fn(), path, rule), \
+        f"{rule} over-fires on the clean variant of {fault}"
+
+
+def test_das502_reverse_and_das505_via_overrides():
+    """The repo-global directions go through the override seams: a
+    doctored catalog/doc must flag against the REAL sources, and the
+    real documents must stay silent."""
+    from dasmtl.analysis.surface import faults
+
+    reg_path = faults.anchor("dasmtl/obs/registry.py")
+    srv_path = faults.anchor("dasmtl/serve/server.py")
+    with faults.inject("das502_dead_doc"):
+        hits = _lint_ids(faults._read(reg_path), reg_path, "DAS502")
+        assert any("dasmtl_phantom_documented_total" in f.message
+                   for f in hits)
+    assert not _lint_ids(faults._read(reg_path), reg_path, "DAS502")
+    with faults.inject("das505_dead_doc_endpoint"):
+        hits = _lint_ids(faults._read(srv_path), srv_path, "DAS505")
+        assert any("/phantom_probe" in f.message for f in hits)
+    assert not _lint_ids(faults._read(srv_path), srv_path, "DAS505")
+
+
+def test_package_lints_clean_on_surface_rules():
+    """Regression for the satellite fixes: the whole package passes
+    DAS501-DAS505 (snake_case config aliases, reconciled catalog,
+    noqa-pinned terminal refusals)."""
+    from dasmtl.analysis.lint import lint_paths
+
+    findings = lint_paths([os.path.join(ROOT, "dasmtl")],
+                          select=["DAS501", "DAS502", "DAS503",
+                                  "DAS504", "DAS505"])
+    assert findings == []
+
+
+def test_noqa_pins_exactly():
+    """The intentional escapes are pinned to exact counts: 5 terminal
+    refusal sites (DAS504) and 1 internal metric family (DAS502).  A
+    new escape must be argued here, not waved through."""
+    def count(tag: str) -> int:
+        n = 0
+        for dirpath, _dirs, files in os.walk(os.path.join(ROOT, "dasmtl")):
+            for fn in files:
+                if fn.endswith(".py"):
+                    with open(os.path.join(dirpath, fn),
+                              encoding="utf-8") as f:
+                        n += f.read().count(tag)
+        return n
+
+    assert count("noqa[DAS504]") == 5
+    assert count("noqa[DAS502]") == 1
+
+
+# -- baseline -----------------------------------------------------------------
+
+def _mini_surface():
+    from dasmtl.analysis.surface import faults
+
+    return json.loads(json.dumps(faults.SURFACE_FIXTURE))
+
+
+def test_baseline_round_trip(tmp_path):
+    from dasmtl.analysis.surface.baseline import (check_surface,
+                                                  load_baseline,
+                                                  update_baseline)
+
+    path = str(tmp_path / "surface_baseline.json")
+    surface = _mini_surface()
+    doc = update_baseline(surface, path)
+    assert doc["surface"] == surface
+    assert check_surface(surface, load_baseline(path), path) == []
+
+
+def test_baseline_missing_is_srf601(tmp_path):
+    from dasmtl.analysis.surface.baseline import check_surface
+
+    out = check_surface(_mini_surface(), None,
+                        str(tmp_path / "nope.json"))
+    assert [f["id"] for f in out] == ["SRF601"]
+
+
+def test_baseline_removal_vs_addition(tmp_path):
+    """The asymmetry that IS the design: removals and additions both
+    fail --check-baseline, with distinct codes so CI output says which
+    review is owed."""
+    from dasmtl.analysis.surface.baseline import (check_surface,
+                                                  load_baseline,
+                                                  update_baseline)
+
+    path = str(tmp_path / "surface_baseline.json")
+    update_baseline(_mini_surface(), path)
+    pinned = load_baseline(path)
+
+    removed = _mini_surface()
+    removed["endpoints"]["serve"]["GET /healthz"]["keys"].remove("ready")
+    ids = [f["id"] for f in check_surface(removed, pinned, path)]
+    assert ids == ["SRF602"]
+
+    added = _mini_surface()
+    added["endpoints"]["serve"]["GET /healthz"]["statuses"].append(418)
+    ids = [f["id"] for f in check_surface(added, pinned, path)]
+    assert ids == ["SRF603"]
+
+    flipped = _mini_surface()
+    flipped["endpoints"]["serve"]["GET /metrics"]["raw_body"] = False
+    ids = [f["id"] for f in check_surface(flipped, pinned, path)]
+    assert ids == ["SRF602"]
+
+
+def test_baseline_comment_survives_update(tmp_path):
+    from dasmtl.analysis.surface.baseline import (load_baseline,
+                                                  update_baseline)
+
+    path = str(tmp_path / "surface_baseline.json")
+    update_baseline(_mini_surface(), path)
+    doc = load_baseline(path)
+    doc["comment"] = "hand-edited: reviewed 2026-08-06"
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    update_baseline(_mini_surface(), path)
+    assert (load_baseline(path)["comment"]
+            == "hand-edited: reviewed 2026-08-06")
+
+
+def test_committed_baseline_matches_tree():
+    """The committed artifacts/surface_baseline.json gates THIS tree
+    cleanly — the CI invariant, asserted locally."""
+    from dasmtl.analysis.surface.baseline import (check_surface,
+                                                  load_baseline)
+    from dasmtl.analysis.surface.extract import extract_surface
+
+    path = os.path.join(ROOT, "artifacts", "surface_baseline.json")
+    baseline = load_baseline(path)
+    assert baseline is not None, "surface baseline not committed"
+    assert check_surface(extract_surface(ROOT), baseline, path) == []
+
+
+# -- probe validators ---------------------------------------------------------
+
+def test_validate_response_contract():
+    from dasmtl.analysis.surface.probe import validate_response
+
+    ok = validate_response("serve", "GET /healthz", 200,
+                           b'{"status": "serving", "ready": true}')
+    assert ok == []
+    bad = validate_response("serve", "GET /healthz", 418,
+                            b'{"status": "serving"}')
+    assert {f["id"] for f in bad} == {"SRF605"}
+    assert len(bad) == 2  # undeclared status AND missing required key
+    extra = validate_response("serve", "GET /healthz", 200,
+                              b'{"status": "s", "ready": true, "z": 1}')
+    assert [f["id"] for f in extra] == ["SRF605"]
+    raw = validate_response("serve", "GET /metrics", 200, b"not json")
+    assert raw == []  # raw_body endpoints skip JSON validation
+
+
+def test_check_endpoint_dead_port_is_srf604():
+    from dasmtl.analysis.surface import faults
+    from dasmtl.analysis.surface.probe import check_endpoint
+
+    with faults.inject("srf604_dead_port"):
+        with faults.dummy_frontend() as base:
+            out = check_endpoint(base, "router", "GET /healthz",
+                                 timeout=5.0)
+    assert [f["id"] for f in out] == ["SRF604"]
+
+
+def test_check_endpoint_live_ephemeral_port():
+    """A real HTTP round trip against an ephemeral-port front end that
+    answers the router /healthz contract — transport, parse, and
+    validation all green."""
+    from dasmtl.analysis.surface import faults
+    from dasmtl.analysis.surface.probe import check_endpoint
+
+    with faults.dummy_frontend() as base:
+        assert check_endpoint(base, "router", "GET /healthz",
+                              timeout=5.0) == []
+
+
+def test_check_exposition():
+    from dasmtl.analysis.surface.probe import check_exposition
+
+    req = ("dasmtl_x_total", "dasmtl_y_total")
+    text = "# TYPE dasmtl_x_total counter\ndasmtl_x_total 0\n"
+    out = check_exposition("serve", text, req)
+    assert [f["id"] for f in out] == ["SRF606"]
+    assert "dasmtl_y_total" in out[0]["message"]
+    assert check_exposition("serve", text + "dasmtl_y_total 1\n",
+                            req) == []
+
+
+@pytest.mark.slow
+def test_live_serve_probe():
+    """The real thing: boot a fresh-init serve replica on an ephemeral
+    port and hold every live reply to the declared contract."""
+    from dasmtl.analysis.surface.probe import probe_serve
+    from dasmtl.analysis.surface.runner import _pin_backend
+
+    _pin_backend()
+    findings, measured = probe_serve(verbose=False)
+    assert findings == []
+    assert measured["serve"]["endpoints_checked"] >= 12
+
+
+# -- self-test ----------------------------------------------------------------
+
+def test_fault_inject_restores_overrides():
+    from dasmtl.analysis.rules import surface as rules_surface
+    from dasmtl.analysis.surface import faults
+
+    with faults.inject("das502_dead_doc"):
+        assert rules_surface._CATALOG_TEXT_OVERRIDE is not None
+        assert faults.active("das502_dead_doc")
+    assert rules_surface._CATALOG_TEXT_OVERRIDE is None
+    assert not faults.active("das502_dead_doc")
+    with pytest.raises(ValueError):
+        with faults.inject("not_a_fault"):
+            pass
+
+
+def test_self_test_green():
+    """Every planted fault caught, every clean variant silent — the
+    suite proves itself end to end."""
+    from dasmtl.analysis.surface.runner import self_test
+
+    assert self_test(verbose=False) == []
