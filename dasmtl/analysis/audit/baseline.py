@@ -18,7 +18,9 @@ Tolerance semantics (all relative, ``abs(new - old) / max(old, 1)``):
   executable's aliasing, not the model).
 
 ``--update-baseline`` rewrites the measured values while preserving any
-hand-edited tolerances.  Budgets move legitimately (a model change, a jax
+hand-edited tolerances (and, via the shared
+:class:`~dasmtl.analysis.core.baseline.BaselineStore`, a hand-edited
+comment).  Budgets move legitimately (a model change, a jax
 upgrade) — the workflow is: justify the delta in the PR, re-run with
 ``--update-baseline``, commit the diff.  Rule ids here continue the
 ``checks`` numbering: AUD105 budget regression, AUD106 collective drift,
@@ -27,11 +29,11 @@ AUD107 missing baseline entry.
 
 from __future__ import annotations
 
-import json
 import os
 from typing import Dict, Iterable, List, Optional
 
 from dasmtl.analysis.audit.checks import AuditFinding, TargetReport
+from dasmtl.analysis.core.baseline import BaselineStore, merge_update
 
 DEFAULT_BASELINE_PATH = os.path.join("artifacts", "audit_baseline.json")
 
@@ -53,38 +55,38 @@ DEFAULT_TOLERANCES: Dict[str, float] = {
 }
 
 
+_COMMENT = ("Compile-time budgets for dasmtl-audit --check-baseline;"
+            " see docs/STATIC_ANALYSIS.md for the update workflow.")
+
+
+def store(path: str = DEFAULT_BASELINE_PATH) -> BaselineStore:
+    # The audit stamp is jax/jaxlib only (no python key) and is always
+    # supplied by the runner from the live jax modules — stamp_python
+    # stays off so doctor's staleness verdict matches the committed
+    # file's historical shape.
+    return BaselineStore(path, payload_key="targets",
+                         default_comment=_COMMENT, merge=merge_update,
+                         stamp_python=False)
+
+
 def load_baseline(path: str) -> Optional[dict]:
-    if not os.path.exists(path):
-        return None
-    with open(path, encoding="utf-8") as f:
-        return json.load(f)
+    return store(path).load()
 
 
 def update_baseline(reports: Iterable[TargetReport], path: str,
                     generated_with: Optional[dict] = None) -> dict:
     """Merge measured values into the baseline at ``path``: audited targets
     are overwritten, targets not in this run are kept, hand-edited
-    tolerances survive."""
-    existing = load_baseline(path) or {}
+    tolerances (and a hand-edited comment) survive."""
+    st = store(path)
+    existing = st.load() or {}
     tolerances = dict(DEFAULT_TOLERANCES)
     tolerances.update(existing.get("tolerances", {}))
-    targets = dict(existing.get("targets", {}))
-    for report in reports:
-        targets[report.name] = report.to_baseline_entry()
-    data = {
-        "version": 1,
-        "comment": ("Compile-time budgets for dasmtl-audit --check-baseline;"
-                    " see docs/STATIC_ANALYSIS.md for the update workflow."),
-        "generated_with": generated_with
-        or existing.get("generated_with", {}),
-        "tolerances": tolerances,
-        "targets": {k: targets[k] for k in sorted(targets)},
-    }
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(data, f, indent=2, sort_keys=True)
-        f.write("\n")
-    return data
+    return st.update(
+        {r.name: r.to_baseline_entry() for r in reports},
+        extra={"tolerances": tolerances},
+        generated_with=generated_with
+        or existing.get("generated_with", {}))
 
 
 def check_reports(reports: Iterable[TargetReport],

@@ -10,13 +10,19 @@ committed full graph.
 
 Workflow (mirrors ``dasmtl-audit``): after an intentional locking
 change run ``dasmtl-conc --update-baseline``, review the diff, commit.
+
+The file handling rides the shared
+:class:`~dasmtl.analysis.core.baseline.BaselineStore` (edges merge by
+set-union across updates; a hand-edited comment survives).
 """
 
 from __future__ import annotations
 
-import json
 import os
 from typing import List, Optional
+
+from dasmtl.analysis.core.baseline import (BaselineStore, generated_with,
+                                           merge_union_pairs)
 
 DEFAULT_BASELINE_PATH = os.path.join("artifacts",
                                      "lockorder_baseline.json")
@@ -29,22 +35,18 @@ _COMMENT = ("Observed lock-acquisition-order edges for the serve + "
             "(docs/STATIC_ANALYSIS.md 'Concurrency analysis').")
 
 
+def store(path: str = DEFAULT_BASELINE_PATH) -> BaselineStore:
+    return BaselineStore(path, payload_key="edges",
+                         default_comment=_COMMENT,
+                         merge=merge_union_pairs)
+
+
 def _generated_with() -> dict:
-    import platform
-
-    from dasmtl.analysis.audit.runner import (
-        _generated_with as _deps_versions)
-
-    out = _deps_versions()
-    out["python"] = platform.python_version()
-    return out
+    return generated_with()
 
 
 def load_baseline(path: str = DEFAULT_BASELINE_PATH) -> Optional[dict]:
-    if not os.path.exists(path):
-        return None
-    with open(path, encoding="utf-8") as f:
-        return json.load(f)
+    return store(path).load()
 
 
 def update_baseline(edges: List[List[str]],
@@ -52,25 +54,7 @@ def update_baseline(edges: List[List[str]],
     """Write/refresh the baseline.  Edges accumulate across updates
     (a ci-preset run must not silently drop the full graph's edges);
     a hand-edited comment survives."""
-    prev = load_baseline(path)
-    merged = {tuple(e) for e in edges}
-    comment = _COMMENT
-    if prev is not None:
-        merged |= {tuple(e) for e in prev.get("edges", [])}
-        comment = prev.get("comment", _COMMENT)
-    doc = {
-        "version": 1,
-        "comment": comment,
-        "generated_with": _generated_with(),
-        "edges": sorted(list(e) for e in merged),
-    }
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(doc, f, indent=2, sort_keys=True)
-        f.write("\n")
-    return doc
+    return store(path).update(sorted(list(e) for e in edges))
 
 
 def check_edges(edges: List[List[str]],

@@ -147,103 +147,102 @@ def runtime_findings(snap: dict) -> List[dict]:
 
 def self_test(verbose: bool = True) -> List[dict]:
     """Prove each half catches its fault.  Returns findings for every
-    fault that went UNCAUGHT (empty = the suite works)."""
+    fault that went UNCAUGHT (empty = the suite works).  The
+    fault/clean loop is the shared
+    :class:`~dasmtl.analysis.core.harness.FaultHarness`; the lockdep
+    legs that predate :mod:`faults`'s registry (long hold, watchdog)
+    arm through a local injector instead."""
+    import contextlib
+
     from dasmtl.analysis.conc import faults
+    from dasmtl.analysis.core.harness import FaultHarness
     from dasmtl.analysis.lint import lint_source
 
-    findings: List[dict] = []
+    harness = FaultHarness("conc", inject=faults.inject,
+                           verbose=verbose)
 
-    def note(msg: str) -> None:
-        if verbose:
-            print(f"[self-test] {msg}")
+    armed: Dict[str, Optional[str]] = {"fault": None}
 
-    def miss(id_: str, msg: str) -> None:
-        findings.append({"id": id_, "severity": "error",
-                         "message": msg})
+    @contextlib.contextmanager
+    def arm(fault: str):
+        armed["fault"] = fault
+        try:
+            yield
+        finally:
+            armed["fault"] = None
 
-    # 1. Lockdep: the injected ABBA order must close a cycle.
-    lockdep.enable(reset=True)
-    with faults.inject("abba"):
+    # 1+2. Lockdep: the injected ABBA order must close a cycle; the
+    # clean order must not, and must still RECORD edges (a silent
+    # tracker is its own failure — the clean_check).
+    last_clean_edges: List[list] = []
+
+    def lockdep_run() -> List[str]:
+        lockdep.enable(reset=True)
         faults.run_lock_exercise()
-    cycles = lockdep.snapshot()["cycles"]
-    if cycles:
-        note(f"CONC401 caught injected ABBA: "
-             f"{' -> '.join(cycles[0]['cycle'])}")
-    else:
-        miss("CONC401", "injected ABBA lock order was NOT caught — no "
-                        "cycle in the acquisition-order graph")
+        snap = lockdep.snapshot()
+        if not snap["cycles"]:
+            last_clean_edges[:] = snap["edges"]
+        return ["CONC401"] if snap["cycles"] else []
 
-    # 2. ... and the clean order must not (false-positive guard).
-    lockdep.enable(reset=True)
-    faults.run_lock_exercise()
-    snap = lockdep.snapshot()
-    if snap["cycles"]:
-        miss("CONC401", f"clean A -> B exercise produced a spurious "
-                        f"cycle: {snap['cycles']}")
-    elif not snap["edges"]:
-        miss("CONC401", "clean exercise recorded no edges — the "
-                        "tracked wrappers are not reporting")
-    else:
-        note("clean lock exercise: edges recorded, no cycle")
+    harness.leg(
+        "abba", "CONC401", lockdep_run,
+        clean_check=lambda _ids: (None if last_clean_edges else
+                                  "clean exercise recorded no edges — "
+                                  "the tracked wrappers are not "
+                                  "reporting"))
 
-    # 3. DAS301: the unguarded-mutation snippet must lint dirty ...
-    with faults.inject("unguarded_mutation"):
-        dirty = faults.mutation_snippet()
-    hits = [f for f in lint_source(dirty, "<conc-self-test>")
-            if f.rule == "DAS301"]
-    if hits:
-        note(f"DAS301 caught injected unguarded mutation: "
-             f"{hits[0].message.splitlines()[0]}")
-    else:
-        miss("DAS301", "injected unguarded shared-attribute mutation "
-                       "was NOT caught by the static rules")
+    # 3+4. DAS301: the unguarded-mutation snippet must lint dirty; the
+    # guarded version must pass EVERY concurrency rule (clean_check
+    # widens the over-fire guard to all of DAS3xx).
+    def das301_run() -> List[str]:
+        return [f.rule
+                for f in lint_source(faults.mutation_snippet(),
+                                     "<conc-self-test>")
+                if f.rule.startswith("DAS3")]
 
-    # 4. ... and the guarded version must lint clean.
-    hits = [f for f in lint_source(faults.mutation_snippet(),
-                                   "<conc-self-test>")
-            if f.rule.startswith("DAS3")]
-    if hits:
-        miss("DAS301", f"guarded snippet tripped the concurrency "
-                       f"rules: {[f.render() for f in hits]}")
-    else:
-        note("guarded snippet lints clean")
+    harness.leg(
+        "unguarded_mutation", "DAS301", das301_run,
+        clean_check=lambda ids: (f"guarded snippet tripped the "
+                                 f"concurrency rules: {ids}"
+                                 if ids else None))
 
-    # 5. Long holds: a deliberate slow critical section must be flagged.
-    lockdep.enable(hold_warn_ms=1.0, reset=True)
-    slow = lockdep.lock("conc_selftest.slow")
-    with slow:
-        # Deliberate fault: sleeping under the lock IS the injected
-        # long hold this leg must catch.
-        time.sleep(0.01)  # dasmtl: noqa[DAS303]
-    holds = lockdep.snapshot()["long_holds"]
-    if holds:
-        note(f"CONC402 caught deliberate long hold: "
-             f"{holds[0]['held_ms']}ms over {holds[0]['warn_ms']}ms")
-    else:
-        miss("CONC402", "a 10ms hold over a 1ms threshold was NOT "
-                        "recorded")
+    # 5. Long holds: a deliberate slow critical section must be
+    # flagged; the same section without the sleep must not.
+    def hold_run() -> List[str]:
+        lockdep.enable(hold_warn_ms=1.0, reset=True)
+        slow = lockdep.lock("conc_selftest.slow")
+        with slow:
+            if armed["fault"] == "long_hold":
+                # Deliberate fault: sleeping under the lock IS the
+                # injected long hold this leg must catch.
+                time.sleep(0.01)  # dasmtl: noqa[DAS303]
+        return (["CONC402"] if lockdep.snapshot()["long_holds"]
+                else [])
+
+    harness.leg("long_hold", "CONC402", hold_run, inject=arm)
 
     # 6. Watchdog: a live straggler must raise; a joined set must not.
-    lockdep.enable(reset=True)
-    release = threading.Event()
-    straggler = threading.Thread(target=release.wait, daemon=True,
-                                 name="conc-selftest-straggler")
-    straggler.start()
-    try:
-        lockdep.assert_joined([straggler], "self-test drain")
-        miss("CONC405", "a thread that outlived its drain was NOT "
-                        "caught by assert_joined")
-    except lockdep.UnjoinedThreadError as exc:
-        note(f"CONC405 caught unjoined thread: "
-             f"{str(exc).splitlines()[0]}")
-    finally:
-        release.set()
-        straggler.join()
-    try:
-        lockdep.assert_joined([straggler], "self-test drain (joined)")
-        note("joined thread passes the watchdog")
-    except lockdep.UnjoinedThreadError:
-        miss("CONC405", "assert_joined raised on a fully joined thread")
+    def watchdog_run() -> List[str]:
+        lockdep.enable(reset=True)
+        release = threading.Event()
+        straggler = threading.Thread(target=release.wait, daemon=True,
+                                     name="conc-selftest-straggler")
+        straggler.start()
+        if armed["fault"] != "unjoined_thread":
+            release.set()
+            straggler.join()
+        try:
+            lockdep.assert_joined([straggler], "self-test drain")
+            return []
+        except lockdep.UnjoinedThreadError:
+            return ["CONC405"]
+        finally:
+            release.set()
+            straggler.join()
+
+    harness.leg("unjoined_thread", "CONC405", watchdog_run, inject=arm)
+
+    findings = harness.run()
 
     # Leave the tracker the way the process-level switches say.
     if lockdep._env_on():

@@ -1,0 +1,29 @@
+"""Shared engine for the dasmtl analysis families.
+
+Every family (lint / audit / sanitize / conc / mem / surface /
+failpath) used to hand-roll the same three mechanisms; this package is
+their single implementation:
+
+- :mod:`dasmtl.analysis.core.baseline` — :class:`BaselineStore`:
+  load / check / update / merge of a committed ``artifacts/*.json``
+  baseline with the shared ``{version, comment, generated_with,
+  <payload>}`` envelope, hand-edited-comment survival, and
+  ok / stale / missing / unreadable status verdicts.
+- :mod:`dasmtl.analysis.core.harness` — :class:`FaultHarness`: the
+  ``--self-test`` contract (every injected fault must be caught; its
+  paired clean variant must stay silent).
+- :mod:`dasmtl.analysis.core.findings` — the normalized finding model
+  with SARIF 2.1.0 and GitHub-annotation output.
+- :mod:`dasmtl.analysis.core.engine` — the ``dasmtl check``
+  orchestrator: run families by preset, merge findings, exit once.
+
+Importing this package must stay jax-free: the orchestrator decides
+per family whether a subprocess (which pins its own backend) is
+needed.
+"""
+
+from dasmtl.analysis.core.baseline import (BaselineStore,  # noqa: F401
+                                           deps_versions, generated_with)
+from dasmtl.analysis.core.findings import (normalize_finding,  # noqa: F401
+                                           render_github, sarif_document)
+from dasmtl.analysis.core.harness import FaultHarness  # noqa: F401

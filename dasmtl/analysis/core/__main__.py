@@ -1,0 +1,8 @@
+"""``python -m dasmtl.analysis.core`` — the ``dasmtl check`` engine."""
+
+import sys
+
+from dasmtl.analysis.core.engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

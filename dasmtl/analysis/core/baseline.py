@@ -1,0 +1,163 @@
+"""The one baseline workflow every analysis family shares.
+
+A committed baseline is a JSON document with the envelope
+
+    {"version": 1, "comment": <reviewed prose>,
+     "generated_with": {"jax": ..., "jaxlib": ..., ["python": ...]},
+     <payload_key>: <family payload>, [<extra keys>...]}
+
+and four behaviors the six families used to reimplement separately:
+
+- **load**: ``None`` for a missing file (the caller's missing-baseline
+  finding), raising for an unreadable one (doctor's ``unreadable``).
+- **update**: merge the new payload into the previous one (the family
+  picks the merge: edges union, tiers/targets dict-update, wholesale
+  replace), stamp ``generated_with``, write sorted 2-indented JSON
+  with a trailing newline.
+- **comment survival**: a hand-edited ``comment`` in the committed
+  file survives every ``--update-baseline`` — the reviewed prose is
+  part of the baseline, not tool output.
+- **status**: ok / stale / missing / unreadable, where ``stale``
+  means the recording environment (``generated_with``) drifted from
+  this host — the payload still gates, but a refresh needs a
+  justified version bump.
+
+Nothing here imports jax; ``generated_with`` reads package metadata
+only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Callable, Optional
+
+
+def deps_versions() -> dict:
+    """jax/jaxlib versions from package metadata (no jax import)."""
+    import importlib.metadata
+
+    out = {}
+    for dist in ("jax", "jaxlib"):
+        try:
+            out[dist] = importlib.metadata.version(dist)
+        except importlib.metadata.PackageNotFoundError:
+            out[dist] = "?"
+    return out
+
+
+def generated_with() -> dict:
+    """The full recording-environment stamp: deps + python version."""
+    import platform
+
+    out = deps_versions()
+    out["python"] = platform.python_version()
+    return out
+
+
+#: payload merge strategies: (previous payload or None, new) -> merged.
+MergeFn = Callable[[Optional[object], object], object]
+
+
+def merge_replace(_prev, new):
+    """Wholesale replace — for always-complete payloads (surface)."""
+    return new
+
+
+def merge_update(prev, new):
+    """Dict-update — measured entries overwrite, unexercised survive
+    (audit targets, sanitize cells, mem tiers)."""
+    merged = dict(prev or {})
+    merged.update(new)
+    return {k: merged[k] for k in sorted(merged)}
+
+
+def merge_union_pairs(prev, new):
+    """Set-union of [a, b] pairs — observations accumulate (conc
+    edges: a ci-preset run must not drop the full graph's edges)."""
+    merged = {tuple(e) for e in new} | {tuple(e) for e in (prev or [])}
+    return sorted(list(e) for e in merged)
+
+
+@dataclasses.dataclass
+class BaselineStatus:
+    """Doctor-facing verdict on one committed baseline."""
+    path: str
+    state: str  # ok | stale | missing | unreadable
+    doc: Optional[dict] = None
+    detail: str = ""
+
+
+class BaselineStore:
+    """Load/check/update one committed baseline file.
+
+    ``payload_key`` names the family payload inside the envelope
+    (``edges`` / ``tiers`` / ``targets`` / ``surface``); ``merge``
+    folds the previous payload into an update; ``stamp_python``
+    matches the family's historical ``generated_with`` shape (the
+    audit/sanitize baselines predate the python stamp and their
+    committed files must keep reading unchanged).
+    """
+
+    def __init__(self, path: str, *, payload_key: str,
+                 default_comment: str, merge: MergeFn = merge_replace,
+                 stamp_python: bool = True):
+        self.path = path
+        self.payload_key = payload_key
+        self.default_comment = default_comment
+        self.merge = merge
+        self.stamp_python = stamp_python
+
+    def current_stamp(self) -> dict:
+        return generated_with() if self.stamp_python else deps_versions()
+
+    def load(self) -> Optional[dict]:
+        if not os.path.exists(self.path):
+            return None
+        with open(self.path, encoding="utf-8") as f:
+            return json.load(f)
+
+    def update(self, payload, *, extra: Optional[dict] = None,
+               generated_with: Optional[dict] = None) -> dict:
+        """Merge ``payload`` over the committed one and rewrite the
+        file.  A hand-edited comment survives; ``extra`` carries
+        family keys outside the payload (audit/sanitize tolerances)."""
+        prev = self.load()
+        merged = self.merge(
+            (prev or {}).get(self.payload_key), payload)
+        doc = {
+            "version": 1,
+            "comment": (prev or {}).get("comment", self.default_comment),
+            "generated_with": generated_with or self.current_stamp(),
+            self.payload_key: merged,
+        }
+        if extra:
+            doc.update(extra)
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                    exist_ok=True)
+        with open(self.path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        return doc
+
+    def status(self) -> BaselineStatus:
+        """ok / stale / missing / unreadable for this host."""
+        try:
+            doc = self.load()
+        except (OSError, ValueError) as exc:
+            return BaselineStatus(self.path, "unreadable", None, str(exc))
+        if doc is None:
+            return BaselineStatus(self.path, "missing")
+        gen = doc.get("generated_with", {})
+        current = self.current_stamp()
+        # Compare only the keys the file recorded: a baseline written
+        # before the python stamp existed is not stale for lacking it.
+        drifted = sorted(k for k, v in gen.items()
+                         if k in current and current[k] != v)
+        if drifted:
+            return BaselineStatus(
+                self.path, "stale", doc,
+                "recorded under " + ", ".join(
+                    f"{k} {gen[k]}" for k in drifted))
+        return BaselineStatus(self.path, "ok", doc)

@@ -1,0 +1,397 @@
+"""``dasmtl check`` — one orchestrator over the seven analysis families.
+
+The repo grew six analysis families (lint, audit, sanitize, conc, mem,
+surface), each with its own CLI, preset ladder, baseline gate and
+fault-injection self-test, plus the seventh — ``failpath`` (DAS601-605,
+the failure-path rules for the long-running fleet tiers).  Running six
+CLIs with six flag sets is operator overhead; this engine runs them all
+behind ONE entry point, merges their findings into one report (text,
+GitHub annotations, or SARIF 2.1.0), and exits nonzero iff any family
+fails by its own convention.
+
+Design constraints the engine honors:
+
+- **Backend isolation.**  The jax-heavy families (audit, sanitize,
+  conc, mem, surface) each pin a CPU backend before jax initializes —
+  a per-process, import-order-sensitive act.  The engine therefore
+  drives them as subprocesses (``python -m dasmtl.analysis.<family>
+  ... --format json``), exactly the committed CLIs with exactly their
+  flags, and parses the JSON they already emit.  Nothing jax-heavy is
+  imported into the engine's process, so ``dasmtl check`` itself never
+  touches an accelerator.
+- **Family sovereignty.**  Exit-code semantics stay per-family (lint
+  fails on ANY finding; conc/mem/surface fail on error-severity only;
+  audit/sanitize fail on budget/fingerprint drift).  The engine
+  reports which families failed, it does not reinterpret them.
+- **Incrementality.**  ``--changed-since REF`` maps changed paths to
+  affected families via :func:`affected_families` — a pure function so
+  tests can pin the mapping without a git repo.
+
+``--self-test`` runs the failpath fault legs (planted DAS601-605
+snippets with paired clean variants) through the shared
+:class:`~dasmtl.analysis.core.harness.FaultHarness` — the engine's own
+checker is checked the same way the family checkers are.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from dasmtl.analysis.core.findings import (normalize_findings,
+                                           render_github, render_text,
+                                           summarize, write_sarif)
+
+PRESETS = ("quick", "ci", "full")
+
+#: The failure-path rule ids — the seventh family's static surface.
+FAILPATH_RULES = ("DAS601", "DAS602", "DAS603", "DAS604", "DAS605")
+
+#: Paths whose findings DAS601-605 govern (mirrors rules/failpath.py).
+FAILPATH_PATHS = ("dasmtl/serve/", "dasmtl/stream/", "dasmtl/obs/")
+
+#: Family -> (description, jax-heavy?).  Order is execution order:
+#: cheap static families first, compile-heavy gates last, so a lint
+#: finding surfaces before minutes of audit compiles.
+FAMILIES: Dict[str, Tuple[str, bool]] = {
+    "lint": ("tracing-discipline linter (DAS1xx-5xx + unused-noqa)",
+             False),
+    "failpath": ("failure-path rules for the fleet tiers (DAS601-605)",
+                 False),
+    "surface": ("wire-surface contract gate + self-test (SRF6xx)",
+                True),
+    "conc": ("lockdep exercises + lock-order baseline (CONC4xx)",
+             True),
+    "mem": ("leasedep exercises + memory budgets (MEM5xx)", True),
+    "audit": ("compile-time budgets vs committed baseline (AUD1xx)",
+              True),
+    "sanitize": ("runtime SPMD determinism fingerprints (SAN2xx)",
+                 True),
+}
+
+#: Subprocess steps per jax-heavy family.  ``{preset}`` is substituted;
+#: each step is the committed family CLI with its committed flags.
+_SUBPROCESS_STEPS: Dict[str, List[Tuple[str, List[str]]]] = {
+    "surface": [
+        ("self-test", ["dasmtl.analysis.surface", "--self-test",
+                       "--format", "json"]),
+        ("check-baseline", ["dasmtl.analysis.surface",
+                            "--check-baseline", "--preset", "{preset}",
+                            "--format", "json"]),
+    ],
+    "conc": [
+        ("self-test", ["dasmtl.analysis.conc", "--self-test",
+                       "--format", "json"]),
+        ("check-baseline", ["dasmtl.analysis.conc", "--check-baseline",
+                            "--preset", "{preset}",
+                            "--format", "json"]),
+    ],
+    "mem": [
+        ("self-test", ["dasmtl.analysis.mem", "--self-test",
+                       "--format", "json"]),
+        ("check-baseline", ["dasmtl.analysis.mem", "--check-baseline",
+                            "--preset", "{preset}",
+                            "--format", "json"]),
+    ],
+    "audit": [
+        ("check-baseline", ["dasmtl.analysis.audit", "--check-baseline",
+                            "--preset", "{preset}",
+                            "--format", "json"]),
+    ],
+    "sanitize": [
+        ("check-baseline", ["dasmtl.analysis.sanitize",
+                            "--check-baseline", "--preset", "{preset}",
+                            "--format", "json"]),
+    ],
+}
+
+
+# -- incremental mode ---------------------------------------------------------
+
+#: Path prefixes that affect each jax-heavy family beyond its own
+#: analysis package.  The static families are handled structurally:
+#: lint covers every ``dasmtl/`` python file, failpath its fleet dirs.
+_FAMILY_TRIGGERS: Dict[str, Tuple[str, ...]] = {
+    "surface": ("dasmtl/serve/", "dasmtl/stream/", "dasmtl/obs/",
+                "dasmtl/analysis/surface/", "docs/OPERATIONS.md",
+                "artifacts/surface_baseline.json"),
+    "conc": ("dasmtl/serve/", "dasmtl/stream/",
+             "dasmtl/analysis/conc/",
+             "artifacts/lockorder_baseline.json"),
+    "mem": ("dasmtl/serve/", "dasmtl/stream/", "dasmtl/train/",
+            "dasmtl/data/", "dasmtl/analysis/mem/",
+            "artifacts/membudget_baseline.json"),
+    "audit": ("dasmtl/models/", "dasmtl/ops/", "dasmtl/parallel/",
+              "dasmtl/train/", "dasmtl/config.py",
+              "dasmtl/analysis/audit/",
+              "artifacts/audit_baseline.json"),
+    "sanitize": ("dasmtl/models/", "dasmtl/ops/", "dasmtl/parallel/",
+                 "dasmtl/train/", "dasmtl/config.py",
+                 "dasmtl/analysis/sanitize/",
+                 "artifacts/determinism_baseline.json"),
+}
+
+#: A change here invalidates every family's premise: the shared engine,
+#: the rule registry, or the linter front end they all ride on.
+_GLOBAL_TRIGGERS = ("dasmtl/analysis/core/", "dasmtl/analysis/rules/",
+                    "dasmtl/analysis/lint.py",
+                    "dasmtl/analysis/__init__.py", "pyproject.toml")
+
+
+def affected_families(paths: Sequence[str]) -> List[str]:
+    """Changed paths -> family names to run, in execution order.
+
+    Pure (no git, no filesystem): callers resolve ``--changed-since``
+    to a path list first, tests pin the mapping directly.  Unknown
+    paths (docs, scripts, CI config) affect nothing; an analysis-core
+    change affects everything."""
+    picked = set()
+    for raw in paths:
+        p = raw.replace("\\", "/")
+        if any(p.startswith(t) or p == t.rstrip("/")
+               for t in _GLOBAL_TRIGGERS):
+            return list(FAMILIES)
+        if p.startswith("dasmtl/") and p.endswith(".py"):
+            picked.add("lint")
+            if any(p.startswith(d) for d in FAILPATH_PATHS) \
+                    or p == "dasmtl/utils/threads.py":
+                picked.add("failpath")
+        for family, triggers in _FAMILY_TRIGGERS.items():
+            if any(p.startswith(t) for t in triggers):
+                picked.add(family)
+    return [f for f in FAMILIES if f in picked]
+
+
+def changed_paths(ref: str) -> List[str]:
+    """``git diff --name-only REF`` against the working tree."""
+    proc = subprocess.run(
+        ["git", "diff", "--name-only", ref],
+        capture_output=True, text=True, timeout=60.0, check=True)
+    return [ln.strip() for ln in proc.stdout.splitlines() if ln.strip()]
+
+
+# -- family drivers -----------------------------------------------------------
+
+def _run_lint_family(select: Optional[Sequence[str]],
+                     report_unused_noqa: bool) -> Tuple[int, List[dict]]:
+    from dasmtl.analysis.lint import lint_paths
+
+    findings = lint_paths(["dasmtl"], select=select,
+                          report_unused_noqa=report_unused_noqa)
+    return (1 if findings else 0,
+            [dataclasses.asdict(f) for f in findings])
+
+
+def _parse_json_tail(stdout: str):
+    """The family CLIs print their JSON document as the last stdout
+    line (exercise chatter, when any, precedes it)."""
+    for line in reversed(stdout.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            return json.loads(line)
+        except ValueError:
+            return None
+    return None
+
+
+def _run_subprocess_family(family: str, preset: str,
+                           verbose: bool) -> Tuple[int, List[dict]]:
+    """Drive one jax-heavy family through its committed CLI.  The
+    family process pins its own CPU backend; the engine only reads
+    its JSON.  A step that exits nonzero without parseable findings
+    (crash, OOM, bad flag) becomes a synthetic error finding carrying
+    the tail of its output — a family can fail, it cannot vanish."""
+    rc_all = 0
+    findings: List[dict] = []
+    for step_name, argv_tpl in _SUBPROCESS_STEPS[family]:
+        argv = [sys.executable, "-m"] + [
+            a.replace("{preset}", preset) for a in argv_tpl]
+        if verbose:
+            print(f"[check:{family}] {step_name}: "
+                  + " ".join(argv[2:]), file=sys.stderr)
+        try:
+            proc = subprocess.run(argv, capture_output=True, text=True,
+                                  timeout=3600.0)
+        except subprocess.TimeoutExpired:
+            rc_all = 1
+            findings.append({"id": "CHECK001", "severity": "error",
+                             "message": f"{family} {step_name} timed "
+                                        f"out after 3600s"})
+            continue
+        rc_all = rc_all or (1 if proc.returncode else 0)
+        doc = _parse_json_tail(proc.stdout)
+        if isinstance(doc, dict) and isinstance(doc.get("findings"),
+                                                list):
+            findings.extend(doc["findings"])
+        elif proc.returncode:
+            tail = (proc.stderr or proc.stdout or "").strip()
+            tail = tail[-400:] if tail else "(no output)"
+            findings.append({"id": "CHECK002", "severity": "error",
+                             "message": f"{family} {step_name} exited "
+                                        f"{proc.returncode} without a "
+                                        f"findings document: {tail}"})
+    return rc_all, findings
+
+
+def run_family(family: str, preset: str,
+               verbose: bool = False) -> Tuple[int, List[dict]]:
+    """(exit-code, raw findings) for one family at one preset."""
+    if family == "lint":
+        # Everything EXCEPT the failpath ids (those are the failpath
+        # family's report) — DAS199 judgment stays restricted to the
+        # rules that ran, so no suppression is misjudged.
+        from dasmtl.analysis.rules import all_rules
+
+        select = [r.id for r in all_rules()
+                  if r.id not in FAILPATH_RULES]
+        return _run_lint_family(select, report_unused_noqa=True)
+    if family == "failpath":
+        return _run_lint_family(list(FAILPATH_RULES),
+                                report_unused_noqa=False)
+    return _run_subprocess_family(family, preset, verbose)
+
+
+# -- orchestrator -------------------------------------------------------------
+
+def run_check(families: Sequence[str], preset: str,
+              verbose: bool = False) -> Tuple[Dict[str, int],
+                                              List[dict]]:
+    """Run families in registry order; returns ({family: exit-code},
+    merged normalized findings)."""
+    codes: Dict[str, int] = {}
+    merged: List[dict] = []
+    seen = set()
+    for family in families:
+        rc, raw = run_family(family, preset, verbose=verbose)
+        codes[family] = rc
+        for f in normalize_findings(raw, family):
+            key = (f["id"], f.get("path"), f.get("line"),
+                   f.get("col"), f["message"])
+            if key in seen:
+                continue
+            seen.add(key)
+            merged.append(f)
+        if verbose:
+            status = "ok" if rc == 0 else f"FAILED (exit {rc})"
+            print(f"[check:{family}] {status}", file=sys.stderr)
+    return codes, merged
+
+
+def self_test(verbose: bool = True) -> List[dict]:
+    from dasmtl.analysis.core.selftest import run_self_test
+
+    return run_self_test(verbose=verbose)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dasmtl check",
+        description="unified analysis engine: run every family, merge "
+                    "findings, exit once (docs/STATIC_ANALYSIS.md)")
+    ap.add_argument("--preset", choices=PRESETS, default="ci",
+                    help="preset forwarded to every preset-aware "
+                         "family (default: ci)")
+    ap.add_argument("--only", type=str, default=None,
+                    help="comma-separated families to run "
+                         "(default: all seven)")
+    ap.add_argument("--changed-since", type=str, default=None,
+                    metavar="REF",
+                    help="run only the families affected by paths "
+                         "changed since REF (git diff --name-only)")
+    ap.add_argument("--sarif", type=str, default=None, metavar="PATH",
+                    help="additionally write the merged findings as "
+                         "SARIF 2.1.0")
+    ap.add_argument("--format", choices=("text", "github", "json"),
+                    default="text")
+    ap.add_argument("--self-test", action="store_true",
+                    help="fault injection for the engine's own family: "
+                         "plant DAS601-605 snippets (with paired clean "
+                         "variants) and verify each rule catches "
+                         "exactly its fault")
+    ap.add_argument("--list-families", action="store_true",
+                    help="print the family registry and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_families:
+        for name, (desc, heavy) in FAMILIES.items():
+            tier = "subprocess" if heavy else "in-process"
+            print(f"{name:<9} [{tier:<10}] {desc}")
+        return 0
+
+    if args.self_test:
+        findings = self_test(verbose=args.format == "text")
+        if args.format == "json":
+            print(json.dumps({"findings": findings}))
+        else:
+            for f in findings:
+                print(f"{f['id']} [{f['severity']}] {f['message']}")
+            print("self-test: "
+                  + ("all injected faults caught" if not findings
+                     else f"{len(findings)} fault(s) NOT caught"),
+                  file=sys.stderr)
+        return 1 if findings else 0
+
+    if args.only:
+        families = [f.strip() for f in args.only.split(",") if f.strip()]
+        unknown = [f for f in families if f not in FAMILIES]
+        if unknown:
+            ap.error(f"unknown famil{'y' if len(unknown) == 1 else 'ies'}"
+                     f" {', '.join(unknown)} (choose from "
+                     f"{', '.join(FAMILIES)})")
+        families = [f for f in FAMILIES if f in families]
+    else:
+        families = list(FAMILIES)
+
+    if args.changed_since:
+        try:
+            paths = changed_paths(args.changed_since)
+        except (subprocess.SubprocessError, OSError) as exc:
+            ap.error(f"--changed-since {args.changed_since}: {exc}")
+        affected = affected_families(paths)
+        families = [f for f in families if f in affected]
+        if args.format != "json":
+            print(f"[check] {len(paths)} changed path(s) since "
+                  f"{args.changed_since} -> "
+                  + (", ".join(families) if families
+                     else "no families affected"),
+                  file=sys.stderr)
+        if not families:
+            if args.format == "json":
+                print(json.dumps({"families": {}, "findings": []}))
+            return 0
+
+    verbose = args.format != "json"
+    codes, findings = run_check(families, args.preset, verbose=verbose)
+
+    if args.sarif:
+        write_sarif(findings, args.sarif)
+        if verbose:
+            print(f"[check] SARIF written: {args.sarif}",
+                  file=sys.stderr)
+
+    if args.format == "json":
+        print(json.dumps({"families": codes, "findings": findings}))
+    elif args.format == "github":
+        for f in findings:
+            print(render_github(f))
+    else:
+        for f in findings:
+            print(render_text(f))
+        failed = sorted(f for f, rc in codes.items() if rc)
+        print(f"check[{args.preset}]: {len(codes)} family(ies), "
+              f"{summarize(findings)}"
+              + (f"; FAILED: {', '.join(failed)}" if failed
+                 else "; all passed"),
+              file=sys.stderr)
+    return 1 if any(codes.values()) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

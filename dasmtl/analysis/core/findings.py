@@ -1,0 +1,154 @@
+"""Normalized finding model + SARIF 2.1.0 + GitHub-annotation output.
+
+The families speak three native dialects — the linter's ``Finding``
+dataclass (``rule``/``path``/``line``/``col``), the audit/sanitize
+``rule``/``target`` dataclasses, and the conc/mem/surface plain dicts
+(``id``/``severity``/``message``) — all carrying the same information:
+a stable rule id, an error-or-warning severity, prose, and sometimes a
+location.  :func:`normalize_finding` folds any of them into one dict
+
+    {"family", "id", "severity", "message", ["path", "line", "col"],
+     ["target"]}
+
+which :func:`sarif_document` serializes as SARIF 2.1.0 (one run, one
+result per finding, one reporting descriptor per distinct rule id) and
+:func:`render_github` as ``::error``/``::warning`` workflow commands
+so a CI run annotates the diff directly.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List, Optional
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def normalize_finding(found, family: str) -> dict:
+    """One finding (lint dataclass, audit/sanitize dataclass or its
+    asdict, or a conc/mem/surface dict) -> the normalized shape."""
+    if not isinstance(found, dict):
+        import dataclasses
+
+        found = dataclasses.asdict(found)
+    out = {
+        "family": family,
+        "id": found.get("id") or found.get("rule") or "UNKNOWN",
+        "severity": found.get("severity", "error"),
+        "message": found.get("message", ""),
+    }
+    if found.get("path"):
+        out["path"] = found["path"]
+        out["line"] = int(found.get("line", 1))
+        out["col"] = int(found.get("col", 0))
+    if found.get("target"):
+        out["target"] = found["target"]
+    return out
+
+
+def normalize_findings(found: Iterable, family: str) -> List[dict]:
+    return [normalize_finding(f, family) for f in found]
+
+
+def _sarif_level(severity: str) -> str:
+    return {"error": "error", "warning": "warning"}.get(severity, "note")
+
+
+def sarif_document(findings: Iterable[dict], *,
+                   tool_name: str = "dasmtl-check",
+                   tool_version: str = "1") -> dict:
+    """A single-run SARIF 2.1.0 log for normalized findings.  Findings
+    without a file location attach to their logical target instead —
+    an audit target or exercise name is a logicalLocation, not a
+    file."""
+    findings = list(findings)
+    rules: Dict[str, dict] = {}
+    results = []
+    for f in findings:
+        rid = f["id"]
+        if rid not in rules:
+            rules[rid] = {
+                "id": rid,
+                "shortDescription": {
+                    "text": f"{f.get('family', 'analysis')} rule {rid}"},
+                "defaultConfiguration": {
+                    "level": _sarif_level(f["severity"])},
+            }
+        result = {
+            "ruleId": rid,
+            "ruleIndex": list(rules.keys()).index(rid),
+            "level": _sarif_level(f["severity"]),
+            "message": {"text": f["message"] or rid},
+            "properties": {"family": f.get("family", "")},
+        }
+        location: dict = {}
+        if f.get("path"):
+            location["physicalLocation"] = {
+                "artifactLocation": {"uri": f["path"].replace("\\", "/"),
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(1, int(f.get("line", 1))),
+                           "startColumn": max(1, int(f.get("col", 0)) + 1)},
+            }
+        if f.get("target"):
+            location["logicalLocations"] = [{"name": f["target"],
+                                             "kind": "member"}]
+        if location:
+            result["locations"] = [location]
+        results.append(result)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": tool_name,
+                "version": str(tool_version),
+                "informationUri":
+                    "https://github.com/sunmin123456/MTL-DAS",
+                "rules": list(rules.values()),
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(findings: Iterable[dict], path: str, **kw) -> dict:
+    doc = sarif_document(findings, **kw)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return doc
+
+
+def render_github(f: dict) -> str:
+    """One finding as a GitHub Actions workflow command — the runner
+    turns these into inline PR annotations."""
+    kind = "error" if f["severity"] == "error" else "warning"
+    # Workflow commands eat newlines/percent unless URL-ish escaped.
+    msg = (f["message"].replace("%", "%25").replace("\r", "")
+           .replace("\n", "%0A"))
+    title = f"{f.get('family', 'analysis')}:{f['id']}"
+    if f.get("path"):
+        where = (f"file={f['path']},line={max(1, int(f.get('line', 1)))},"
+                 f"col={max(1, int(f.get('col', 0)) + 1)},")
+    else:
+        where = ""
+    return f"::{kind} {where}title={title}::{f['id']}: {msg}"
+
+
+def render_text(f: dict) -> str:
+    """The family CLIs' shared text shape, prefixed with the family."""
+    loc = f":{f['path']}:{f['line']}" if f.get("path") else (
+        f":{f['target']}" if f.get("target") else "")
+    return (f"[{f.get('family', '?')}{loc}] {f['id']} "
+            f"[{f['severity']}] {f['message']}")
+
+
+def summarize(findings: List[dict]) -> str:
+    n_err = sum(1 for f in findings if f["severity"] == "error")
+    n_warn = len(findings) - n_err
+    if not findings:
+        return "clean"
+    return f"{n_err} error(s), {n_warn} warning(s)"

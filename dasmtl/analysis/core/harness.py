@@ -1,0 +1,86 @@
+"""The shared ``--self-test`` contract: fault legs with clean pairs.
+
+Every analysis family proves itself the same way: inject a fault the
+suite exists to catch, run the relevant check, and demand the finding;
+then run the same check clean and demand silence (the over-fire
+guard).  :class:`FaultHarness` is that loop, lifted out of the six
+runners that each copied it.
+
+A *leg* is ``(fault, expect, run)``: ``run()`` returns the finding ids
+the check produced; the harness wraps it in ``inject(fault)`` for the
+dirty pass and runs it bare for the clean pass.  Families with richer
+clean-side requirements (conc: the clean lock exercise must still
+RECORD edges — a silent tracker is its own failure) attach a
+``clean_check`` returning an error message or ``None``.
+
+``run()`` returns the misses as the family's standard finding dicts —
+a fault that went uncaught, or a clean variant that tripped, fails the
+self-test run exactly as before.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, List, Optional, Sequence
+
+
+class FaultHarness:
+    """Registered fault legs + their paired clean variants."""
+
+    def __init__(self, family: str,
+                 inject: Optional[Callable] = None,
+                 verbose: bool = True):
+        self.family = family
+        #: ``inject(fault)`` context manager arming one named fault
+        #: (the family's ``faults.inject``); legs may override it.
+        self.inject = inject
+        self.verbose = verbose
+        self._legs: List[dict] = []
+
+    def note(self, msg: str) -> None:
+        if self.verbose:
+            print(f"[{self.family}-self-test] {msg}")
+
+    def leg(self, fault: str, expect: str,
+            run: Callable[[], Sequence[str]], *,
+            inject: Optional[Callable] = None,
+            clean_check: Optional[Callable[[Sequence[str]],
+                                           Optional[str]]] = None,
+            ) -> None:
+        """Register one fault leg.  ``run()`` -> finding ids; the
+        injected pass must contain ``expect``, the clean pass must not
+        (plus ``clean_check``, when given)."""
+        self._legs.append({"fault": fault, "expect": expect, "run": run,
+                           "inject": inject or self.inject,
+                           "clean_check": clean_check})
+
+    def run(self) -> List[dict]:
+        """Drive every leg; return findings for each MISSED fault or
+        over-firing clean variant (empty = the suite is proven)."""
+        findings: List[dict] = []
+
+        def miss(id_: str, msg: str) -> None:
+            findings.append({"id": id_, "severity": "error",
+                             "message": msg})
+
+        for leg in self._legs:
+            fault, expect, run = leg["fault"], leg["expect"], leg["run"]
+            injector = leg["inject"] or contextlib.nullcontext
+            with injector(fault):
+                dirty = list(run())
+            clean = list(run())
+            if expect in dirty:
+                self.note(f"{expect} caught injected {fault}")
+            else:
+                miss(expect, f"injected fault {fault!r} was NOT caught "
+                             f"({expect} stayed silent)")
+            if expect in clean:
+                miss(expect, f"clean variant of {fault!r} tripped "
+                             f"{expect} — the check over-fires")
+            else:
+                self.note(f"clean variant of {fault} stays silent")
+            if leg["clean_check"] is not None:
+                problem = leg["clean_check"](clean)
+                if problem:
+                    miss(expect, f"clean variant of {fault!r}: {problem}")
+        return findings

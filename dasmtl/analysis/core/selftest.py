@@ -1,0 +1,190 @@
+"""Fault injection for the failpath family (``dasmtl check --self-test``).
+
+Same contract as every other family's self-test, expressed through the
+shared :class:`~dasmtl.analysis.core.harness.FaultHarness`: each leg
+plants a snippet containing exactly one failure-path fault (an
+unbounded ``Event.wait``, a swallowed exception, a crash-silent thread
+target, an uncapped retry loop, a raising ``finally`` cleanup), runs
+the DAS601-605 rules over it, and demands the finding — then runs the
+paired *clean* variant (the fix the rule's message prescribes) and
+demands silence.  A rule that misses its fault or fires on its own
+prescribed fix fails the self-test.
+
+The snippets lint under a fleet-scoped path (the rules are scoped to
+``dasmtl/serve|stream|obs``) and each leg selects only the rule under
+test, so legs cannot mask each other.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import List, Optional, Sequence
+
+from dasmtl.analysis.core.harness import FaultHarness
+
+#: Scoped path the snippets lint under (never written to disk).
+_SNIPPET_PATH = "dasmtl/serve/_failpath_selftest.py"
+
+_ACTIVE: Optional[str] = None
+
+
+@contextlib.contextmanager
+def inject(fault: str):
+    """Arm one named fault: legs pick their dirty snippet while their
+    fault is active and the clean pair otherwise."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = fault
+    try:
+        yield
+    finally:
+        _ACTIVE = prev
+
+
+#: fault -> (rule, dirty snippet, clean snippet).  The clean variant is
+#: the fix the rule's finding message prescribes — the self-test proves
+#: the prescription actually silences the rule.
+FAULTS = {
+    "das601_unbounded_wait": ("DAS601", """
+import threading
+stop = threading.Event()
+
+def wait_for_drain():
+    stop.wait()
+""", """
+import threading
+stop = threading.Event()
+
+def wait_for_drain():
+    while not stop.wait(timeout=1.0):
+        pass
+"""),
+    "das601_naked_urlopen": ("DAS601", """
+import urllib.request
+
+def scrape(url):
+    return urllib.request.urlopen(url).read()
+""", """
+import urllib.request
+
+def scrape(url):
+    return urllib.request.urlopen(url, timeout=10.0).read()
+"""),
+    "das602_swallowed": ("DAS602", """
+def drain(sink):
+    try:
+        sink.flush()
+    except Exception:
+        pass
+""", """
+def drain(sink, errors):
+    try:
+        sink.flush()
+    except Exception as exc:
+        errors.append(f"flush failed: {exc}")
+"""),
+    "das603_silent_thread": ("DAS603", """
+import threading
+
+def pump(source):
+    while source.poll():
+        source.step()
+
+t = threading.Thread(target=pump, daemon=True)
+""", """
+import threading
+
+def pump(source):
+    try:
+        while source.poll():
+            source.step()
+    except Exception as exc:
+        # Recording by assignment: a CALL in the handler could itself
+        # raise and kill the thread, and the rule knows it.
+        source.crash = exc
+
+t = threading.Thread(target=pump, daemon=True)
+"""),
+    "das603_wrapped_clean_factory": ("DAS603", """
+import threading
+
+def pump(source):
+    source.step()
+
+t = threading.Thread(target=pump, daemon=True)
+""", """
+import threading
+from dasmtl.utils.threads import crash_logged
+
+def pump(source):
+    source.step()
+
+t = threading.Thread(target=crash_logged(pump, "pump"), daemon=True)
+"""),
+    "das604_unbounded_retry": ("DAS604", """
+def fetch(sock):
+    while True:
+        try:
+            return sock.recv(4096)
+        except Exception:
+            continue
+""", """
+def fetch(sock):
+    for _attempt in range(5):
+        try:
+            return sock.recv(4096)
+        except Exception:
+            continue
+    raise TimeoutError("fetch: 5 attempts failed")
+"""),
+    "das605_raising_finally": ("DAS605", """
+def close(self):
+    try:
+        self.drain()
+    finally:
+        self.sock.close()
+        self.log.flush()
+""", """
+def close(self):
+    try:
+        self.drain()
+    finally:
+        try:
+            self.sock.close()
+        except Exception as exc:
+            self.errors.append(f"sock close failed: {exc}")
+        try:
+            self.log.flush()
+        except Exception as exc:
+            self.errors.append(f"log flush failed: {exc}")
+"""),
+}
+
+
+def _lint_ids(source: str, select: Sequence[str]) -> List[str]:
+    from dasmtl.analysis.lint import lint_source
+
+    return [f.rule for f in lint_source(source, path=_SNIPPET_PATH,
+                                        select=select)]
+
+
+def run_self_test(verbose: bool = True) -> List[dict]:
+    """Drive every failpath fault leg; returns the misses (empty =
+    the family is proven)."""
+    harness = FaultHarness("failpath", inject=inject, verbose=verbose)
+
+    def make_run(fault: str, rule: str, dirty: str, clean: str):
+        def run() -> List[str]:
+            src = dirty if _ACTIVE == fault else clean
+            return _lint_ids(src, [rule])
+        return run
+
+    for fault, (rule, dirty, clean) in FAULTS.items():
+        harness.leg(
+            fault, rule, make_run(fault, rule, dirty, clean),
+            # The clean pair must be FULLY silent under the selected
+            # rule — partial credit ("fires, but elsewhere") is still
+            # an over-firing prescription.
+            clean_check=lambda ids: (f"expected no findings, got {ids}"
+                                     if ids else None))
+    return harness.run()

@@ -46,8 +46,12 @@ TRACING_TRANSFORMS = frozenset({
 })
 
 #: Modules whose import aliases we resolve through.  Anything else keeps its
-#: literal spelling (e.g. ``self.cv_step`` stays ``self.cv_step``).
-_KNOWN_ROOTS = ("jax", "numpy", "functools", "threading")
+#: literal spelling (e.g. ``self.cv_step`` stays ``self.cv_step``).  The
+#: stdlib transport/concurrency roots exist for the failure-path rules
+#: (DAS601-DAS605): ``from queue import Queue`` must resolve to
+#: ``queue.Queue`` for blocking-call provenance.
+_KNOWN_ROOTS = ("jax", "numpy", "functools", "threading", "queue",
+                "subprocess", "socket", "urllib")
 
 _NOQA_RE = re.compile(
     r"#\s*dasmtl:\s*noqa(?:\[\s*([A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*)\s*\])?")

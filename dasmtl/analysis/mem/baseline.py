@@ -12,13 +12,19 @@ does not exercise are left untouched.
 Workflow (mirrors ``dasmtl-audit``): after an intentional batching /
 staging-depth change run ``dasmtl-mem --update-baseline --preset
 full``, review the diff, commit.
+
+The file handling rides the shared
+:class:`~dasmtl.analysis.core.baseline.BaselineStore` (tiers merge by
+dict-update across presets; a hand-edited comment survives).
 """
 
 from __future__ import annotations
 
-import json
 import os
 from typing import Dict, List, Optional
+
+from dasmtl.analysis.core.baseline import (BaselineStore, generated_with,
+                                           merge_update)
 
 DEFAULT_BASELINE_PATH = os.path.join("artifacts",
                                      "membudget_baseline.json")
@@ -39,22 +45,17 @@ _COMMENT = ("Per-tier peak resident host-staging bytes and peak "
             "(docs/STATIC_ANALYSIS.md 'Memory discipline').")
 
 
+def store(path: str = DEFAULT_BASELINE_PATH) -> BaselineStore:
+    return BaselineStore(path, payload_key="tiers",
+                         default_comment=_COMMENT, merge=merge_update)
+
+
 def _generated_with() -> dict:
-    import platform
-
-    from dasmtl.analysis.audit.runner import (
-        _generated_with as _deps_versions)
-
-    out = _deps_versions()
-    out["python"] = platform.python_version()
-    return out
+    return generated_with()
 
 
 def load_baseline(path: str = DEFAULT_BASELINE_PATH) -> Optional[dict]:
-    if not os.path.exists(path):
-        return None
-    with open(path, encoding="utf-8") as f:
-        return json.load(f)
+    return store(path).load()
 
 
 def update_baseline(measured: Dict[str, dict],
@@ -63,27 +64,9 @@ def update_baseline(measured: Dict[str, dict],
     previous entries; tiers this run did not exercise survive (a
     quick-preset run must not drop the full set); a hand-edited
     comment survives."""
-    prev = load_baseline(path)
-    tiers: Dict[str, dict] = {}
-    comment = _COMMENT
-    if prev is not None:
-        tiers.update(prev.get("tiers", {}))
-        comment = prev.get("comment", _COMMENT)
-    for tier, stats in measured.items():
-        tiers[tier] = {m: int(stats.get(m, 0)) for m in _METRICS}
-    doc = {
-        "version": 1,
-        "comment": comment,
-        "generated_with": _generated_with(),
-        "tiers": {t: tiers[t] for t in sorted(tiers)},
-    }
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(doc, f, indent=2, sort_keys=True)
-        f.write("\n")
-    return doc
+    return store(path).update(
+        {tier: {m: int(stats.get(m, 0)) for m in _METRICS}
+         for tier, stats in measured.items()})
 
 
 def check_budgets(measured: Dict[str, dict],

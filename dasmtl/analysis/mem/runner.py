@@ -185,91 +185,68 @@ def runtime_findings(snap: dict, exercise: str = "") -> List[dict]:
 
 def self_test(verbose: bool = True) -> List[dict]:
     """Prove each checker catches its fault.  Returns findings for
-    every fault that went UNCAUGHT (empty = the suite works)."""
+    every fault that went UNCAUGHT (empty = the suite works).  The
+    fault/clean loop is the shared
+    :class:`~dasmtl.analysis.core.harness.FaultHarness`."""
+    from dasmtl.analysis.core.harness import FaultHarness
     from dasmtl.analysis.lint import lint_source
     from dasmtl.analysis.mem import faults
 
-    findings: List[dict] = []
+    harness = FaultHarness("mem", inject=faults.inject, verbose=verbose)
 
-    def note(msg: str) -> None:
-        if verbose:
-            print(f"[self-test] {msg}")
+    def lease_leg(fault: str, exercise: Callable[[], None],
+                  id_: str, *, needs_acquires: bool = True) -> None:
+        """Runtime leg: arm leasedep fresh, drive the exercise, map the
+        snapshot to MEM50x ids.  The clean pass must still RECORD
+        leases — silent tracker hooks are their own failure."""
+        state = {"acquires": 0}
 
-    def miss(id_: str, msg: str) -> None:
-        findings.append({"id": id_, "severity": "error", "message": msg})
-
-    def leg(fault: str, exercise: Callable[[], None], key: str,
-            id_: str, what: str) -> None:
-        """Injected variant must record under ``key``; clean must not."""
-        leasedep.enable(reset=True)
-        with faults.inject(fault):
+        def run() -> List[str]:
+            leasedep.enable(reset=True)
             exercise()
-        hits = leasedep.snapshot()[key]
-        if hits:
-            note(f"{id_} caught injected {what}: {hits[0]['message']}")
-        else:
-            miss(id_, f"injected {what} was NOT caught — no "
-                      f"{key} finding recorded")
-        leasedep.enable(reset=True)
-        exercise()
-        snap = leasedep.snapshot()
-        if snap[key]:
-            miss(id_, f"clean {what} exercise produced a spurious "
-                      f"finding: {snap[key]}")
-        elif not snap["acquires"] and key != "retirements":
-            miss(id_, f"clean {what} exercise recorded no leases — the "
-                      f"tracker hooks are not reporting")
-        else:
-            note(f"clean {what} exercise: silent")
+            snap = leasedep.snapshot()
+            state["acquires"] = snap["acquires"]
+            return [f["id"] for f in runtime_findings(snap)]
 
-    leg("leaked_lease", faults.run_lease_exercise, "leaks",
-        "MEM501", "leaked lease")
-    leg("double_release", faults.run_lease_exercise, "double_releases",
-        "MEM502", "double release")
-    leg("use_after_release", faults.run_canary_exercise, "canary",
-        "MEM503", "freelist write (use-after-release)")
-    leg("retire_alias", faults.run_retirement_exercise, "retirements",
-        "MEM504", "aliased retirement")
+        harness.leg(
+            fault, id_, run,
+            clean_check=lambda _ids: (
+                None if state["acquires"] or not needs_acquires else
+                "clean exercise recorded no leases — the tracker hooks "
+                "are not reporting"))
+
+    lease_leg("leaked_lease", faults.run_lease_exercise, "MEM501")
+    lease_leg("double_release", faults.run_lease_exercise, "MEM502")
+    lease_leg("use_after_release", faults.run_canary_exercise, "MEM503")
+    lease_leg("retire_alias", faults.run_retirement_exercise, "MEM504",
+              needs_acquires=False)
 
     # Budget bust: the quadrupled footprint must fail the fixture
-    # baseline; the in-budget measurement must pass it.
-    with faults.inject("budget_bust"):
-        over = check_budgets(faults.measured_budgets(),
-                             faults.BASELINE_DOC, "<fixture>")
-    if any(f["id"] == "MEM505" for f in over):
-        note(f"MEM505 caught injected budget bust: "
-             f"{over[0]['message'].splitlines()[0]}")
-    else:
-        miss("MEM505", "injected budget bust was NOT caught against "
-                       "the fixture baseline")
-    clean = check_budgets(faults.measured_budgets(),
-                          faults.BASELINE_DOC, "<fixture>")
-    if clean:
-        miss("MEM505", f"in-budget measurement tripped the budget "
-                       f"check: {clean}")
-    else:
-        note("in-budget measurement passes the budget check")
+    # baseline; the in-budget measurement must pass it entirely.
+    def budget_run() -> List[str]:
+        return [f["id"] for f in check_budgets(faults.measured_budgets(),
+                                               faults.BASELINE_DOC,
+                                               "<fixture>")]
+
+    harness.leg(
+        "budget_bust", "MEM505", budget_run,
+        clean_check=lambda ids: (f"in-budget measurement tripped the "
+                                 f"budget check: {ids}" if ids else None))
 
     # DAS401: the raw hot-path allocation must lint dirty; the
-    # stack_leaf spelling must lint clean.
-    with faults.inject("raw_hot_alloc"):
-        dirty = faults.allocation_snippet()
-    hits = [f for f in lint_source(dirty, "dasmtl/serve/<mem-self-test>")
-            if f.rule == "DAS401"]
-    if hits:
-        note(f"DAS401 caught injected raw hot-path allocation: "
-             f"{hits[0].message.splitlines()[0]}")
-    else:
-        miss("DAS401", "injected raw np.stack on a hot path was NOT "
-                       "caught by the static rules")
-    hits = [f for f in lint_source(faults.allocation_snippet(),
-                                   "dasmtl/serve/<mem-self-test>")
-            if f.rule.startswith("DAS4")]
-    if hits:
-        miss("DAS401", f"staged snippet tripped the memory rules: "
-                       f"{[f.render() for f in hits]}")
-    else:
-        note("staged snippet lints clean")
+    # stack_leaf spelling must pass EVERY memory rule.
+    def das401_run() -> List[str]:
+        return [f.rule
+                for f in lint_source(faults.allocation_snippet(),
+                                     "dasmtl/serve/<mem-self-test>")
+                if f.rule.startswith("DAS4")]
+
+    harness.leg(
+        "raw_hot_alloc", "DAS401", das401_run,
+        clean_check=lambda ids: (f"staged snippet tripped the memory "
+                                 f"rules: {ids}" if ids else None))
+
+    findings = harness.run()
 
     # Leave the tracker the way the process-level switches say.
     if leasedep._env_on():

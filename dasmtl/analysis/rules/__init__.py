@@ -54,8 +54,8 @@ def all_rules() -> List[Rule]:
     # Import here (not at module top) so the registry modules can import
     # this one without a cycle.
     from dasmtl.analysis.rules import (concurrency, donation,  # noqa: F401
-                                       dtype, host_sync, hygiene, loops,
-                                       memory, prng, serve_sync, surface,
-                                       tracing)
+                                       dtype, failpath, host_sync, hygiene,
+                                       loops, memory, prng, serve_sync,
+                                       surface, tracing)
 
     return [r for _, r in sorted(_REGISTRY.items())]

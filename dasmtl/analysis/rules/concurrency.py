@@ -337,6 +337,12 @@ def _thread_bodies(ctx: ModuleContext,
                 callback = call.args[0]
             if callback is None:
                 continue
+            if isinstance(callback, ast.Call) and callback.args:
+                # A wrapper factory — target=crash_logged(self._run, ...)
+                # (dasmtl/utils/threads.py) — still runs the wrapped
+                # callable on the spawned thread: look through it so the
+                # concurrency model keeps seeing the real body.
+                callback = callback.args[0]
             key = _expr_key(callback)
             if key and key.startswith("self."):
                 m = model.methods.get(key[5:])

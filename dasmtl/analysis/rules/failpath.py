@@ -1,0 +1,465 @@
+"""Failure-path rules — the static half of the ``failpath`` family.
+
+The fleet tiers (``dasmtl/serve/``, ``dasmtl/stream/``,
+``dasmtl/obs/``) are long-running multi-threaded processes whose
+failure modes are operational, not numerical: a blocking call with no
+deadline wedges a drain forever, a swallowed exception turns a dead
+sink into silence, a crashed worker thread takes its queue down with
+nobody noticing.  These rules encode the fleet's failure-path
+conventions the way DAS301-305 encode the locking ones and DAS401-405
+the memory ones:
+
+DAS601 — blocking call with no timeout/deadline on a fleet path.
+  Provenance is intra-module and name-based: a receiver assigned from
+  ``threading.Event()`` / ``threading.Thread(...)`` / ``queue.Queue()``
+  / ``subprocess.Popen(...)`` / ``socket.socket(...)`` makes its
+  ``.wait()`` / ``.join()`` / ``.get()`` / ``.communicate()`` /
+  ``.recv()`` a known blocker; ``urlopen`` and ``subprocess.run`` are
+  flagged directly.  Unknown receivers are clean — false negatives
+  over false positives, the linter's standing contract.
+DAS602 — swallowed exception: a broad handler (``except:`` /
+  ``except Exception:``) whose body neither re-raises, returns, nor
+  does ANY recording work (no call, no assignment — nothing but
+  ``pass``/``continue``).  A handler that bumps an error counter or
+  logs is clean; silence is not.
+DAS603 — thread target with no crash propagation: a
+  ``Thread(target=f)`` where the module-local ``f`` has a
+  call-bearing statement outside every broad ``try`` — an exception
+  there kills the thread silently.  Wrap the body, or construct the
+  thread with a recorded-failure wrapper
+  (``dasmtl.utils.threads.crash_logged``) — a ``target=<call>(...)``
+  expression is treated as such a wrapper.
+DAS604 — unbounded retry loop: ``while True`` around a transport
+  call inside a ``try`` whose broad handler neither raises, returns,
+  nor breaks — the failure path retries forever with no attempt cap.
+DAS605 — cleanup in a ``finally`` that can itself raise past the
+  drain: inside a drain/close-path function, a ``close``/``shutdown``/
+  ``terminate``/``kill``/``flush`` call at finally-level not wrapped
+  in its own ``try`` — one raising cleanup call skips the rest and
+  replaces the in-flight exception.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dasmtl.analysis.lint import ModuleContext
+from dasmtl.analysis.rules import make_finding, rule
+from dasmtl.analysis.rules.donation import _chain
+
+#: The long-running fleet tiers these rules govern.
+_SCOPED_DIRS = ("dasmtl/serve/", "dasmtl/stream/", "dasmtl/obs/")
+
+#: Constructor -> receiver kind, for blocking-call provenance.
+_CTOR_KINDS = {
+    "threading.Event": "event",
+    "threading.Thread": "thread",
+    "threading.Condition": "event",
+    "queue.Queue": "queue",
+    "queue.SimpleQueue": "queue",
+    "queue.LifoQueue": "queue",
+    "queue.PriorityQueue": "queue",
+    "subprocess.Popen": "process",
+    "socket.socket": "socket",
+}
+
+#: kind -> method names that block forever without a timeout argument.
+_BLOCKING_METHODS = {
+    "event": ("wait",),
+    "thread": ("join",),
+    "queue": ("get",),
+    "process": ("wait", "communicate"),
+}
+
+#: Direct calls that block without a ``timeout=`` keyword.
+_BLOCKING_CALLS = frozenset({
+    "urllib.request.urlopen",
+    "subprocess.run", "subprocess.check_output",
+    "subprocess.check_call", "subprocess.call",
+})
+
+#: Attribute calls that look like transport I/O (DAS604's retry body).
+_TRANSPORT_ATTRS = frozenset({
+    "recv", "send", "sendall", "connect", "request", "urlopen",
+    "getresponse", "communicate",
+})
+
+#: finally-level cleanup calls that genuinely raise in practice
+#: (thread joins and lock releases are excluded on purpose: flagging
+#: them would make every drain path noisy for calls that cannot
+#: realistically fail).
+_RISKY_CLEANUP_ATTRS = frozenset({
+    "close", "shutdown", "terminate", "kill", "flush",
+})
+
+#: Function names that mark a drain/close path for DAS605.
+_DRAIN_NAMES = ("close", "drain", "stop", "shutdown", "terminate",
+                "teardown", "finish", "__exit__", "__del__")
+
+
+def _scoped(ctx: ModuleContext) -> bool:
+    path = ctx.path.replace("\\", "/")
+    return any(d in path for d in _SCOPED_DIRS)
+
+
+def _all_functions(ctx: ModuleContext) -> List[ast.AST]:
+    return [fn for fns in ctx.functions.values() for fn in fns]
+
+
+def _provenance(ctx: ModuleContext) -> Dict[str, str]:
+    """chain (``stop`` / ``self._q``) -> receiver kind, from every
+    assignment whose value is a recognized constructor call."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(ctx.tree):
+        value = None
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            value, targets = node.value, list(node.targets)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            value, targets = node.value, [node.target]
+        if not isinstance(value, ast.Call):
+            continue
+        kind = _CTOR_KINDS.get(ctx.resolve(value.func) or "")
+        if kind is None:
+            continue
+        for tgt in targets:
+            key = _chain(tgt)
+            if key:
+                out[key] = kind
+    return out
+
+
+def _has_kw(call: ast.Call, *names: str) -> bool:
+    return any(kw.arg in names for kw in call.keywords)
+
+
+def _is_broad_handler(ctx: ModuleContext,
+                      handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    types = (handler.type.elts
+             if isinstance(handler.type, ast.Tuple)
+             else [handler.type])
+    for t in types:
+        name = ctx.resolve(t) or ""
+        if name.rsplit(".", 1)[-1] in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+# -- DAS601: blocking call with no timeout -----------------------------------
+
+@rule("DAS601", "error",
+      "blocking call with no timeout/deadline on a fleet path "
+      "(wedges drains and shutdowns forever)")
+def check_unbounded_blocking(ctx: ModuleContext) -> Iterator:
+    if not _scoped(ctx):
+        return
+    provenance = _provenance(ctx)
+    socket_bounded = {
+        _chain(n.func.value)
+        for n in ast.walk(ctx.tree)
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+        and n.func.attr == "settimeout" and _chain(n.func.value)}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = ctx.resolve(node.func)
+        if resolved in _BLOCKING_CALLS and not _has_kw(node, "timeout"):
+            short = resolved.rsplit(".", 1)[-1]
+            yield make_finding(
+                ctx, "DAS601", node,
+                f"{short}() without timeout= on a fleet path — a hung "
+                f"peer blocks this thread forever; pass an explicit "
+                f"deadline (docs/OPERATIONS.md 'timeout budgets')")
+            continue
+        if not isinstance(node.func, ast.Attribute):
+            continue
+        receiver = _chain(node.func.value)
+        kind = provenance.get(receiver or "")
+        if kind is None:
+            continue
+        if kind == "socket":
+            if (node.func.attr in ("recv", "accept")
+                    and receiver not in socket_bounded):
+                yield make_finding(
+                    ctx, "DAS601", node,
+                    f"{receiver}.{node.func.attr}() on a socket with no "
+                    f"settimeout() in this module — a silent peer "
+                    f"blocks forever; set a socket timeout")
+            continue
+        if node.func.attr not in _BLOCKING_METHODS.get(kind, ()):
+            continue
+        if node.args or _has_kw(node, "timeout"):
+            continue
+        if kind == "queue" and _has_kw(node, "block"):
+            continue
+        yield make_finding(
+            ctx, "DAS601", node,
+            f"{receiver}.{node.func.attr}() blocks with no timeout — "
+            f"a {kind} that never signals wedges this thread (and any "
+            f"drain waiting on it) forever; use a bounded wait in a "
+            f"loop so shutdown stays responsive")
+
+
+# -- DAS602: swallowed exception ---------------------------------------------
+
+@rule("DAS602", "error",
+      "broad except whose body does nothing (no re-raise, no return, "
+      "no recording) — the failure vanishes")
+def check_swallowed_exception(ctx: ModuleContext) -> Iterator:
+    if not _scoped(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad_handler(ctx, node):
+            continue
+        if _handler_does_work(node):
+            continue
+        label = ("bare except" if node.type is None
+                 else f"except {ctx.resolve(node.type) or '...'}")
+        yield make_finding(
+            ctx, "DAS602", node,
+            f"{label} swallows the failure silently — the body "
+            f"neither re-raises, returns an error, nor records it; "
+            f"count it (an error counter / log / alert sink) or let "
+            f"it propagate")
+
+
+def _handler_does_work(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body records, returns, or re-raises —
+    any call, assignment, return or raise counts as handling; a body
+    of only pass/continue/constants does not."""
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Raise, ast.Return, ast.Call,
+                                 ast.Assign, ast.AugAssign, ast.Yield,
+                                 ast.Break)):
+                return True
+            if isinstance(node, ast.AnnAssign) and node.value is not None:
+                return True
+    return False
+
+
+# -- DAS603: thread target that can die silently ------------------------------
+
+def _resolve_target_fn(ctx: ModuleContext,
+                       target: ast.AST) -> Optional[ast.AST]:
+    """The module-local function a ``target=`` refers to: a bare name,
+    or the method name of a ``self.x`` / ``obj.x`` chain."""
+    chain = _chain(target)
+    if not chain:
+        return None
+    name = chain.rsplit(".", 1)[-1]
+    fns = ctx.functions.get(name, [])
+    return fns[0] if len(fns) == 1 else None
+
+
+def _unguarded_call(body: List[ast.stmt], ctx: ModuleContext,
+                    guarded: bool = False) -> Optional[ast.AST]:
+    """First call-bearing statement not under a broad try (an
+    exception there escapes the function).  Nested defs are their own
+    functions; their bodies do not run here."""
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(stmt, ast.Try):
+            broad = any(_is_broad_handler(ctx, h) for h in stmt.handlers)
+            for part, part_guarded in ((stmt.body, guarded or broad),
+                                       (stmt.orelse, guarded or broad),
+                                       (stmt.finalbody, guarded)):
+                hit = _unguarded_call(part, ctx, part_guarded)
+                if hit is not None:
+                    return hit
+            for h in stmt.handlers:
+                hit = _unguarded_call(h.body, ctx, guarded)
+                if hit is not None:
+                    return hit
+            continue
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            if not guarded:
+                hit = _call_outside_defs(
+                    stmt.iter if isinstance(stmt, (ast.For, ast.AsyncFor))
+                    else stmt.test)
+                if hit is not None:
+                    return hit
+            hit = _unguarded_call(stmt.body + stmt.orelse, ctx, guarded)
+            if hit is not None:
+                return hit
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            if not guarded:
+                for item in stmt.items:
+                    hit = _call_outside_defs(item.context_expr)
+                    if hit is not None:
+                        return hit
+            hit = _unguarded_call(stmt.body, ctx, guarded)
+            if hit is not None:
+                return hit
+        elif isinstance(stmt, ast.If):
+            if not guarded:
+                hit = _call_outside_defs(stmt.test)
+                if hit is not None:
+                    return hit
+            hit = _unguarded_call(stmt.body + stmt.orelse, ctx, guarded)
+            if hit is not None:
+                return hit
+        elif not guarded:
+            hit = _call_outside_defs(stmt)
+            if hit is not None:
+                return hit
+    return None
+
+
+def _call_outside_defs(expr: Optional[ast.AST]) -> Optional[ast.AST]:
+    if expr is None:
+        return None
+    nested: Set[int] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            nested.update(id(n) for n in ast.walk(node) if n is not node)
+            continue
+        if id(node) in nested:
+            continue
+        if isinstance(node, ast.Call):
+            return node
+    return None
+
+
+@rule("DAS603", "error",
+      "Thread target that can raise out the top — the thread dies "
+      "silently (wrap with dasmtl.utils.threads.crash_logged)")
+def check_silent_thread_death(ctx: ModuleContext) -> Iterator:
+    if not _scoped(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and ctx.resolve(node.func) == "threading.Thread"):
+            continue
+        target = next((kw.value for kw in node.keywords
+                       if kw.arg == "target"), None)
+        if target is None or isinstance(target, ast.Call):
+            # target=crash_logged(f, ...) — a wrapper factory IS the
+            # crash propagation this rule asks for.
+            continue
+        fn = _resolve_target_fn(ctx, target)
+        if fn is None:
+            continue
+        hit = _unguarded_call(fn.body, ctx)
+        if hit is None:
+            continue
+        yield make_finding(
+            ctx, "DAS603", node,
+            f"Thread target {fn.name}() has a call outside any broad "
+            f"try (line {hit.lineno}) — an exception there kills the "
+            f"thread silently and its work just stops; wrap the body "
+            f"in try/except-with-recording or construct with "
+            f"target=crash_logged({fn.name}, ...) "
+            f"(dasmtl/utils/threads.py)")
+
+
+# -- DAS604: unbounded retry loop ---------------------------------------------
+
+def _is_transport_call(ctx: ModuleContext, node: ast.Call) -> bool:
+    resolved = ctx.resolve(node.func) or ""
+    if resolved in _BLOCKING_CALLS:
+        return True
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr in _TRANSPORT_ATTRS
+    return False
+
+
+@rule("DAS604", "error",
+      "while-True retry around a transport call with no attempt cap "
+      "(the failure path retries forever)")
+def check_unbounded_retry(ctx: ModuleContext) -> Iterator:
+    if not _scoped(ctx):
+        return
+    for loop in ast.walk(ctx.tree):
+        if not (isinstance(loop, ast.While)
+                and isinstance(loop.test, ast.Constant)
+                and loop.test.value):
+            continue
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Try):
+                continue
+            has_transport = any(
+                isinstance(n, ast.Call) and _is_transport_call(ctx, n)
+                for stmt in node.body for n in ast.walk(stmt))
+            if not has_transport:
+                continue
+            for handler in node.handlers:
+                if not _is_broad_handler(ctx, handler):
+                    continue
+                bounded = any(
+                    isinstance(n, (ast.Raise, ast.Return, ast.Break))
+                    for stmt in handler.body for n in ast.walk(stmt))
+                if bounded:
+                    continue
+                yield make_finding(
+                    ctx, "DAS604", handler,
+                    "transport call retried under `while True` with a "
+                    "handler that never raises, returns, or breaks — "
+                    "a dead peer spins this loop forever; cap the "
+                    "attempts or bound the backoff and escalate")
+
+
+# -- DAS605: finally cleanup that can raise past the drain --------------------
+
+def _enclosing_functions(ctx: ModuleContext) -> Dict[int, str]:
+    """node id -> name of the nearest enclosing function."""
+    out: Dict[int, str] = {}
+
+    def visit(fn: ast.AST) -> None:
+        for node in ctx.body_walk(fn):
+            out.setdefault(id(node), fn.name)
+
+    for fn in _all_functions(ctx):
+        visit(fn)
+    return out
+
+
+def _is_drain_path(fn_name: str, try_node: ast.Try) -> bool:
+    name = fn_name.lower()
+    if any(tag in name for tag in _DRAIN_NAMES):
+        return True
+    for stmt in try_node.body:
+        for node in ast.walk(stmt):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("drain", "drain_check")):
+                return True
+    return False
+
+
+@rule("DAS605", "warning",
+      "finally-level cleanup call not individually wrapped on a "
+      "drain/close path (one raise skips the remaining cleanup)")
+def check_raising_finally(ctx: ModuleContext) -> Iterator:
+    if not _scoped(ctx):
+        return
+    owner = _enclosing_functions(ctx)
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Try) and node.finalbody):
+            continue
+        fn_name = owner.get(id(node), "")
+        if not _is_drain_path(fn_name, node):
+            continue
+        for stmt in node.finalbody:
+            if isinstance(stmt, ast.Try):
+                continue  # individually wrapped — exactly the ask
+            for inner in ast.walk(stmt):
+                if (isinstance(inner, ast.Call)
+                        and isinstance(inner.func, ast.Attribute)
+                        and inner.func.attr in _RISKY_CLEANUP_ATTRS):
+                    yield make_finding(
+                        ctx, "DAS605", inner,
+                        f"{inner.func.attr}() at finally-level of a "
+                        f"drain/close path — if it raises, the rest of "
+                        f"the cleanup is skipped and the in-flight "
+                        f"exception is replaced; wrap it in its own "
+                        f"try/except and record the failure")
+                    break

@@ -228,39 +228,40 @@ def run_cell(cell: SanitizeCell, spec=None,
 
 # -- baseline ----------------------------------------------------------------
 
+_BASELINE_COMMENT = ("Determinism fingerprints for dasmtl-sanitize "
+                     "--check-baseline; see docs/STATIC_ANALYSIS.md for "
+                     "the update workflow.")
+
+
+def store(path: str = DEFAULT_BASELINE_PATH) -> "BaselineStore":
+    from dasmtl.analysis.core.baseline import BaselineStore, merge_update
+
+    # Same stamp shape as the audit baseline: jax/jaxlib only, always
+    # supplied by the runner from the live jax modules.
+    return BaselineStore(path, payload_key="targets",
+                         default_comment=_BASELINE_COMMENT,
+                         merge=merge_update, stamp_python=False)
+
+
 def load_baseline(path: str) -> Optional[dict]:
-    if not os.path.exists(path):
-        return None
-    with open(path, encoding="utf-8") as f:
-        return json.load(f)
+    return store(path).load()
 
 
 def update_baseline(reports: Iterable[CellReport], path: str,
                     generated_with: Optional[dict] = None) -> dict:
     """Merge measured fingerprints into the baseline: audited cells are
-    overwritten, other cells kept, hand-edited tolerances preserved —
-    the same contract as the audit baseline."""
-    existing = load_baseline(path) or {}
+    overwritten, other cells kept, hand-edited tolerances (and a
+    hand-edited comment) preserved — the same contract as the audit
+    baseline."""
+    st = store(path)
+    existing = st.load() or {}
     tolerances = dict(DEFAULT_TOLERANCES)
     tolerances.update(existing.get("tolerances", {}))
-    targets = dict(existing.get("targets", {}))
-    for report in reports:
-        targets[report.name] = report.to_baseline_entry()
-    data = {
-        "version": 1,
-        "comment": ("Determinism fingerprints for dasmtl-sanitize "
-                    "--check-baseline; see docs/STATIC_ANALYSIS.md for the "
-                    "update workflow."),
-        "generated_with": generated_with
-        or existing.get("generated_with", {}),
-        "tolerances": tolerances,
-        "targets": {k: targets[k] for k in sorted(targets)},
-    }
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(data, f, indent=2, sort_keys=True)
-        f.write("\n")
-    return data
+    return st.update(
+        {r.name: r.to_baseline_entry() for r in reports},
+        extra={"tolerances": tolerances},
+        generated_with=generated_with
+        or existing.get("generated_with", {}))
 
 
 def versions_match(baseline: Optional[dict], current: dict) -> bool:

@@ -18,14 +18,18 @@ before it is a fleet incident:
 
 A hand-edited ``comment`` survives ``--update-baseline`` (the
 established analysis-family convention; mem/conc/audit baselines
-behave identically).
+behave identically).  The file handling rides the shared
+:class:`~dasmtl.analysis.core.baseline.BaselineStore` (the extraction
+is always complete, so the payload replaces wholesale).
 """
 
 from __future__ import annotations
 
-import json
 import os
 from typing import Dict, List, Optional
+
+from dasmtl.analysis.core.baseline import (BaselineStore, generated_with,
+                                           merge_replace)
 
 DEFAULT_BASELINE_PATH = os.path.join("artifacts", "surface_baseline.json")
 
@@ -38,22 +42,17 @@ _COMMENT = ("The committed wire surface of the fleet: per-frontend "
             "STATIC_ANALYSIS.md 'Interface contracts').")
 
 
+def store(path: str = DEFAULT_BASELINE_PATH) -> BaselineStore:
+    return BaselineStore(path, payload_key="surface",
+                         default_comment=_COMMENT, merge=merge_replace)
+
+
 def _generated_with() -> dict:
-    import platform
-
-    from dasmtl.analysis.audit.runner import (
-        _generated_with as _deps_versions)
-
-    out = _deps_versions()
-    out["python"] = platform.python_version()
-    return out
+    return generated_with()
 
 
 def load_baseline(path: str = DEFAULT_BASELINE_PATH) -> Optional[dict]:
-    if not os.path.exists(path):
-        return None
-    with open(path, encoding="utf-8") as f:
-        return json.load(f)
+    return store(path).load()
 
 
 def update_baseline(surface: dict,
@@ -61,23 +60,7 @@ def update_baseline(surface: dict,
     """Write/refresh the baseline from a full extracted surface.  The
     extraction is always complete (static), so the surface replaces
     wholesale; a hand-edited comment survives."""
-    prev = load_baseline(path)
-    comment = _COMMENT
-    if prev is not None:
-        comment = prev.get("comment", _COMMENT)
-    doc = {
-        "version": 1,
-        "comment": comment,
-        "generated_with": _generated_with(),
-        "surface": surface,
-    }
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(doc, f, indent=2, sort_keys=True)
-        f.write("\n")
-    return doc
+    return store(path).update(surface)
 
 
 def _finding(id_: str, message: str) -> dict:

@@ -62,36 +62,18 @@ def resolve_exercises(preset: str,
 def self_test(verbose: bool = True) -> List[dict]:
     """Plant every fault in :data:`faults.FAULTS`; each must be caught
     by exactly its check, and the clean variant must stay silent.
-    Returns findings for every MISSED fault (empty = suite proven)."""
+    Returns findings for every MISSED fault (empty = suite proven).
+    The fault/clean loop is the shared
+    :class:`~dasmtl.analysis.core.harness.FaultHarness`."""
+    from dasmtl.analysis.core.harness import FaultHarness
     from dasmtl.analysis.lint import lint_source
     from dasmtl.analysis.surface import faults, probe
     from dasmtl.analysis.surface.probe import (
         REQUIRED_ROUTER_METRIC_FAMILIES)
 
-    say = print if verbose else (lambda *_a, **_k: None)
-    findings: List[dict] = []
-
-    def note(msg: str) -> None:
-        say(f"[surface-self-test] {msg}")
-
-    def miss(check: str, msg: str) -> None:
-        findings.append({"id": check, "severity": "error",
-                         "message": msg})
-
-    def leg(fault: str, expect: str, run) -> None:
-        with faults.inject(fault):
-            dirty = run()
-        clean = run()
-        if expect in dirty:
-            note(f"{expect} caught injected {fault}")
-        else:
-            miss(expect, f"injected fault {fault!r} was NOT caught "
-                         f"({expect} stayed silent)")
-        if expect in clean:
-            miss(expect, f"clean variant of {fault!r} tripped {expect} "
-                         f"— the check over-fires")
-        else:
-            note(f"clean variant of {fault} stays silent")
+    harness = FaultHarness("surface", inject=faults.inject,
+                           verbose=verbose)
+    leg = harness.leg
 
     def lint_ids(source: str, path: str, rule: str) -> List[str]:
         return [f.rule for f in lint_source(source, path, select=[rule])]
@@ -159,7 +141,7 @@ def self_test(verbose: bool = True) -> List[dict]:
     leg("srf605_extra_key", "SRF605", reply_run)
     leg("srf606_missing_family", "SRF606", exposition_run)
 
-    return findings
+    return harness.run()
 
 
 # -- CLI ----------------------------------------------------------------------

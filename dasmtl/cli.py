@@ -148,6 +148,21 @@ def surface_main(argv=None) -> int:
     return main(argv)
 
 
+def check_main(argv=None) -> int:
+    """``dasmtl check`` — the unified analysis engine
+    (dasmtl/analysis/core/; docs/STATIC_ANALYSIS.md 'The check
+    engine').  Runs every analysis family — lint, failpath, surface,
+    conc, mem, audit, sanitize — through one orchestrator, merges the
+    findings, optionally emits SARIF, and exits nonzero iff any family
+    failed.  ``--only`` / ``--changed-since`` narrow the sweep;
+    ``--self-test`` proves the DAS6xx failure-path rules by fault
+    injection."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    from dasmtl.analysis.core.engine import main
+
+    return main(argv)
+
+
 def obs_main(argv=None) -> int:
     """``dasmtl-obs`` — the unified telemetry layer's CLI
     (dasmtl/obs/; docs/OBSERVABILITY.md): ``dump`` span records or
@@ -192,6 +207,8 @@ _SUBCOMMANDS = {
     "router": (router_main, "replica router tier: scale-out serving + "
                             "blue/green rollout (dasmtl-router)"),
     "doctor": (doctor_main, "environment diagnostics (dasmtl-doctor)"),
+    "check": (check_main, "unified analysis engine: every family, one "
+                          "run, merged findings + SARIF (dasmtl-check)"),
     "lint": (lint_main, "JAX-aware AST linter (dasmtl-lint)"),
     "audit": (audit_main, "compile-time HLO/cost auditor (dasmtl-audit)"),
     "sanitize": (sanitize_main,

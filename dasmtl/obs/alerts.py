@@ -52,6 +52,7 @@ from dasmtl.analysis.conc import lockdep
 from dasmtl.obs.history import (MetricsHistory, render_sample_key,
                                 samples_of_parsed)
 from dasmtl.obs.registry import MetricsRegistry, parse_exposition
+from dasmtl.utils.threads import crash_logged
 
 ALERT_KINDS = ("threshold", "rate", "burn_rate")
 ALERT_OPS: Dict[str, Callable[[float, float], bool]] = {
@@ -454,8 +455,9 @@ class AlertEngine:
                         self.source_errors += 1
                 self._stop.wait(interval_s)
 
-        self._thread = threading.Thread(target=run, daemon=True,
-                                        name="dasmtl-alerts")
+        self._thread = threading.Thread(
+            target=crash_logged(run, "obs-alerts"),
+            daemon=True, name="dasmtl-alerts")
         self._thread.start()
         return self
 

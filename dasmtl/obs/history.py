@@ -34,6 +34,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple
 from dasmtl.analysis.conc import lockdep
 
 from dasmtl.obs.registry import escape_label_value, parse_exposition
+from dasmtl.utils.threads import crash_logged
 
 #: One snapshot's payload: ``{family: {(sample_name, labels): value}}``
 #: where ``labels`` is a sorted tuple of ``(key, value)`` pairs — the
@@ -211,8 +212,9 @@ class HistorySampler:
     def start(self) -> "HistorySampler":
         if self._thread is not None:
             raise RuntimeError("HistorySampler already started")
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="dasmtl-history")
+        self._thread = threading.Thread(
+            target=crash_logged(self._run, "obs-history"),
+            daemon=True, name="dasmtl-history")
         self._thread.start()
         return self
 

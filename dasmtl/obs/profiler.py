@@ -39,6 +39,7 @@ from collections import defaultdict
 from typing import List, Optional
 
 from dasmtl.analysis.conc import lockdep
+from dasmtl.utils.threads import crash_logged
 
 
 class ProfilerHook:
@@ -81,8 +82,10 @@ class ProfilerHook:
             self._last_trigger = now
             path = os.path.join(self.out_dir,
                                 f"capture_{self.captures + len(self.skips):03d}")
-            t = threading.Thread(target=self._run, args=(path, reason),
-                                 name="dasmtl-obs-capture", daemon=True)
+            t = threading.Thread(
+                target=crash_logged(self._run, "obs-capture"),
+                args=(path, reason),
+                name="dasmtl-obs-capture", daemon=True)
             self._active = t
         t.start()
         return path

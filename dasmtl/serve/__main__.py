@@ -381,7 +381,11 @@ def main(argv=None) -> int:
     # queued, then stop accepting connections.  shutdown() must not run in
     # the signal handler (it joins the serve_forever thread) — flag + poll.
     install_signal_handlers(loop, on_drain=lambda _s: stop.set())
-    stop.wait()
+    # Bounded wait in a loop (DAS601): the process stays parked until
+    # the drain signal, but never sleeps in an unbounded syscall — a
+    # missed signal or wedged handler cannot make shutdown unreachable.
+    while not stop.wait(timeout=1.0):
+        pass
     drained = loop.drain(timeout=60.0)
     if sampler is not None:
         sampler.stop()

@@ -337,10 +337,12 @@ class ReplicaProcess:
         return self.proc.poll() is None
 
     def kill(self) -> None:
-        """SIGKILL — the failure-injection path (no drain, no goodbye)."""
+        """SIGKILL — the failure-injection path (no drain, no goodbye).
+        Even reaping a SIGKILLed child gets a deadline (DAS601): a
+        pathological wait here must surface, not wedge the router."""
         if self.alive:
             os.kill(self.proc.pid, signal.SIGKILL)
-        self.proc.wait()
+        self.proc.wait(timeout=30.0)
 
     def terminate(self, timeout_s: float = 60.0) -> int:
         """SIGTERM (graceful drain) and wait; returns the exit code."""
@@ -350,7 +352,9 @@ class ReplicaProcess:
             return self.proc.wait(timeout=timeout_s)
         except subprocess.TimeoutExpired:
             self.proc.kill()
-            return self.proc.wait()
+            # A SIGKILLed child reaps promptly; the deadline (DAS601)
+            # is for the pathological case — surface it, don't wedge.
+            return self.proc.wait(timeout=30.0)
 
     def log_tail(self, max_bytes: int = 4096) -> str:
         try:

@@ -54,6 +54,7 @@ from dasmtl.obs.registry import (MetricsRegistry, escape_label_value,
                                  parse_exposition, render_prometheus)
 from dasmtl.obs.trace import TraceRing, make_span, mint_trace_id
 from dasmtl.serve.replica import HttpTransport, ReplicaHandle, TransportError
+from dasmtl.utils.threads import crash_logged
 
 #: Outcomes the router's own requests_total counter distinguishes (the
 #: replica outcomes plus the two only a router can produce).
@@ -204,8 +205,8 @@ class Router:
     def start(self) -> "Router":
         self.probe_once()  # synchronous first pass: known state at start
         self._probe_thread = threading.Thread(
-            target=self._probe_loop, name="dasmtl-router-probe",
-            daemon=True)
+            target=crash_logged(self._probe_loop, "router-probe"),
+            name="dasmtl-router-probe", daemon=True)
         self._probe_thread.start()
         return self
 
@@ -419,7 +420,10 @@ class Router:
                              "policy": policy, "steps": [],
                              "started_t": time.time()}
         self._rollout_thread = threading.Thread(
-            target=self._run_rollout,
+            target=crash_logged(
+                self._run_rollout, "router-rollout",
+                on_crash=lambda exc: self._finish_rollout(
+                    "failed", f"rollout thread crashed: {exc}")),
             args=(version, policy, drain_timeout_s, swap_timeout_s),
             name="dasmtl-router-rollout", daemon=True)
         self._rollout_thread.start()
@@ -833,7 +837,10 @@ def main(argv=None) -> int:
         _signal.signal(s, _stop)
     t = threading.Thread(target=httpd.serve_forever, daemon=True)
     t.start()
-    stop.wait()
+    # Bounded wait in a loop (DAS601): parked until SIGTERM/SIGINT,
+    # never in an unbounded syscall.
+    while not stop.wait(timeout=1.0):
+        pass
     httpd.shutdown()
     t.join(timeout=10.0)
     if sampler is not None:

@@ -91,6 +91,7 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
     from dasmtl.serve.executor import ExecutorPool
     from dasmtl.serve.server import (ServeLoop, install_signal_handlers,
                                      make_http_server)
+    from dasmtl.utils.threads import crash_logged
 
     conc0 = lockdep.snapshot()
     mem0 = leasedep.snapshot()
@@ -153,8 +154,13 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
             except Exception as exc:  # noqa: BLE001 — a drop IS the finding
                 record(k, poisoned, before_drain, exc)
 
-    threads = [threading.Thread(target=client, args=(c,), daemon=True)
-               for c in range(clients)]
+    threads = [threading.Thread(
+        target=crash_logged(
+            client, "serve-selftest-client",
+            on_crash=lambda exc: failures.append(
+                f"client thread crashed: {type(exc).__name__}: {exc}")),
+        args=(c,), daemon=True)
+        for c in range(clients)]
     prev_handlers: Optional[dict] = None
     scrapes: list = []
     httpd = http_thread = None
@@ -209,9 +215,14 @@ def run_selftest(*, requests: int = 512, clients: int = 8,
         if prev_handlers is not None:
             for s, h_prev in prev_handlers.items():
                 signal.signal(s, h_prev)
-        if httpd is not None:
-            httpd.shutdown()
-            http_thread.join(timeout=10.0)
+        try:
+            if httpd is not None:
+                httpd.shutdown()
+                http_thread.join(timeout=10.0)
+        except Exception as exc:  # noqa: BLE001 — recorded (DAS605):
+            # a raising shutdown must not replace the real finding.
+            failures.append(f"/metrics front-end shutdown failed: "
+                            f"{type(exc).__name__}: {exc}")
     stats = loop.stats()
     loop.close()
 

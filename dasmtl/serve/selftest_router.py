@@ -47,6 +47,7 @@ from dasmtl.obs.trace import join_chains, mint_trace_id
 from dasmtl.serve.replica import (HttpTransport, ReplicaHandle,
                                   ReplicaProcess, TransportError)
 from dasmtl.serve.router import Router, make_router_http_server
+from dasmtl.utils.threads import crash_logged
 
 #: Reduced-window replica spec (the PR 4 selftest convention: identical
 #: serving machinery, smaller conv stacks).
@@ -111,8 +112,13 @@ def _check_trace_propagation(transport: HttpTransport, router_addr: str,
             with res_lock:
                 results.append(payload)
 
-        burst = [threading.Thread(target=one_shot, args=(k,), daemon=True)
-                 for k in range(12)]
+        burst = [threading.Thread(
+            target=crash_logged(
+                one_shot, "router-selftest-burst",
+                on_crash=lambda exc: failures.append(
+                    f"burst thread crashed: {type(exc).__name__}: {exc}")),
+            args=(k,), daemon=True)
+            for k in range(12)]
         for t in burst:
             t.start()
         for t in burst:
@@ -249,8 +255,13 @@ def run_router_selftest(*, requests: int = 400, clients: int = 8,
             "ready (warmup compiles run behind /readyz=503) ...")
         _wait(lambda: router.stats()["in_rotation"] == 2, 300.0,
               "both replicas in rotation")
-        threads = [threading.Thread(target=client, args=(c,), daemon=True)
-                   for c in range(clients)]
+        threads = [threading.Thread(
+            target=crash_logged(
+                client, "router-selftest-client",
+                on_crash=lambda exc: failures.append(
+                    f"client thread crashed: {type(exc).__name__}: {exc}")),
+            args=(c,), daemon=True)
+            for c in range(clients)]
         for t in threads:
             t.start()
         phase1 = max(50, requests // 4)

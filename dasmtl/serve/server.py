@@ -87,6 +87,7 @@ from dasmtl.obs.trace import TraceRing, make_span
 from dasmtl.serve.batcher import BatchPlan, MicroBatcher, StagingBuffers
 from dasmtl.serve.metrics import ServeMetrics
 from dasmtl.serve.queue import ServeResult
+from dasmtl.utils.threads import crash_logged
 
 #: Decoded event-head label names (index = class id), mirrored from the
 #: streaming CSV writer so the two serving surfaces agree.
@@ -172,13 +173,13 @@ class ServeLoop:
         if self._thread is not None:
             raise RuntimeError("ServeLoop.start is once-only")
         self._warmup_s = self.executor.warmup()
-        self._collector = threading.Thread(target=self._collect_loop,
-                                           name="dasmtl-serve-collect",
-                                           daemon=True)
+        self._collector = threading.Thread(
+            target=crash_logged(self._collect_loop, "serve-collect"),
+            name="dasmtl-serve-collect", daemon=True)
         self._collector.start()
-        self._thread = threading.Thread(target=self._dispatch_loop,
-                                        name="dasmtl-serve-dispatch",
-                                        daemon=True)
+        self._thread = threading.Thread(
+            target=crash_logged(self._dispatch_loop, "serve-dispatch"),
+            name="dasmtl-serve-dispatch", daemon=True)
         self._thread.start()
         return self
 
@@ -441,7 +442,13 @@ class ServeLoop:
     # -- stage 2: collector --------------------------------------------------
     def _collect_loop(self) -> None:
         while True:
-            item = self._completion.get()
+            # Bounded get (DAS601): the collector re-checks every second
+            # instead of parking forever — a lost sentinel cannot leave a
+            # zombie thread holding device buffers.
+            try:
+                item = self._completion.get(timeout=1.0)
+            except _queue.Empty:
+                continue
             if item is _SENTINEL:
                 return
             plan, handle, buf, staging, executor = item
@@ -779,7 +786,8 @@ def _make_handler(loop: ServeLoop, request_timeout_s: float,
                                                 "warming"})
                     return
                 threading.Thread(
-                    target=loop.swap_to, args=(swap_builder, version),
+                    target=crash_logged(loop.swap_to, "serve-swap"),
+                    args=(swap_builder, version),
                     name="dasmtl-serve-swap", daemon=True).start()
                 self._reply(202, {"swap": {"state": "started",
                                            "version": version},

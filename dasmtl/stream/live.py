@@ -48,6 +48,7 @@ from dasmtl.obs.registry import (DEFAULT_LATENCY_BUCKETS_S, MetricsRegistry)
 from dasmtl.stream.feed import FiberFeed
 from dasmtl.stream.tracks import TrackBook, WindowDecode
 from dasmtl.stream.windower import LiveWindower
+from dasmtl.utils.threads import crash_logged
 
 #: Metric families a healthy stream scrape must carry — the acceptance
 #: catalog of docs/OBSERVABILITY.md's ``dasmtl_stream_*`` section.
@@ -508,8 +509,10 @@ class StreamLoop:
             while not self._stop.is_set():
                 self.run_cycle()
                 self._stop.wait(poll_s)
-        self._pump = threading.Thread(target=pump, daemon=True,
-                                      name="dasmtl-stream-pump")
+        self._pump = threading.Thread(
+            target=crash_logged(pump, "stream-pump",
+                                on_crash=lambda _exc: self._stop.set()),
+            daemon=True, name="dasmtl-stream-pump")
         self._pump.start()
         return self
 
@@ -551,8 +554,12 @@ class StreamLoop:
         for t in self.tenants:
             try:
                 t.source.close()
-            except Exception:  # noqa: BLE001 — teardown best-effort
-                pass
+            except Exception as exc:  # noqa: BLE001 — teardown best-effort,
+                # but recorded (DAS602): a source that cannot close is an
+                # fd/socket leak worth a line in the log.
+                print(f"[stream-close] tenant {t.name}: source.close "
+                      f"failed: {type(exc).__name__}: {exc}",
+                      file=sys.stderr)
 
     # -- views ---------------------------------------------------------------
     def events(self, n: int = 100,
@@ -1046,7 +1053,10 @@ def serve_main(argv=None) -> int:
     stop = threading.Event()
     install_signal_handlers(loop, on_drain=lambda _s: stop.set())
     stream.start(poll_s=args.poll_ms / 1e3)
-    stop.wait()
+    # Bounded wait in a loop (DAS601): parked until the drain signal,
+    # never in an unbounded syscall.
+    while not stop.wait(timeout=1.0):
+        pass
     stream_drained = stream.drain(timeout=30.0)
     serve_drained = loop.drain(timeout=60.0)
     if sampler is not None:

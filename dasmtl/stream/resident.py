@@ -46,6 +46,7 @@ import numpy as np
 from dasmtl.analysis.mem import leasedep
 from dasmtl.data.staging import aligned_zeros
 from dasmtl.export import PROB_Q_SCALE, make_resident_serve_fn
+from dasmtl.utils.threads import crash_logged
 
 
 def collect_host(outputs):
@@ -405,8 +406,9 @@ class ResidentCollector:
         self._on_batch = on_batch
         self._q: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="dasmtl-resident-collect")
+        self._thread = threading.Thread(
+            target=crash_logged(self._run, "resident-collect"),
+            daemon=True, name="dasmtl-resident-collect")
         self._thread.start()
 
     def submit(self, tenant, windows: List, batch: ResidentBatch) -> None:
@@ -414,7 +416,12 @@ class ResidentCollector:
 
     def _run(self) -> None:
         while True:
-            item = self._q.get()
+            # Bounded get (DAS601): re-check every second rather than
+            # parking forever, so a lost sentinel cannot leak the thread.
+            try:
+                item = self._q.get(timeout=1.0)
+            except queue.Empty:
+                continue
             if item is None:
                 return
             tenant, windows, batch = item

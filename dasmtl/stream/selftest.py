@@ -284,13 +284,24 @@ def run_selftest(*, fibers: int = 3, cycles: int = 140, devices: int = 1,
         stream_drained = stream.drain(timeout=60.0)
         serve_drained = loop.drain(timeout=60.0)
     finally:
-        httpd.shutdown()
-        http_thread.join(timeout=10.0)
-        hookd.shutdown()
-        hook_thread.join(timeout=10.0)
-        stream.close()
-        loop.close()
-        jsonl_sink.close()
+        # Each cleanup wrapped on its own (DAS605): one raising close
+        # must not skip the rest or replace an in-flight exception —
+        # it becomes a recorded finding instead.
+        def _cleanup(what: str, fn) -> None:
+            try:
+                fn()
+            except Exception as exc:  # noqa: BLE001 — recorded above
+                failures.append(f"teardown: {what} failed: "
+                                f"{type(exc).__name__}: {exc}")
+        _cleanup("httpd.shutdown", httpd.shutdown)
+        _cleanup("http thread join",
+                 lambda: http_thread.join(timeout=10.0))
+        _cleanup("hookd.shutdown", hookd.shutdown)
+        _cleanup("hook thread join",
+                 lambda: hook_thread.join(timeout=10.0))
+        _cleanup("stream.close", stream.close)
+        _cleanup("loop.close", loop.close)
+        _cleanup("jsonl_sink.close", jsonl_sink.close)
 
     # -- 1. fairness ---------------------------------------------------------
     if not stream_drained:

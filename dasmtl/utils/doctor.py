@@ -200,25 +200,21 @@ def collect() -> dict:
             "guard_transfer": d.guard_transfer,
             "guard_nan_check": d.guard_nan_check,
         },
-        "audit_baseline": _audit_baseline_summary(),
         "sanitize_defaults": {
             "sanitize": d.sanitize,
             "sanitize_every": d.sanitize_every,
         },
-        "determinism_baseline": _determinism_baseline_summary(),
         "conc_defaults": {
             "conc_lockdep": d.conc_lockdep,
             "conc_hold_warn_ms": d.conc_hold_warn_ms,
             "conc_dump_path": d.conc_dump_path,
         },
-        "lockorder_baseline": _lockorder_baseline_summary(),
         "mem_defaults": {
             "mem_track": d.mem_track,
             "mem_canary": d.mem_canary,
             "mem_dump_path": d.mem_dump_path,
         },
-        "membudget_baseline": _membudget_baseline_summary(),
-        "surface_baseline": _surface_baseline_summary(),
+        "baselines": _baseline_statuses(),
     }
     return info
 
@@ -244,113 +240,52 @@ def _registry_summary(root: Optional[str]) -> dict:
                 for e in entries]}
 
 
-def _audit_baseline_summary() -> dict:
-    """Status of the compile-time auditor's committed budgets — metadata
-    only (reading the JSON; never lowering/compiling anything here)."""
-    from dasmtl.analysis.audit.baseline import (DEFAULT_BASELINE_PATH,
-                                                load_baseline)
+#: Every family with a committed baseline: (family, module holding
+#: ``store()``, its CLI).  The consolidated doctor table iterates this
+#: instead of five hand-rolled summaries.
+_BASELINE_REGISTRY = (
+    ("audit", "dasmtl.analysis.audit.baseline", "dasmtl-audit"),
+    ("sanitize", "dasmtl.analysis.sanitize.determinism",
+     "dasmtl-sanitize"),
+    ("conc", "dasmtl.analysis.conc.baseline", "dasmtl-conc"),
+    ("mem", "dasmtl.analysis.mem.baseline", "dasmtl-mem"),
+    ("surface", "dasmtl.analysis.surface.baseline", "dasmtl-surface"),
+)
 
-    path = DEFAULT_BASELINE_PATH
-    try:
-        data = load_baseline(path)
-    except (OSError, ValueError) as exc:
-        return {"path": path, "status": f"unreadable ({exc})"}
-    if data is None:
-        return {"path": path, "status": "missing"}
-    return {"path": path, "status": "ok",
-            "targets": len(data.get("targets", {})),
-            "generated_with": data.get("generated_with", {})}
-
-
-def _determinism_baseline_summary() -> dict:
-    """Status of the sanitizer's committed determinism fingerprints —
-    metadata only, nothing executed."""
-    from dasmtl.analysis.sanitize.determinism import (DEFAULT_BASELINE_PATH,
-                                                      load_baseline)
-
-    path = DEFAULT_BASELINE_PATH
-    try:
-        data = load_baseline(path)
-    except (OSError, ValueError) as exc:
-        return {"path": path, "status": f"unreadable ({exc})"}
-    if data is None:
-        return {"path": path, "status": "missing"}
-    return {"path": path, "status": "ok",
-            "targets": len(data.get("targets", {})),
-            "generated_with": data.get("generated_with", {})}
+#: Payload-count noun per family, for the table's size column.
+_BASELINE_UNITS = {"audit": "target(s)", "sanitize": "cell(s)",
+                   "conc": "edge(s)", "mem": "tier(s)",
+                   "surface": "endpoint(s)"}
 
 
-def _lockorder_baseline_summary() -> dict:
-    """Status of the concurrency suite's committed lock-order graph —
-    metadata only, nothing executed.  ``stale`` means the recording
-    environment drifted (python/jax versions differ from this host):
-    the edges still gate, but regenerate after justifying the bump."""
-    from dasmtl.analysis.conc.baseline import (DEFAULT_BASELINE_PATH,
-                                               _generated_with,
-                                               load_baseline)
+def _baseline_statuses() -> dict:
+    """ok/stale/missing/unreadable for every family's committed
+    baseline, via each family's shared
+    :class:`~dasmtl.analysis.core.baseline.BaselineStore` — metadata
+    only (reading JSON; nothing compiled, extracted, or booted)."""
+    import importlib
 
-    path = DEFAULT_BASELINE_PATH
-    try:
-        data = load_baseline(path)
-    except (OSError, ValueError) as exc:
-        return {"path": path, "status": f"unreadable ({exc})"}
-    if data is None:
-        return {"path": path, "status": "missing"}
-    gen = data.get("generated_with", {})
-    status = "ok" if gen == _generated_with() else "stale"
-    return {"path": path, "status": status,
-            "edges": len(data.get("edges", [])), "generated_with": gen}
-
-
-def _membudget_baseline_summary() -> dict:
-    """Status of the memory suite's committed per-tier footprint budgets
-    — metadata only, nothing executed.  ``stale`` means the recording
-    environment drifted (python/jax versions differ from this host):
-    the budgets still gate, but regenerate after justifying the bump."""
-    from dasmtl.analysis.mem.baseline import (DEFAULT_BASELINE_PATH,
-                                              _generated_with,
-                                              load_baseline)
-
-    path = DEFAULT_BASELINE_PATH
-    try:
-        data = load_baseline(path)
-    except (OSError, ValueError) as exc:
-        return {"path": path, "status": f"unreadable ({exc})"}
-    if data is None:
-        return {"path": path, "status": "missing"}
-    gen = data.get("generated_with", {})
-    status = "ok" if gen == _generated_with() else "stale"
-    return {"path": path, "status": status,
-            "tiers": len(data.get("tiers", {})), "generated_with": gen}
-
-
-def _surface_baseline_summary() -> dict:
-    """Status of the interface-contract suite's committed wire surface
-    — metadata only, nothing extracted or booted here.  ``stale`` means
-    the recording environment drifted (python/jax versions differ from
-    this host): the surface still gates, but regenerate after
-    justifying the bump."""
-    from dasmtl.analysis.surface.baseline import (DEFAULT_BASELINE_PATH,
-                                                  _generated_with,
-                                                  load_baseline)
-
-    path = DEFAULT_BASELINE_PATH
-    try:
-        data = load_baseline(path)
-    except (OSError, ValueError) as exc:
-        return {"path": path, "status": f"unreadable ({exc})"}
-    if data is None:
-        return {"path": path, "status": "missing"}
-    gen = data.get("generated_with", {})
-    status = "ok" if gen == _generated_with() else "stale"
-    surface = data.get("surface", {})
-    return {"path": path, "status": status,
-            "endpoints": sum(len(v) for v in
-                             surface.get("endpoints", {}).values()),
-            "metric_families": len(surface.get("metric_families", [])),
-            "config_fields": len(surface.get("config", {})
-                                 .get("fields", [])),
-            "generated_with": gen}
+    out = {}
+    for family, module, cli in _BASELINE_REGISTRY:
+        st = importlib.import_module(module).store()
+        status = st.status()
+        payload = (status.doc or {}).get(st.payload_key) or {}
+        if family == "surface":
+            size = sum(len(v) for v in payload.get("endpoints",
+                                                   {}).values())
+        else:
+            size = len(payload)
+        out[family] = {
+            "path": status.path,
+            "status": status.state,
+            "detail": status.detail,
+            "size": size,
+            "unit": _BASELINE_UNITS[family],
+            "cli": cli,
+            "generated_with": (status.doc or
+                               {}).get("generated_with", {}),
+        }
+    return out
 
 
 def check_exported_artifact(path: str, window=None,
@@ -506,90 +441,42 @@ def main(argv=None) -> int:
           "(dasmtl-lint; docs/STATIC_ANALYSIS.md)")
     print("  guard defaults: " + ", ".join(
         f"{k}={v}" for k, v in ana.get("guard_defaults", {}).items()))
-    ab = ana.get("audit_baseline", {})
-    if ab.get("status") == "ok":
-        gen = ab.get("generated_with", {})
-        gen_s = ", ".join(f"{k} {v}" for k, v in sorted(gen.items()))
-        print(f"  audit: baseline ok — {ab['targets']} target(s) in "
-              f"{ab['path']}" + (f" (from {gen_s})" if gen_s else "")
-              + "; verify with dasmtl-audit --check-baseline")
-    else:
-        print(f"  audit: baseline {ab.get('status', 'missing')} at "
-              f"{ab.get('path')} — generate with dasmtl-audit "
-              f"--update-baseline --preset full")
     print("  sanitize defaults: " + ", ".join(
         f"{k}={v}" for k, v in ana.get("sanitize_defaults", {}).items()))
-    db = ana.get("determinism_baseline", {})
-    if db.get("status") == "ok":
-        gen = db.get("generated_with", {})
-        gen_s = ", ".join(f"{k} {v}" for k, v in sorted(gen.items()))
-        print(f"  sanitize: determinism baseline ok — {db['targets']} "
-              f"cell(s) in {db['path']}"
-              + (f" (from {gen_s})" if gen_s else "")
-              + "; verify with dasmtl-sanitize --check-baseline")
-    else:
-        print(f"  sanitize: determinism baseline "
-              f"{db.get('status', 'missing')} at {db.get('path')} — "
-              f"generate with dasmtl-sanitize --update-baseline "
-              f"--preset full")
     print("  conc defaults: " + ", ".join(
         f"{k}={v}" for k, v in ana.get("conc_defaults", {}).items()))
-    lb = ana.get("lockorder_baseline", {})
-    if lb.get("status") == "ok":
-        print(f"  conc: lock-order baseline ok — {lb['edges']} edge(s) "
-              f"in {lb['path']}; verify with dasmtl-conc "
-              f"--check-baseline")
-    elif lb.get("status") == "stale":
-        gen = lb.get("generated_with", {})
-        gen_s = ", ".join(f"{k} {v}" for k, v in sorted(gen.items()))
-        print(f"  conc: lock-order baseline STALE — {lb['edges']} "
-              f"edge(s) in {lb['path']} recorded under {gen_s}; edges "
-              f"still gate, refresh with dasmtl-conc --update-baseline "
-              f"after justifying the version bump")
-    else:
-        print(f"  conc: lock-order baseline "
-              f"{lb.get('status', 'missing')} at {lb.get('path')} — "
-              f"generate with dasmtl-conc --update-baseline "
-              f"--preset full")
     print("  mem defaults: " + ", ".join(
         f"{k}={v}" for k, v in ana.get("mem_defaults", {}).items()))
-    mb = ana.get("membudget_baseline", {})
-    if mb.get("status") == "ok":
-        print(f"  mem: membudget baseline ok — {mb['tiers']} tier(s) "
-              f"in {mb['path']}; verify with dasmtl-mem "
-              f"--check-baseline")
-    elif mb.get("status") == "stale":
-        gen = mb.get("generated_with", {})
-        gen_s = ", ".join(f"{k} {v}" for k, v in sorted(gen.items()))
-        print(f"  mem: membudget baseline STALE — {mb['tiers']} "
-              f"tier(s) in {mb['path']} recorded under {gen_s}; budgets "
-              f"still gate, refresh with dasmtl-mem --update-baseline "
-              f"after justifying the version bump")
-    else:
-        print(f"  mem: membudget baseline "
-              f"{mb.get('status', 'missing')} at {mb.get('path')} — "
-              f"generate with dasmtl-mem --update-baseline "
-              f"--preset full")
-    sb = ana.get("surface_baseline", {})
-    if sb.get("status") == "ok":
-        print(f"  surface: wire-surface baseline ok — "
-              f"{sb['endpoints']} endpoint(s), {sb['metric_families']} "
-              f"metric family(ies), {sb['config_fields']} config "
-              f"field(s) in {sb['path']}; verify with dasmtl-surface "
-              f"--check-baseline")
-    elif sb.get("status") == "stale":
-        gen = sb.get("generated_with", {})
-        gen_s = ", ".join(f"{k} {v}" for k, v in sorted(gen.items()))
-        print(f"  surface: wire-surface baseline STALE — "
-              f"{sb['endpoints']} endpoint(s) in {sb['path']} recorded "
-              f"under {gen_s}; the surface still gates, refresh with "
-              f"dasmtl-surface --update-baseline after justifying the "
-              f"version bump")
-    else:
-        print(f"  surface: wire-surface baseline "
-              f"{sb.get('status', 'missing')} at {sb.get('path')} — "
-              f"generate with dasmtl-surface --update-baseline")
+    _print_baseline_table(ana.get("baselines", {}))
     return rc
+
+
+def _print_baseline_table(baselines: dict) -> None:
+    """One table for every family's committed baseline — ok rows say
+    how to verify, stale rows why and how to refresh, missing rows how
+    to generate (replaces five scattered per-family printouts)."""
+    if not baselines:
+        return
+    print("  analysis baselines (verify all at once: dasmtl check; "
+          "docs/STATIC_ANALYSIS.md 'The baseline workflow'):")
+    width = max(len(f) for f in baselines)
+    for family, b in baselines.items():
+        status = b["status"].upper() if b["status"] not in ("ok",) \
+            else b["status"]
+        row = (f"    {family:<{width}}  {status:<10} "
+               f"{b['size']} {b['unit']} in {b['path']}")
+        if b["status"] == "ok":
+            row += f" — verify with {b['cli']} --check-baseline"
+        elif b["status"] == "stale":
+            row += (f" — {b['detail']}; still gates, refresh with "
+                    f"{b['cli']} --update-baseline after justifying "
+                    f"the version bump")
+        else:
+            if b["detail"]:
+                row += f" — {b['detail']}"
+            row += (f" — generate with {b['cli']} --update-baseline "
+                    f"and commit the diff")
+        print(row)
 
 
 if __name__ == "__main__":

@@ -1,14 +1,60 @@
 #!/usr/bin/env bash
-# One-shot local lint: the JAX-aware dasmtl linter plus (when installed)
-# the ruff subset from pyproject.toml.  Mirrors the CI lint job
+# One-shot local lint: the unified analysis engine (every dasmtl analysis
+# family through one process plan) plus (when installed) the ruff subset
+# from pyproject.toml and the runtime smokes.  Mirrors the CI jobs
 # (.github/workflows/ci.yml); docs/STATIC_ANALYSIS.md documents the rules.
+#
+# Skip legs with one comma-separated list:
+#
+#   DASMTL_LINT_SKIP=audit,conc,serve scripts/lint_all.sh
+#
+# Legs: lint failpath surface conc mem audit sanitize (analysis families,
+# routed through `dasmtl check`) + serve router parity loader obs stream
+# (runtime smokes).  The old per-leg DASMTL_LINT_SKIP_<LEG>=1 variables
+# still work but are deprecated.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 rc=0
 
-echo "== dasmtl-lint dasmtl/ (+ unused-noqa report)"
-python -m dasmtl.analysis.lint --report-unused-noqa dasmtl/ || rc=1
+# skip LEG -> exit 0 (skip) / 1 (run).  Honors the DASMTL_LINT_SKIP list
+# and the deprecated per-leg variables, with a note for the latter.
+skips=",${DASMTL_LINT_SKIP:-},"
+skip() {
+    local leg="$1"
+    local legacy
+    legacy="DASMTL_LINT_SKIP_$(echo "$leg" | tr '[:lower:]' '[:upper:]')"
+    case "$skips" in
+        *",$leg,"*) return 0 ;;
+    esac
+    if [ -n "${!legacy:-}" ]; then
+        echo "== note: $legacy is deprecated — use DASMTL_LINT_SKIP=$leg"
+        return 0
+    fi
+    return 1
+}
+
+# Analysis families route through the unified engine: one process plan
+# (cheap static rules first, compile-heavy baselines last), merged
+# findings, one exit code (docs/STATIC_ANALYSIS.md 'The check engine').
+# The quick preset matches what this script always ran locally — audit
+# compiles the one sharded MTL config (~40 s cold), sanitize runs the one
+# dp2-sharded cell, conc/mem run their self-tests plus the quick baseline
+# gate, surface its self-test plus the static gate; CI's matrixed
+# analysis job runs the wider ci preset per family.
+only=""
+for fam in lint failpath surface conc mem audit sanitize; do
+    if skip "$fam"; then
+        echo "== analysis family $fam skipped (DASMTL_LINT_SKIP)"
+    else
+        only="$only,$fam"
+    fi
+done
+only="${only#,}"
+if [ -n "$only" ]; then
+    echo "== dasmtl check --preset quick --only $only"
+    python -m dasmtl.analysis.core --preset quick --only "$only" || rc=1
+fi
 
 if command -v ruff >/dev/null 2>&1; then
     echo "== ruff check"
@@ -17,89 +63,26 @@ else
     echo "== ruff not installed here; skipped (CI runs it — pip install ruff)"
 fi
 
-# Compile-time audit against the committed budgets.  `quick` compiles the
-# one sharded MTL config (~40 s — always a cold compile: the auditor
-# disables the persistent cache because deserialized executables lose
-# their aliasing table); CI's audit job runs the wider `ci` preset.
-if [ "${DASMTL_LINT_SKIP_AUDIT:-}" = "" ]; then
-    echo "== dasmtl-audit --check-baseline --preset quick"
-    python -m dasmtl.analysis.audit --check-baseline --preset quick || rc=1
-else
-    echo "== dasmtl-audit skipped (DASMTL_LINT_SKIP_AUDIT set)"
-fi
-
-# Runtime sanitizer smoke against the committed determinism baseline.
-# `quick` runs the one dp2-sharded cell (divergence + determinism in a
-# single seeded run); CI's sanitize job runs the wider `ci` preset plus
-# the fault-injection self-test.
-if [ "${DASMTL_LINT_SKIP_SANITIZE:-}" = "" ]; then
-    echo "== dasmtl-sanitize --check-baseline --preset quick"
-    python -m dasmtl.analysis.sanitize --check-baseline --preset quick || rc=1
-else
-    echo "== dasmtl-sanitize skipped (DASMTL_LINT_SKIP_SANITIZE set)"
-fi
-
-# Concurrency suite: the fault-injection self-test (pure threading + AST,
-# no model compiles — cheap), then the lock-order baseline gate on the
-# `quick` preset (one serve selftest with lockdep armed).  CI's conc job
-# runs the wider `ci` preset plus standalone lockdep-armed selftests.
-if [ "${DASMTL_LINT_SKIP_CONC:-}" = "" ]; then
-    echo "== dasmtl-conc --self-test"
-    python -m dasmtl.analysis.conc --self-test || rc=1
-    echo "== dasmtl-conc --check-baseline --preset quick"
-    python -m dasmtl.analysis.conc --check-baseline --preset quick || rc=1
-else
-    echo "== dasmtl-conc skipped (DASMTL_LINT_SKIP_CONC set)"
-fi
-
-# Memory-discipline suite: the fault-injection self-test (fake buffers +
-# AST snippet, no model compiles — cheap), then the membudget baseline
-# gate on the `quick` preset (one leasedep-armed train exercise).  CI's
-# mem job runs the wider `ci` preset plus standalone DASMTL_MEM_TRACK=1
-# serve/stream selftests.
-if [ "${DASMTL_LINT_SKIP_MEM:-}" = "" ]; then
-    echo "== dasmtl-mem --self-test"
-    python -m dasmtl.analysis.mem --self-test || rc=1
-    echo "== dasmtl-mem --check-baseline --preset quick"
-    python -m dasmtl.analysis.mem --check-baseline --preset quick || rc=1
-else
-    echo "== dasmtl-mem skipped (DASMTL_LINT_SKIP_MEM set)"
-fi
-
-# Interface-contract suite: the fault-injection self-test (AST snippets
-# + pure fixtures, no model compiles — cheap), then the wire-surface
-# baseline gate (pure static extraction — cheap).  The per-handler
-# rules DAS501-DAS505 already ran under dasmtl-lint above; CI's
-# surface job adds the live probe (boots the real front ends).
-if [ "${DASMTL_LINT_SKIP_SURFACE:-}" = "" ]; then
-    echo "== dasmtl-surface --self-test"
-    python -m dasmtl.analysis.surface --self-test || rc=1
-    echo "== dasmtl-surface --check-baseline"
-    python -m dasmtl.analysis.surface --check-baseline || rc=1
-else
-    echo "== dasmtl-surface skipped (DASMTL_LINT_SKIP_SURFACE set)"
-fi
-
 # Online-serving smoke: the in-process selftest (concurrent clients, NaN
 # poisoning, SIGTERM drain, recompile/occupancy invariants) on a reduced
 # window — a few model compiles, so skippable for doc-only edits.
 # CI's serve job runs this plus the bench_serve.py --smoke leg.
-if [ "${DASMTL_LINT_SKIP_SERVE:-}" = "" ]; then
+if skip serve; then
+    echo "== dasmtl serve selftest skipped (DASMTL_LINT_SKIP)"
+else
     echo "== dasmtl serve --selftest"
     python -m dasmtl.serve --selftest || rc=1
-else
-    echo "== dasmtl serve selftest skipped (DASMTL_LINT_SKIP_SERVE set)"
 fi
 
 # Router-tier smoke: 2 real replica processes behind a real router,
 # blue/green rollout + SIGKILL under load (dasmtl/serve/router.py,
 # docs/SERVING.md "Router tier").  Spawns subprocesses and compiles two
 # replicas' buckets, so skippable alongside the serve smoke.
-if [ "${DASMTL_LINT_SKIP_ROUTER:-}" = "" ]; then
+if skip router; then
+    echo "== router selftest skipped (DASMTL_LINT_SKIP)"
+else
     echo "== dasmtl router --selftest"
     python -m dasmtl.serve.router --selftest || rc=1
-else
-    echo "== router selftest skipped (DASMTL_LINT_SKIP_ROUTER set)"
 fi
 
 # Precision parity gate: both reduced serving presets vs the f32
@@ -107,44 +90,44 @@ fi
 # log-prob tolerance, NaN-mask identity — dasmtl/serve/parity.py).
 # CI's serve job runs the same gate; a few model compiles, so
 # skippable alongside the serve smoke for doc-only edits.
-if [ "${DASMTL_LINT_SKIP_PARITY:-}" = "" ]; then
+if skip parity; then
+    echo "== serve parity check skipped (DASMTL_LINT_SKIP)"
+else
     echo "== dasmtl serve --parity-check (bf16 + int8)"
     python -m dasmtl.serve --parity-check --window 52x64 \
         --parity_windows 128 || rc=1
-else
-    echo "== serve parity check skipped (DASMTL_LINT_SKIP_PARITY set)"
 fi
 
 # Training-loader smoke: staged-pipeline invariants (worker-determinism,
 # staging bounds, guarded short train run) on a small synthetic tree.
 # CI's loader job runs the same leg after building the native extension.
-if [ "${DASMTL_LINT_SKIP_LOADER:-}" = "" ]; then
+if skip loader; then
+    echo "== bench_loader smoke skipped (DASMTL_LINT_SKIP)"
+else
     echo "== bench_loader --smoke"
     python scripts/bench_loader.py --smoke || rc=1
-else
-    echo "== bench_loader smoke skipped (DASMTL_LINT_SKIP_LOADER set)"
 fi
 
 # Observability smoke: guarded 2-epoch train with the heartbeat enabled —
 # every heartbeat line must parse against the committed schema and carry
 # a finite MFU in (0, 1] from the audit cost model (dasmtl/obs/,
 # docs/OBSERVABILITY.md).  CI's obs job runs the same leg.
-if [ "${DASMTL_LINT_SKIP_OBS:-}" = "" ]; then
+if skip obs; then
+    echo "== obs smoke skipped (DASMTL_LINT_SKIP)"
+else
     echo "== obs_smoke (guarded train + heartbeat)"
     python scripts/obs_smoke.py || rc=1
-else
-    echo "== obs smoke skipped (DASMTL_LINT_SKIP_OBS set)"
 fi
 
 # Streaming soak: the live tier's selftest — planted events through the
 # oracle-backed serve plane, fairness isolation, track recovery, 0
 # post-warmup recompiles (dasmtl/stream/, docs/STREAMING.md).  CI's
 # stream job runs this on 1 and 2 virtual devices plus the bench soak.
-if [ "${DASMTL_LINT_SKIP_STREAM:-}" = "" ]; then
+if skip stream; then
+    echo "== stream soak selftest skipped (DASMTL_LINT_SKIP)"
+else
     echo "== dasmtl stream serve --selftest"
     python -m dasmtl.stream serve --selftest || rc=1
-else
-    echo "== stream soak selftest skipped (DASMTL_LINT_SKIP_STREAM set)"
 fi
 
 exit $rc

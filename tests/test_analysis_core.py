@@ -1,0 +1,370 @@
+"""The shared analysis core (dasmtl/analysis/core/): BaselineStore
+parity against every committed baseline, the FaultHarness leg/clean
+contract, SARIF 2.1.0 output held to a schema, the finding normalizer,
+and the check engine's pure pieces (family mapping, JSON-tail parsing,
+CLI seams).  Nothing here compiles a model or talks to jax — the
+subprocess families are covered by their own suites and by CI's
+matrixed `dasmtl check --only FAMILY --preset ci` legs."""
+
+import importlib
+import json
+import os
+import shutil
+
+import pytest
+
+from dasmtl.analysis.core.baseline import (BaselineStore, merge_replace,
+                                           merge_union_pairs,
+                                           merge_update)
+from dasmtl.analysis.core.harness import FaultHarness
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- BaselineStore vs every committed baseline --------------------------------
+
+#: family -> the module exposing its store() (the same registry doctor
+#: renders; duplicated literally so a registry typo cannot hide).
+STORE_MODULES = {
+    "audit": "dasmtl.analysis.audit.baseline",
+    "sanitize": "dasmtl.analysis.sanitize.determinism",
+    "conc": "dasmtl.analysis.conc.baseline",
+    "mem": "dasmtl.analysis.mem.baseline",
+    "surface": "dasmtl.analysis.surface.baseline",
+}
+
+
+def _stores():
+    for family, module in STORE_MODULES.items():
+        yield family, importlib.import_module(module).store()
+
+
+def test_every_committed_baseline_loads_through_its_store():
+    """The migration onto BaselineStore must read the committed
+    artifacts unchanged: every file loads, carries its payload under
+    the store's payload_key, and is never missing/unreadable."""
+    for family, st in _stores():
+        doc = st.load()
+        assert doc is not None, f"{family}: {st.path} missing"
+        assert doc.get(st.payload_key), (
+            f"{family}: no {st.payload_key!r} payload in {st.path}")
+        status = st.status()
+        assert status.state in ("ok", "stale"), (
+            f"{family}: {status.state} ({status.detail})")
+
+
+@pytest.mark.parametrize("family", sorted(STORE_MODULES))
+def test_update_round_trip_preserves_payload_and_comment(family,
+                                                         tmp_path):
+    """Re-updating a copy of the committed baseline with its own
+    payload is the identity on the payload, and a hand-edited comment
+    survives the rewrite (the reviewed prose is part of the baseline,
+    not tool output)."""
+    committed = importlib.import_module(STORE_MODULES[family]).store()
+    path = str(tmp_path / os.path.basename(committed.path))
+    shutil.copy(committed.path, path)
+    st = BaselineStore(path, payload_key=committed.payload_key,
+                       default_comment=committed.default_comment,
+                       merge=committed.merge,
+                       stamp_python=committed.stamp_python)
+    original = st.load()
+
+    # Hand-edit the comment the way a reviewer would.
+    edited = dict(original)
+    edited["comment"] = "reviewed by a human; keep me"
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(edited, f)
+
+    doc = st.update(original[st.payload_key])
+    assert doc[st.payload_key] == original[st.payload_key]
+    assert doc["comment"] == "reviewed by a human; keep me"
+    reread = st.load()
+    assert reread[st.payload_key] == original[st.payload_key]
+    assert set(doc["generated_with"]) == set(st.current_stamp())
+
+
+def test_merge_strategies():
+    assert merge_replace({"a": 1}, {"b": 2}) == {"b": 2}
+    # Dict-update: measured entries overwrite, unexercised survive.
+    assert merge_update({"a": 1, "b": 2}, {"b": 3}) == {"a": 1, "b": 3}
+    assert merge_update(None, {"b": 3}) == {"b": 3}
+    # Pair-union: observations accumulate, sorted and deduplicated.
+    assert merge_union_pairs([["a", "b"]], [["a", "b"], ["b", "c"]]) \
+        == [["a", "b"], ["b", "c"]]
+    assert merge_union_pairs(None, [["b", "c"], ["a", "b"]]) \
+        == [["a", "b"], ["b", "c"]]
+
+
+def test_status_verdicts(tmp_path):
+    st = BaselineStore(str(tmp_path / "b.json"), payload_key="edges",
+                       default_comment="c")
+    assert st.status().state == "missing"
+
+    st.update([["a", "b"]])
+    assert st.status().state == "ok"
+
+    doc = st.load()
+    doc["generated_with"]["jax"] = "0.0.0-from-another-era"
+    with open(st.path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    status = st.status()
+    assert status.state == "stale"
+    assert "jax 0.0.0-from-another-era" in status.detail
+
+    with open(st.path, "w", encoding="utf-8") as f:
+        f.write("{not json")
+    assert st.status().state == "unreadable"
+
+
+# -- FaultHarness contract ----------------------------------------------------
+
+def test_harness_green_when_every_leg_catches_and_stays_silent():
+    injected = []
+
+    def inject(fault):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            injected.append(fault)
+            yield
+            injected.remove(fault)
+        return cm()
+
+    h = FaultHarness("toy", inject=inject, verbose=False)
+    h.leg("f1", "TOY001",
+          lambda: ["TOY001"] if "f1" in injected else [])
+    h.leg("f2", "TOY002",
+          lambda: ["TOY002"] if "f2" in injected else [])
+    assert h.run() == []
+
+
+def test_harness_reports_missed_fault_and_overfiring_clean():
+    h = FaultHarness("toy", verbose=False)
+    h.leg("missed", "TOY001", lambda: [])          # never fires
+    h.leg("overfire", "TOY002", lambda: ["TOY002"])  # always fires
+    found = h.run()
+    assert [f["id"] for f in found] == ["TOY001", "TOY002"]
+    assert "NOT caught" in found[0]["message"]
+    assert "over-fires" in found[1]["message"]
+    assert all(f["severity"] == "error" for f in found)
+
+
+def test_harness_clean_check_and_note_prefix(capsys):
+    h = FaultHarness("toy", verbose=True)
+    h.leg("f", "TOY001",
+          lambda: ["TOY001"],
+          inject=None,  # falls back to a nullcontext
+          clean_check=lambda ids: None)
+    # The dirty and clean passes are identical here, so the clean pass
+    # over-fires; clean_check returning a problem adds a second miss.
+    h2 = FaultHarness("toy2", verbose=False)
+    h2.leg("f", "TOY001", lambda: [],
+           clean_check=lambda ids: "tracker silent")
+    found = h2.run()
+    assert any("tracker silent" in f["message"] for f in found)
+    h.run()
+    out = capsys.readouterr().out
+    assert "[toy-self-test]" in out
+
+
+# -- SARIF + finding normalization --------------------------------------------
+
+#: The structural core of SARIF 2.1.0 this repo relies on — enough for
+#: jsonschema to fail on a malformed document (the full OASIS schema is
+#: a network fetch this container does not make).
+_SARIF_CORE_SCHEMA = {
+    "type": "object",
+    "required": ["version", "runs"],
+    "properties": {
+        "version": {"const": "2.1.0"},
+        "runs": {
+            "type": "array", "minItems": 1,
+            "items": {
+                "type": "object",
+                "required": ["tool", "results"],
+                "properties": {
+                    "tool": {
+                        "type": "object", "required": ["driver"],
+                        "properties": {"driver": {
+                            "type": "object", "required": ["name"],
+                            "properties": {
+                                "name": {"type": "string"},
+                                "rules": {"type": "array", "items": {
+                                    "type": "object",
+                                    "required": ["id"]}},
+                            }}},
+                    },
+                    "results": {"type": "array", "items": {
+                        "type": "object",
+                        "required": ["ruleId", "message", "level"],
+                        "properties": {
+                            "message": {"type": "object",
+                                        "required": ["text"]},
+                            "level": {"enum": ["error", "warning",
+                                               "note"]},
+                            "locations": {"type": "array", "items": {
+                                "type": "object", "properties": {
+                                    "physicalLocation": {
+                                        "type": "object",
+                                        "required":
+                                            ["artifactLocation"]},
+                                }}},
+                        }}},
+                },
+            },
+        },
+    },
+}
+
+
+def _sample_findings():
+    return [
+        {"family": "failpath", "id": "DAS601", "severity": "error",
+         "message": "blocking call", "path": "dasmtl/serve/router.py",
+         "line": 12, "col": 4},
+        {"family": "audit", "id": "AUD105", "severity": "error",
+         "message": "budget", "target": "mtl_dp2"},
+        {"family": "failpath", "id": "DAS605", "severity": "warning",
+         "message": "finally cleanup"},
+    ]
+
+
+def test_sarif_document_validates_and_indexes_rules():
+    import jsonschema
+
+    from dasmtl.analysis.core.findings import sarif_document
+
+    doc = sarif_document(_sample_findings())
+    jsonschema.validate(doc, _SARIF_CORE_SCHEMA)
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "dasmtl-check"
+    assert len(run["results"]) == 3
+    rule_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert rule_ids == ["DAS601", "AUD105", "DAS605"]
+    for result in run["results"]:
+        assert rule_ids[result["ruleIndex"]] == result["ruleId"]
+    # File findings carry a physical location (1-indexed column), the
+    # audit target a logical one.
+    das601 = run["results"][0]["locations"][0]["physicalLocation"]
+    assert das601["artifactLocation"]["uri"] == "dasmtl/serve/router.py"
+    assert das601["region"] == {"startLine": 12, "startColumn": 5}
+    aud = run["results"][1]["locations"][0]["logicalLocations"]
+    assert aud == [{"name": "mtl_dp2", "kind": "member"}]
+
+
+def test_write_sarif_round_trips(tmp_path):
+    from dasmtl.analysis.core.findings import write_sarif
+
+    path = str(tmp_path / "out.sarif")
+    write_sarif(_sample_findings(), path)
+    with open(path, encoding="utf-8") as f:
+        assert json.load(f)["version"] == "2.1.0"
+
+
+def test_normalize_finding_folds_all_three_dialects():
+    from dasmtl.analysis.core.findings import normalize_finding
+    from dasmtl.analysis.lint import lint_source
+
+    lint = lint_source("import jax\n\n@jax.jit\ndef f(x):\n"
+                       "    assert x > 0\n    return x\n",
+                       "dasmtl/ops/snippet.py")[0]
+    n = normalize_finding(lint, "lint")
+    assert n["family"] == "lint" and n["id"] == lint.rule
+    assert n["path"] == "dasmtl/ops/snippet.py" and n["line"] > 0
+
+    n = normalize_finding({"id": "CONC401", "severity": "error",
+                           "message": "cycle"}, "conc")
+    assert n == {"family": "conc", "id": "CONC401",
+                 "severity": "error", "message": "cycle"}
+
+
+def test_render_github_escapes_and_locates():
+    from dasmtl.analysis.core.findings import render_github
+
+    line = render_github({"family": "failpath", "id": "DAS601",
+                          "severity": "error", "message": "a\nb%c",
+                          "path": "dasmtl/serve/x.py", "line": 3,
+                          "col": 0})
+    assert line.startswith("::error file=dasmtl/serve/x.py,line=3,")
+    assert "%0A" in line and "%25" in line and "\n" not in line
+
+
+# -- the check engine's pure pieces -------------------------------------------
+
+def test_affected_families_mapping():
+    from dasmtl.analysis.core.engine import FAMILIES, affected_families
+
+    # Docs/scripts/CI config affect nothing.
+    assert affected_families(["docs/SERVING.md", "scripts/bench.py",
+                              ".github/workflows/ci.yml"]) == []
+    # A fleet-tier source file: static rules + the runtime families
+    # that exercise the fleet, never the compile-side ones.
+    assert affected_families(["dasmtl/serve/server.py"]) == \
+        ["lint", "failpath", "surface", "conc", "mem"]
+    # Model code: lint + the compile/runtime numeric families.
+    assert affected_families(["dasmtl/models/unet.py"]) == \
+        ["lint", "audit", "sanitize"]
+    # The crash wrapper is failpath's own helper.
+    assert affected_families(["dasmtl/utils/threads.py"]) == \
+        ["lint", "failpath"]
+    # A committed baseline re-gates exactly its family.
+    assert affected_families(["artifacts/lockorder_baseline.json"]) == \
+        ["conc"]
+    # Anything under the shared core invalidates every family.
+    assert affected_families(["dasmtl/analysis/core/engine.py"]) == \
+        list(FAMILIES)
+    assert affected_families(["pyproject.toml"]) == list(FAMILIES)
+
+
+def test_parse_json_tail_takes_last_line():
+    from dasmtl.analysis.core.engine import _parse_json_tail
+
+    assert _parse_json_tail(
+        "exercise chatter\nmore\n{\"findings\": []}\n") \
+        == {"findings": []}
+    assert _parse_json_tail("no json here") is None
+    assert _parse_json_tail("") is None
+
+
+def test_engine_self_test_is_green():
+    """Every planted DAS601-605 fault is caught and every clean
+    variant stays silent — the engine's own family proves itself the
+    way the six others do."""
+    from dasmtl.analysis.core.engine import self_test
+
+    assert self_test(verbose=False) == []
+
+
+def test_static_families_run_clean_on_this_tree():
+    """lint + failpath (the in-process families) over the committed
+    tree: exit 0, no findings — the tree the engine ships in passes
+    its own engine."""
+    from dasmtl.analysis.core.engine import run_check
+
+    codes, findings = run_check(["lint", "failpath"], "ci")
+    assert codes == {"lint": 0, "failpath": 0}
+    assert findings == []
+
+
+def test_cli_only_validates_family_names(capsys):
+    from dasmtl.analysis.core.engine import main
+
+    with pytest.raises(SystemExit):
+        main(["--only", "bogus"])
+    assert "bogus" in capsys.readouterr().err
+
+    assert main(["--list-families"]) == 0
+    out = capsys.readouterr().out
+    for family in ("lint", "failpath", "surface", "conc", "mem",
+                   "audit", "sanitize"):
+        assert family in out
+
+
+def test_cli_json_format_reports_family_codes(capsys):
+    from dasmtl.analysis.core.engine import main
+
+    assert main(["--only", "failpath", "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["families"] == {"failpath": 0}
+    assert doc["findings"] == []

@@ -1,0 +1,416 @@
+"""Failure-path rules (DAS601-605, dasmtl/analysis/rules/failpath.py):
+every rule id has a positive snippet it must flag and a negative
+near-miss it must NOT flag, anchored in the fleet dirs the rules
+govern.  Plus the regressions the rules' first sweep fixed in the real
+fleet code (bounded waits, crash_logged thread wiring, recorded
+teardown) and the fleet-wide noqa inventory pin.  Pure AST — no jax
+execution, fast."""
+
+import os
+
+from dasmtl.analysis.lint import lint_paths, lint_source
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: The long-running tiers DAS601-605 govern (mirrors failpath.py).
+FLEET_DIRS = [os.path.join(ROOT, "dasmtl", d)
+              for d in ("serve", "stream", "obs")]
+
+FAILPATH_RULES = ["DAS601", "DAS602", "DAS603", "DAS604", "DAS605"]
+
+
+def ids(src: str, path: str = "dasmtl/serve/snippet.py"):
+    return sorted({f.rule for f in lint_source(src, path)})
+
+
+# -- DAS601: blocking call with no timeout -----------------------------------
+
+_DAS601_POS = """
+import queue
+import subprocess
+import threading
+import urllib.request
+
+def drain(proc_args):
+    stop = threading.Event()
+    q = queue.Queue()
+    worker = threading.Thread(target=print, daemon=True)
+    stop.wait()                      # no timeout: wedges forever
+    q.get()                          # ditto
+    worker.join()                    # ditto
+    urllib.request.urlopen("http://peer/healthz")
+    subprocess.run(proc_args)
+"""
+
+_DAS601_NEG = """
+import queue
+import subprocess
+import threading
+import urllib.request
+
+def drain(proc_args, unknown):
+    stop = threading.Event()
+    q = queue.Queue()
+    worker = threading.Thread(target=print, daemon=True)
+    while not stop.wait(timeout=1.0):
+        pass
+    q.get(timeout=0.5)
+    q.get(block=False)
+    worker.join(5.0)
+    urllib.request.urlopen("http://peer/healthz", timeout=5.0)
+    subprocess.run(proc_args, timeout=30.0)
+    unknown.wait()                   # unknown receiver: clean
+"""
+
+_DAS601_SOCKET_POS = """
+import socket
+
+def pump():
+    sock = socket.socket()
+    return sock.recv(4096)           # no settimeout in this module
+"""
+
+_DAS601_SOCKET_NEG = """
+import socket
+
+def pump():
+    sock = socket.socket()
+    sock.settimeout(5.0)
+    return sock.recv(4096)
+"""
+
+
+def test_das601_flags_unbounded_blocking_calls():
+    found = [f for f in lint_source(_DAS601_POS,
+                                    "dasmtl/serve/snippet.py")
+             if f.rule == "DAS601"]
+    assert len(found) == 5, "\n".join(f.render() for f in found)
+
+
+def test_das601_allows_bounded_and_unknown_receivers():
+    assert "DAS601" not in ids(_DAS601_NEG)
+
+
+def test_das601_socket_needs_module_level_settimeout():
+    assert "DAS601" in ids(_DAS601_SOCKET_POS)
+    assert "DAS601" not in ids(_DAS601_SOCKET_NEG)
+
+
+def test_das601_message_points_at_operations_doc():
+    found = [f for f in lint_source(_DAS601_POS,
+                                    "dasmtl/stream/snippet.py")
+             if f.rule == "DAS601" and "urlopen" in f.message]
+    assert found and "timeout budgets" in found[0].message
+
+
+def test_das601_scoped_to_fleet_dirs_only():
+    assert "DAS601" not in ids(_DAS601_POS, "dasmtl/train/loop.py")
+
+
+# -- DAS602: swallowed exception ---------------------------------------------
+
+_DAS602_POS = """
+def poll(source):
+    try:
+        source.step()
+    except Exception:
+        pass                         # the failure vanishes
+"""
+
+_DAS602_NEG = """
+def poll(source, errors, log):
+    try:
+        source.step()
+    except Exception as exc:
+        errors.append(exc)           # recorded: clean
+    try:
+        source.step()
+    except Exception as exc:
+        log.warning("step failed: %s", exc)
+    try:
+        source.step()
+    except ValueError:
+        pass                         # narrow handler: not DAS602's ask
+"""
+
+
+def test_das602_flags_silent_broad_handler():
+    assert "DAS602" in ids(_DAS602_POS)
+
+
+def test_das602_allows_recording_and_narrow_handlers():
+    assert "DAS602" not in ids(_DAS602_NEG)
+
+
+# -- DAS603: thread target that can die silently ------------------------------
+
+_DAS603_POS = """
+import threading
+
+def pump(source):
+    while True:
+        source.step()                # raises -> thread dies silently
+
+def start(source):
+    t = threading.Thread(target=pump, args=(source,), daemon=True)
+    t.start()
+    return t
+"""
+
+_DAS603_NEG_GUARDED = """
+import threading
+
+def pump(source):
+    try:
+        while True:
+            source.step()
+    except Exception as exc:
+        source.crash = exc           # recorded by assignment
+
+def start(source):
+    t = threading.Thread(target=pump, args=(source,), daemon=True)
+    t.start()
+    return t
+"""
+
+_DAS603_NEG_WRAPPED = """
+import threading
+
+from dasmtl.utils.threads import crash_logged
+
+def pump(source):
+    while True:
+        source.step()
+
+def start(source):
+    t = threading.Thread(target=crash_logged(pump, "pump"),
+                         args=(source,), daemon=True)
+    t.start()
+    return t
+"""
+
+
+def test_das603_flags_unguarded_thread_target():
+    assert "DAS603" in ids(_DAS603_POS)
+
+
+def test_das603_allows_broad_try_with_recording():
+    assert "DAS603" not in ids(_DAS603_NEG_GUARDED)
+
+
+def test_das603_wrapper_factory_target_is_exempt():
+    assert "DAS603" not in ids(_DAS603_NEG_WRAPPED)
+
+
+# -- DAS604: unbounded retry loop ---------------------------------------------
+
+_DAS604_POS = """
+import time
+
+def forward(sock, payload):
+    while True:
+        try:
+            sock.sendall(payload)
+            return
+        except Exception:
+            time.sleep(1.0)          # retries a dead peer forever
+"""
+
+_DAS604_NEG = """
+import time
+
+def forward(sock, payload):
+    for _attempt in range(5):
+        try:
+            sock.sendall(payload)
+            return
+        except Exception:
+            time.sleep(1.0)
+    raise RuntimeError("peer unreachable after 5 attempts")
+
+def forward_bounded(sock, payload):
+    while True:
+        try:
+            sock.sendall(payload)
+            return
+        except Exception:
+            raise                     # escalates: bounded
+"""
+
+
+def test_das604_flags_unbounded_transport_retry():
+    assert "DAS604" in ids(_DAS604_POS)
+
+
+def test_das604_allows_capped_or_escalating_retry():
+    assert "DAS604" not in ids(_DAS604_NEG)
+
+
+# -- DAS605: finally cleanup that can raise past the drain --------------------
+
+_DAS605_POS = """
+def close(self):
+    try:
+        self.drain()
+    finally:
+        self.sock.close()            # raising here skips the sink
+        self.sink.close()
+"""
+
+_DAS605_NEG = """
+def close(self, failures):
+    try:
+        self.drain()
+    finally:
+        try:
+            self.sock.close()
+        except Exception as exc:
+            failures.append(exc)
+        try:
+            self.sink.close()
+        except Exception as exc:
+            failures.append(exc)
+"""
+
+_DAS605_NON_DRAIN = """
+def render(self):
+    try:
+        self.fmt()
+    finally:
+        self.buf.flush()             # not a drain/close path
+"""
+
+
+def test_das605_flags_bare_cleanup_on_drain_path():
+    found = [f for f in lint_source(_DAS605_POS,
+                                    "dasmtl/serve/snippet.py")
+             if f.rule == "DAS605"]
+    assert len(found) == 2
+    assert all(f.severity == "warning" for f in found)
+
+
+def test_das605_individually_wrapped_cleanup_is_clean():
+    assert "DAS605" not in ids(_DAS605_NEG)
+
+
+def test_das605_ignores_non_drain_paths():
+    assert "DAS605" not in ids(_DAS605_NON_DRAIN)
+
+
+# -- fleet regressions: the first sweep's fixes stay fixed --------------------
+
+def test_fleet_packages_clean_under_failpath_rules():
+    """dasmtl/serve, /stream, /obs carry ZERO DAS601-605 findings and
+    ZERO DAS6xx suppressions — the first failpath sweep fixed its
+    findings for real (bounded stop-waits, crash_logged thread
+    wiring, recorded teardown) rather than suppressing them."""
+    findings = [f for f in lint_paths(FLEET_DIRS, select=FAILPATH_RULES)
+                if f.rule in FAILPATH_RULES]
+    assert findings == [], "\n".join(f.render() for f in findings)
+    from dasmtl.analysis.lint import iter_python_files
+
+    for py in iter_python_files(FLEET_DIRS):
+        with open(py, encoding="utf-8") as f:
+            assert "noqa[DAS6" not in f.read(), (
+                f"{py}: failpath findings must be fixed, not suppressed")
+
+
+def test_fleet_noqa_inventory_is_pinned():
+    """Every remaining suppression in the fleet tiers, count-pinned per
+    rule.  A new noqa must move this table in the same PR that
+    documents why the suppression is legal (docs/STATIC_ANALYSIS.md
+    'Suppressions')."""
+    import re
+
+    from dasmtl.analysis.lint import iter_python_files
+
+    counts = {}
+    for py in iter_python_files(FLEET_DIRS):
+        with open(py, encoding="utf-8") as f:
+            for rule_id in re.findall(r"dasmtl: noqa\[(DAS\d{3})\]",
+                                      f.read()):
+                counts[rule_id] = counts.get(rule_id, 0) + 1
+    assert counts == {
+        "DAS111": 2,  # the two designated D2H sync points (serve
+                      # executor.collect, stream cycle collector)
+        "DAS301": 2,  # benign-race singletons: server SLO-check stamp,
+                      # alert-engine per-rule state insert
+        "DAS402": 1,  # server submit: acquire outside the staging lease
+                      # helper, released on the completion path
+        "DAS403": 1,  # server submit: the handle crosses threads to the
+                      # collector, which owns the release
+        "DAS502": 1,  # alert selftest's seeded gauge — a fixture
+                      # family, never scraped
+        "DAS504": 5,  # terminal 400/504 replies — clients dispatch on
+                      # status, not on a refusal payload key
+    }, counts
+
+
+def test_router_stop_wait_is_bounded():
+    """serve/router.py regression: the rollout stop-event wait is a
+    bounded loop (DAS601's fix), not a bare Event.wait()."""
+    with open(os.path.join(ROOT, "dasmtl", "serve", "router.py"),
+              encoding="utf-8") as f:
+        src = f.read()
+    assert "stop.wait(timeout=" in src
+    found = [f for f in lint_paths(
+        [os.path.join(ROOT, "dasmtl", "serve", "router.py")],
+        select=["DAS601"])]
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def test_fleet_threads_ride_crash_logged():
+    """DAS603's fix: every fleet tier constructs its worker threads
+    through dasmtl.utils.threads.crash_logged, so a crashing body is
+    recorded instead of dying silently."""
+    for rel in ("serve/router.py", "serve/server.py", "stream/live.py",
+                "stream/resident.py", "obs/alerts.py", "obs/history.py",
+                "obs/profiler.py"):
+        with open(os.path.join(ROOT, "dasmtl", rel),
+                  encoding="utf-8") as f:
+            assert "crash_logged" in f.read(), (
+                f"dasmtl/{rel}: thread targets must be wrapped in "
+                f"crash_logged")
+
+
+def test_crash_logged_records_and_reraises_nothing():
+    """The wrapper the fleet fixes ride: the wrapped callable's crash
+    is recorded (stderr + optional on_crash hook), never propagated
+    out of the thread, and a clean run passes through untouched."""
+    from dasmtl.utils.threads import crash_logged
+
+    seen = []
+    wrapped = crash_logged(lambda: (_ for _ in ()).throw(
+        RuntimeError("boom")), "test-leg", on_crash=seen.append)
+    wrapped()  # must not raise
+    assert len(seen) == 1 and "boom" in str(seen[0])
+
+    ok = []
+    crash_logged(lambda: ok.append("ran"), "test-leg")()
+    assert ok == ["ran"]
+
+
+def test_das301_sees_through_crash_logged_wrapper():
+    """concurrency-rule regression: wrapping a thread target in a
+    factory call (target=crash_logged(f, ...)) must NOT blind
+    DAS301-305 to the target's body — the wrapper still runs it on
+    the spawned thread."""
+    src = """
+import threading
+
+from dasmtl.utils.threads import crash_logged
+
+class Pump:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.count = 0
+
+    def _run(self):
+        self.count += 1              # unguarded shared mutation
+
+    def start(self):
+        t = threading.Thread(target=crash_logged(self._run, "pump"),
+                             daemon=True)
+        t.start()
+"""
+    assert "DAS301" in ids(src, "dasmtl/serve/pump.py")
