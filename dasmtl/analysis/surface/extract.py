@@ -40,11 +40,12 @@ import os
 import re
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-#: The three HTTP front ends, by tier name (repo-relative paths).
+#: The four HTTP front ends, by tier name (repo-relative paths).
 FRONTEND_FILES: Dict[str, str] = {
     "serve": os.path.join("dasmtl", "serve", "server.py"),
     "router": os.path.join("dasmtl", "serve", "router.py"),
     "stream": os.path.join("dasmtl", "stream", "live.py"),
+    "fleet": os.path.join("dasmtl", "stream", "fleet.py"),
 }
 
 #: Modules whose same-named methods/functions resolve producer calls
@@ -53,6 +54,7 @@ PRODUCER_FILES: Tuple[str, ...] = (
     os.path.join("dasmtl", "serve", "server.py"),
     os.path.join("dasmtl", "serve", "router.py"),
     os.path.join("dasmtl", "stream", "live.py"),
+    os.path.join("dasmtl", "stream", "fleet.py"),
 )
 
 #: Reply helper method names on the handler classes.

@@ -160,13 +160,51 @@ WIRE_CONTRACT: Dict[str, Dict[str, dict]] = {
         "GET /healthz": endpoint(
             statuses=(200,), keys=_HEALTHZ_KEYS + ("stream",),
             required=("status", "stream")),
+        "GET /readyz": endpoint(
+            statuses=(200, 503), keys=_HEALTHZ_KEYS + ("stream",),
+            required=("status", "ready", "stream")),
         "GET /metrics": _METRICS,
         "GET /query": _QUERY,
         "GET /stats": endpoint(
             statuses=(200,),
             keys=("cycles", "resident", "tenants", "events_held",
-                  "alerts"),
+                  "alerts", "dynamic", "hot_shard"),
             required=("cycles", "tenants"), exhaustive=False),
+        # Dynamic tenancy (--fleet_worker): the fleet controller's
+        # migration/failover handshake.  An assign answers the resume
+        # offset the fiber actually starts at; a release drains first
+        # and answers the offset the next owner must resume from.
+        "POST /fibers": endpoint(
+            statuses=(200, 400, 409),
+            keys=("fiber", "assigned", "resume_offset", "tiles",
+                  "error", "detail")),
+        "POST /fibers/release": endpoint(
+            statuses=(200, 400, 404),
+            keys=("fiber", "released", "drained", "resume_offset",
+                  "open_tracks", "track_closes", "error", "detail")),
+    },
+    "fleet": {
+        "GET /events": endpoint(statuses=(200,), raw_body=True),
+        "GET /healthz": endpoint(
+            statuses=(200,),
+            keys=("status", "ready", "workers", "ready_workers",
+                  "fibers", "assigned", "orphaned", "migrating"),
+            required=("status", "ready", "workers", "fibers",
+                      "assigned")),
+        "GET /readyz": endpoint(
+            statuses=(200, 503),
+            keys=("status", "ready", "workers", "ready_workers",
+                  "fibers", "assigned", "orphaned", "migrating"),
+            required=("status", "ready")),
+        "GET /metrics": _METRICS,
+        "GET /stats": endpoint(
+            statuses=(200,),
+            keys=("workers", "ready_workers", "fibers", "assigned",
+                  "orphaned", "migrating", "migrations", "failovers",
+                  "reassignments", "reassign_latency_s_max",
+                  "per_worker_load", "events_held", "worker_procs"),
+            required=("workers", "fibers", "assigned"),
+            exhaustive=False),
     },
 }
 

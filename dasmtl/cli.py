@@ -37,7 +37,9 @@ def test_main(argv=None) -> None:
 def stream_main(argv=None) -> int:
     """``dasmtl-stream`` — the streaming tier.  ``serve`` as the first
     argument starts continuous live inference over unbounded fibers
-    (dasmtl/stream/live.py, docs/STREAMING.md); anything else is the
+    (dasmtl/stream/live.py, docs/STREAMING.md); ``fleet`` starts the
+    fiber-placement control plane sharding fibers across stream-worker
+    processes (dasmtl/stream/fleet.py); anything else is the
     long-standing offline record sweep (dasmtl/stream/offline.py)."""
     argv = list(sys.argv[1:] if argv is None else argv)
     apply_device_flag(argv)
@@ -45,6 +47,10 @@ def stream_main(argv=None) -> int:
         from dasmtl.stream.live import serve_main as stream_serve_main
 
         return stream_serve_main(argv[1:])
+    if argv[:1] == ["fleet"]:
+        from dasmtl.stream.fleet import fleet_main
+
+        return fleet_main(argv[1:])
     from dasmtl.stream import main
 
     return main(argv)

@@ -268,6 +268,27 @@ class Config:
     # every record as JSONL (None = no file sink).
     stream_events_ring: int = 1024
     stream_events_path: Optional[str] = None
+    # Fleet control plane (`dasmtl stream fleet`): shard N fibers across
+    # `stream_fleet_workers` worker processes.  Workers are probed on
+    # the router's eviction contract every
+    # `stream_fleet_probe_interval_s`; /stats + /events are polled every
+    # `stream_fleet_stats_interval_s` (resume offsets, hot-shard
+    # evidence, event stitching).  A failed-over fiber resumes
+    # `stream_fleet_replay_margin` samples BEFORE its last known offset
+    # so in-flight tracks re-form (the stitcher dedupes the replay).  A
+    # fiber shedding past `stream_fleet_rebalance_shed_rate` windows/s
+    # migrates (drain-on-old then resume-on-new) to the least-loaded
+    # worker, one migration at a time with a
+    # `stream_fleet_rebalance_cooldown_s` gap (0 rate = rebalancing
+    # off); the old owner gets `stream_fleet_release_timeout_s` to
+    # drain.
+    stream_fleet_workers: int = 2
+    stream_fleet_probe_interval_s: float = 0.5
+    stream_fleet_stats_interval_s: float = 0.5
+    stream_fleet_replay_margin: int = 2048
+    stream_fleet_rebalance_shed_rate: float = 0.0
+    stream_fleet_rebalance_cooldown_s: float = 3.0
+    stream_fleet_release_timeout_s: float = 10.0
 
     # ---- observability (dasmtl/obs/, docs/OBSERVABILITY.md) ----
     # Train heartbeat cadence in seconds (0 = off): periodic structured
@@ -427,6 +448,23 @@ class Config:
                              "(0 = the tenant's fairness quota)")
         if self.stream_events_ring < 1:
             raise ValueError("stream_events_ring must be >= 1")
+        if self.stream_fleet_workers < 1:
+            raise ValueError("stream_fleet_workers must be >= 1")
+        if self.stream_fleet_probe_interval_s <= 0:
+            raise ValueError("stream_fleet_probe_interval_s must be > 0")
+        if self.stream_fleet_stats_interval_s <= 0:
+            raise ValueError("stream_fleet_stats_interval_s must be > 0")
+        if self.stream_fleet_replay_margin < 0:
+            raise ValueError("stream_fleet_replay_margin must be >= 0 "
+                             "(0 = resume exactly at the cached offset)")
+        if self.stream_fleet_rebalance_shed_rate < 0:
+            raise ValueError("stream_fleet_rebalance_shed_rate must be "
+                             ">= 0 (0 = rebalancing off)")
+        if self.stream_fleet_rebalance_cooldown_s < 0:
+            raise ValueError("stream_fleet_rebalance_cooldown_s must "
+                             "be >= 0")
+        if self.stream_fleet_release_timeout_s <= 0:
+            raise ValueError("stream_fleet_release_timeout_s must be > 0")
         if self.router_replicas < 1:
             raise ValueError("router_replicas must be >= 1")
         ports = tuple(int(v) for v in self.router_replica_ports)
@@ -901,6 +939,35 @@ def _add_shared_args(p: argparse.ArgumentParser) -> None:
                    default=d.stream_events_path, metavar="PATH",
                    help="append every track record as JSONL here "
                         "(default: no file sink)")
+    # Fleet control-plane block (dasmtl/stream/fleet.py,
+    # docs/STREAMING.md "The streaming fleet") — `dasmtl stream fleet`
+    # carries first-class flags; these keep config.json/CLI parity.
+    p.add_argument("--stream_fleet_workers", type=int,
+                   default=d.stream_fleet_workers,
+                   help="stream worker processes behind the fleet "
+                        "controller")
+    p.add_argument("--stream_fleet_probe_interval_s", type=float,
+                   default=d.stream_fleet_probe_interval_s,
+                   help="/readyz probe cadence per worker (the router's "
+                        "eviction contract)")
+    p.add_argument("--stream_fleet_stats_interval_s", type=float,
+                   default=d.stream_fleet_stats_interval_s,
+                   help="/stats + /events poll cadence per ready worker")
+    p.add_argument("--stream_fleet_replay_margin", type=int,
+                   default=d.stream_fleet_replay_margin,
+                   help="samples replayed before the cached offset on "
+                        "failover resume")
+    p.add_argument("--stream_fleet_rebalance_shed_rate", type=float,
+                   default=d.stream_fleet_rebalance_shed_rate,
+                   help="per-fiber shed windows/s that triggers a "
+                        "migration (0 = rebalancing off)")
+    p.add_argument("--stream_fleet_rebalance_cooldown_s", type=float,
+                   default=d.stream_fleet_rebalance_cooldown_s,
+                   help="minimum gap between migrations")
+    p.add_argument("--stream_fleet_release_timeout_s", type=float,
+                   default=d.stream_fleet_release_timeout_s,
+                   help="drain deadline granted to the old owner during "
+                        "a migration release")
     # Observability block (dasmtl/obs/, docs/OBSERVABILITY.md) — the
     # serve CLI carries first-class --trace_ring/--slo_p99_ms flags;
     # these keep the config.json/CLI-parity invariant for training runs.

@@ -275,21 +275,31 @@ class HttpTransport:
         return raw.decode("utf-8")
 
 
-# -- real replica processes ---------------------------------------------------
+# -- real supervised processes ------------------------------------------------
 
 
-class ReplicaProcess:
-    """One real ``python -m dasmtl.serve`` child on an ephemeral port.
+class SupervisedProcess:
+    """One real ``python -m <module>`` child on an ephemeral port — the
+    reusable supervisor contract every fleet tier's children speak.
 
     The child binds its HTTP front end BEFORE warmup and writes the bound
     port to ``--port_file``; the supervisor polls that file, so startup
     needs no fixed ports and no output scraping.  Liveness (`/healthz`)
     is up as soon as the file exists — readiness comes later, when the
-    child finishes compiling its buckets, and that is the router's
-    business, not the supervisor's.
+    child finishes compiling its buckets, and that is the prober's
+    business (:class:`ReplicaHandle`), not the supervisor's.  SIGTERM
+    drains, SIGKILL is the failure-injection path (the selftests'
+    mid-load kill is a REAL kill) — identical for a serve replica
+    (:class:`ReplicaProcess`) and a stream worker
+    (:class:`dasmtl.stream.fleet.StreamWorkerProcess`).
     """
 
-    def __init__(self, serve_args: Sequence[str], *, name: str = "replica",
+    #: ``python -m`` target; subclasses pin their tier's entry point.
+    module = "dasmtl.serve"
+    #: Log file basename inside the supervisor's scratch dir.
+    log_name = "child.log"
+
+    def __init__(self, args: Sequence[str], *, name: str = "child",
                  host: str = "127.0.0.1",
                  startup_timeout_s: float = 180.0,
                  env: Optional[dict] = None,
@@ -298,9 +308,9 @@ class ReplicaProcess:
         self.host = host
         self._dir = tempfile.mkdtemp(prefix=f"dasmtl-{name}-")
         port_file = os.path.join(self._dir, "port")
-        self.log_path = log_path or os.path.join(self._dir, "serve.log")
+        self.log_path = log_path or os.path.join(self._dir, self.log_name)
         self._log = open(self.log_path, "wb")
-        cmd = [sys.executable, "-m", "dasmtl.serve", *serve_args,
+        cmd = [sys.executable, "-m", self.module, *args,
                "--host", host, "--port", "0", "--port_file", port_file]
         self.proc = subprocess.Popen(cmd, stdout=self._log,
                                      stderr=subprocess.STDOUT,
@@ -310,7 +320,7 @@ class ReplicaProcess:
         while time.monotonic() < deadline:
             if self.proc.poll() is not None:
                 raise RuntimeError(
-                    f"replica {name} exited rc={self.proc.returncode} "
+                    f"{name} exited rc={self.proc.returncode} "
                     f"before binding — log: {self.log_path}\n"
                     f"{self.log_tail()}")
             try:
@@ -324,7 +334,7 @@ class ReplicaProcess:
             time.sleep(0.05)
         if self.port is None:
             self.proc.kill()
-            raise RuntimeError(f"replica {name} never bound a port "
+            raise RuntimeError(f"{name} never bound a port "
                                f"within {startup_timeout_s}s — log: "
                                f"{self.log_path}\n{self.log_tail()}")
 
@@ -371,8 +381,20 @@ class ReplicaProcess:
         self.terminate()
         self._log.close()
 
-    def __enter__(self) -> "ReplicaProcess":
+    def __enter__(self) -> "SupervisedProcess":
         return self
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+class ReplicaProcess(SupervisedProcess):
+    """A real serving replica: ``python -m dasmtl.serve`` under the
+    supervisor contract."""
+
+    module = "dasmtl.serve"
+    log_name = "serve.log"
+
+    def __init__(self, serve_args: Sequence[str], *,
+                 name: str = "replica", **kw):
+        super().__init__(serve_args, name=name, **kw)

@@ -104,11 +104,14 @@ class RouterCore:
         return [r for r in self.replicas if r.next_probe_at() <= now]
 
 
-def aggregate_expositions(texts: Dict[str, str]) -> str:
-    """One Prometheus exposition over many replicas' scrapes: each
-    sample re-labeled with ``replica="<name>"`` so per-replica series
+def aggregate_expositions(texts: Dict[str, str],
+                          label: str = "replica") -> str:
+    """One Prometheus exposition over many members' scrapes: each
+    sample re-labeled with ``<label>="<name>"`` so per-member series
     survive aggregation (a scraper sums/joins on the label).  Families
-    merge across replicas; HELP/TYPE render once per family."""
+    merge across members; HELP/TYPE render once per family.  The
+    router aggregates replicas (``replica=``); the stream fleet
+    aggregates workers (``worker=``)."""
     families: Dict[str, dict] = {}
     order: List[str] = []
     for name, text in texts.items():
@@ -126,8 +129,8 @@ def aggregate_expositions(texts: Dict[str, str]) -> str:
         if info["help"]:
             lines.append(f"# HELP {fam} {info['help']}")
         lines.append(f"# TYPE {fam} {info['type']}")
-        for sample, labels, replica, value in info["rows"]:
-            pairs = [*labels, ("replica", replica)]
+        for sample, labels, member, value in info["rows"]:
+            pairs = [*labels, (label, member)]
             pairs.sort()
             body = ",".join(f'{k}="{escape_label_value(v)}"'
                             for k, v in pairs)

@@ -25,7 +25,8 @@ in (pinned by tests/test_stream_pkg.py).
 from __future__ import annotations
 
 from dasmtl.stream.feed import (FiberFeed, FileTailSource, PlantedEvent,
-                                SocketSource, SyntheticSource)
+                                SocketSource, SyntheticSource,
+                                source_from_spec)
 from dasmtl.stream.merge import find_shards, merge_shards
 from dasmtl.stream.offline import (EVENT_NAMES, _resolve_stride, main,
                                    shard_csv_path, stream_predict)
@@ -40,13 +41,21 @@ _LIVE_EXPORTS = {
     "serve_main": "dasmtl.stream.live",
     "run_selftest": "dasmtl.stream.selftest",
     "write_stream_job_summary": "dasmtl.stream.selftest",
+    "Fleet": "dasmtl.stream.fleet",
+    "FleetCore": "dasmtl.stream.fleet",
+    "FiberSpec": "dasmtl.stream.fleet",
+    "StreamWorkerProcess": "dasmtl.stream.fleet",
+    "make_fleet_http_server": "dasmtl.stream.fleet",
+    "fleet_main": "dasmtl.stream.fleet",
+    "run_fleet_selftest": "dasmtl.stream.fleet",
+    "run_fleet_bench": "dasmtl.stream.fleet",
 }
 
 __all__ = [
     "EVENT_NAMES", "stream_predict", "shard_csv_path", "main",
     "find_shards", "merge_shards",
     "FiberFeed", "SyntheticSource", "FileTailSource", "SocketSource",
-    "PlantedEvent", "LiveWindower", "CutWindow",
+    "PlantedEvent", "source_from_spec", "LiveWindower", "CutWindow",
     "TrackFuser", "TrackBook", "Track", "WindowDecode",
     *sorted(_LIVE_EXPORTS),
 ]

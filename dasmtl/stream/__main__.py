@@ -1,10 +1,12 @@
 """``python -m dasmtl.stream`` — the streaming tier's entry point.
 
 ``serve`` as the first argument routes to the live tier
-(:func:`dasmtl.stream.live.serve_main`); anything else keeps the
-long-standing offline sweep semantics (:func:`dasmtl.stream.offline.main`)
-— existing ``python -m dasmtl.stream --record ...`` invocations are
-untouched by the package split."""
+(:func:`dasmtl.stream.live.serve_main`); ``fleet`` to the fiber-placement
+control plane (:func:`dasmtl.stream.fleet.fleet_main`); anything else
+keeps the long-standing offline sweep semantics
+(:func:`dasmtl.stream.offline.main`) — existing
+``python -m dasmtl.stream --record ...`` invocations are untouched by
+the package split."""
 
 from __future__ import annotations
 
@@ -17,6 +19,10 @@ def main(argv=None) -> int:
         from dasmtl.stream.live import serve_main
 
         return serve_main(argv[1:])
+    if argv[:1] == ["fleet"]:
+        from dasmtl.stream.fleet import fleet_main
+
+        return fleet_main(argv[1:])
     from dasmtl.stream.offline import main as offline_main
 
     return offline_main(argv or None)

@@ -8,7 +8,10 @@ windower downstream can detect when it has fallen behind the ring
 (overrun) instead of silently reading overwritten data.
 
 Three chunk sources share one tiny protocol — ``channels`` attribute,
-``poll(max_samples) -> (channels, k) array | None``, ``close()``:
+``poll(max_samples) -> (channels, k) array | None``, ``close()``, and
+``resume_from(offset)`` (reposition to an absolute sample index — the
+fleet's migration/failover handshake; sources could always *state*
+positions, this is the consumer's API to *request* one):
 
 - :class:`SyntheticSource` — deterministic generator with planted
   ground-truth events; the soak selftest's signal (and the demo mode of
@@ -58,14 +61,24 @@ class FiberFeed:
         self.ring_samples = int(ring_samples)
         self._buf = np.zeros((self.channels, self.ring_samples), dtype)
         self.total = 0
+        # First index ever appendable: 0, or the resume_from offset —
+        # samples below it were never appended here and must not read
+        # as zeros just because the ring slots exist.
+        self._floor = 0
         # (total_after_append, clock_reading) pairs, oldest first; pruned
         # to entries still covering retained samples.
         self._arrivals: deque = deque()
 
     @property
+    def floor(self) -> int:
+        """First absolute sample index this ring ever covered: 0, or
+        the last ``resume_from`` offset."""
+        return self._floor
+
+    @property
     def oldest(self) -> int:
         """First absolute sample index still retained."""
-        return max(0, self.total - self.ring_samples)
+        return max(self._floor, self.total - self.ring_samples)
 
     def append(self, chunk: np.ndarray, now: float = 0.0) -> int:
         """Append ``(channels, n_new)`` samples; returns ``n_new``.  A
@@ -123,6 +136,21 @@ class FiberFeed:
                 return now
         return self._arrivals[-1][1] if self._arrivals else 0.0
 
+    def resume_from(self, offset: int) -> None:
+        """Reposition an (empty or restarted) ring at absolute sample
+        ``offset``: the ring forgets everything it held and the next
+        ``append`` lands at ``offset`` — the receiving half of the
+        fleet's migration/failover handshake, so a fiber resumed on a
+        new worker keeps the SAME absolute sample addressing its track
+        records and resume offsets are stated in."""
+        offset = int(offset)
+        if offset < 0:
+            raise ValueError(f"resume offset {offset} must be >= 0")
+        self._buf[:] = 0
+        self.total = offset
+        self._floor = offset
+        self._arrivals.clear()
+
 
 # -- chunk sources -------------------------------------------------------------
 
@@ -165,6 +193,7 @@ class SyntheticSource:
         self.nan_samples = frozenset(int(s) for s in nan_samples)
         self.nan_channel = (self.channels // 2 if nan_channel is None
                             else int(nan_channel))
+        self._seed = int(seed)
         self._rng = np.random.default_rng(seed)
         self._pos = 0
 
@@ -192,6 +221,22 @@ class SyntheticSource:
                 out[self.nan_channel, s - p0] = np.nan
         self._pos += n
         return out
+
+    def resume_from(self, offset: int) -> None:
+        """Reposition the generator at absolute sample ``offset``.  The
+        planted events replay EXACTLY (they are deterministic functions
+        of absolute sample index); the Gaussian background re-draws
+        from a ``(seed, offset)``-keyed stream — statistically the same
+        fiber, not bit-identical noise.  That is the honest contract a
+        real re-tapped interrogator offers too: the physical events are
+        still there, the noise floor is fresh."""
+        offset = int(offset)
+        if offset < 0:
+            raise ValueError(f"resume offset {offset} must be >= 0")
+        # Offset 0 is a plain (re)start: same stream as a fresh source.
+        self._rng = np.random.default_rng(
+            self._seed if offset == 0 else [self._seed, offset])
+        self._pos = offset
 
     def close(self) -> None:
         pass
@@ -223,8 +268,28 @@ class FileTailSource:
             n_frames, self.channels)
         return np.ascontiguousarray(frames.T)
 
+    def resume_from(self, offset: int) -> None:
+        """Seek to absolute sample ``offset`` (frame-addressed: byte
+        position ``offset * 4 * channels``) and drop any carried
+        partial frame."""
+        offset = int(offset)
+        if offset < 0:
+            raise ValueError(f"resume offset {offset} must be >= 0")
+        self._f.seek(offset * self._frame_bytes)
+        self._carry = b""
+
     def close(self) -> None:
         self._f.close()
+
+
+#: ``SocketSource.resume_from`` wire handshake: 8-byte magic + one
+#: big-endian uint64 absolute sample offset, sent consumer -> producer.
+#: Opt-in — a plain frame sender never receives one (the consumer only
+#: sends it when a supervisor explicitly requests a resume), and a
+#: handshake-aware sender rewinds its cursor and resumes frames from
+#: that sample.
+RESUME_MAGIC = b"DASRESUM"
+RESUME_FRAME_BYTES = len(RESUME_MAGIC) + 8
 
 
 class SocketSource:
@@ -264,8 +329,49 @@ class SocketSource:
             n_frames, self.channels)
         return np.ascontiguousarray(frames.T)
 
+    def resume_from(self, offset: int) -> None:
+        """Request replay from absolute sample ``offset``: sends the
+        :data:`RESUME_MAGIC` control frame upstream (the opt-in
+        handshake — the peer must speak it) and drops any buffered
+        partial frame so the next bytes received ARE sample ``offset``
+        onward."""
+        offset = int(offset)
+        if offset < 0:
+            raise ValueError(f"resume offset {offset} must be >= 0")
+        self._sock.sendall(RESUME_MAGIC
+                           + offset.to_bytes(8, "big"))
+        self._carry = b""
+
     def close(self) -> None:
         try:
             self._sock.close()
         except OSError:
             pass
+
+
+# -- fleet fiber specs ---------------------------------------------------------
+
+def source_from_spec(spec: dict, channels: int):
+    """Instantiate a chunk source from its portable JSON spec — how a
+    fleet controller hands a fiber to a worker (and to a DIFFERENT
+    worker after migration or failover; the spec plus a resume offset
+    is the fiber's whole identity).  Kinds: ``synthetic`` (``seed``,
+    optional ``events`` rows ``[onset, duration, event,
+    center_channel]``, ``nan_samples``, ``nan_channel``), ``tail``
+    (``path``), ``connect`` (``host``, ``port``)."""
+    kind = spec.get("kind")
+    if kind == "synthetic":
+        events = tuple(PlantedEvent(int(e[0]), int(e[1]), int(e[2]),
+                                    int(e[3]))
+                       for e in spec.get("events", ()))
+        return SyntheticSource(channels, seed=int(spec.get("seed", 0)),
+                               events=events,
+                               nan_samples=spec.get("nan_samples", ()),
+                               nan_channel=spec.get("nan_channel"))
+    if kind == "tail":
+        return FileTailSource(spec["path"], channels)
+    if kind == "connect":
+        return SocketSource(spec.get("host", "127.0.0.1"),
+                            int(spec["port"]), channels)
+    raise ValueError(f"unknown fiber spec kind {kind!r} — expected "
+                     f"synthetic | tail | connect")

@@ -150,7 +150,7 @@ class StreamTenant:
                  open_windows: int = 3, close_windows: int = 3,
                  min_event_prob: float = 0.9, merge_bins: float = 2.0,
                  distance_ewma: float = 0.3, n_distance_bins: int = 16,
-                 track_ids=None):
+                 track_ids=None, resume_offset: int = 0):
         if weight <= 0:
             raise ValueError(f"tenant {name}: weight must be > 0")
         self.name = name
@@ -161,6 +161,12 @@ class StreamTenant:
         # toward base, never past it.
         self.base_weight = float(weight)
         self.feed = FiberFeed(source.channels, ring_samples)
+        if resume_offset:
+            # The migration/failover handshake: reposition source AND
+            # ring at the stated absolute sample, so the windower (which
+            # starts at the feed head) cuts from exactly there.
+            self.source.resume_from(resume_offset)
+            self.feed.resume_from(resume_offset)
         self.windower = LiveWindower(self.feed, window,
                                      stride_time=stride_time,
                                      stride_channels=stride_channels)
@@ -192,6 +198,12 @@ class StreamTenant:
         # Adaptive-weight interval marks (shed/submitted at last adapt).
         self._adapt_shed0 = 0
         self._adapt_sub0 = 0
+        # Draining for release: run_cycle stops polling/cutting, the
+        # outstanding tail resolves, then the loop detaches the tenant.
+        self.draining = False
+        # (now, shed) marks the hot-shard /stats block derives each
+        # fiber's recent shed RATE from (not just the lifetime counter).
+        self._rate_marks: deque = deque(maxlen=8)
 
     def p99_latency_s(self) -> float:
         if not self.latencies:
@@ -218,15 +230,27 @@ class StreamLoop:
                  history: Optional[MetricsHistory] = None,
                  resident: str = "off",
                  resident_max_windows: int = 0,
-                 adapt_weights: bool = False, adapt_every: int = 8):
-        if not tenants:
-            raise ValueError("a stream loop needs at least one tenant")
-        if cycle_budget < len(tenants):
+                 adapt_weights: bool = False, adapt_every: int = 8,
+                 dynamic: bool = False,
+                 tenant_kwargs: Optional[dict] = None):
+        if not tenants and not dynamic:
+            raise ValueError("a stream loop needs at least one tenant "
+                             "(or dynamic=True — the fleet-worker mode, "
+                             "fibers assigned over HTTP)")
+        if tenants and cycle_budget < len(tenants):
             raise ValueError(f"cycle_budget {cycle_budget} < "
                              f"{len(tenants)} tenants — every tenant "
                              f"needs at least one slot")
+        if dynamic and resident != "off":
+            raise ValueError("dynamic tenancy (fleet worker) runs the "
+                             "host data plane only — resident lanes "
+                             "cannot yet be attached mid-stream")
         self.serve = serve
         self.tenants = list(tenants)
+        self.dynamic = bool(dynamic)
+        # Geometry/hysteresis template for fibers assigned over HTTP
+        # (StreamTenant kwargs minus name/source/weight/resume_offset).
+        self.tenant_kwargs = dict(tenant_kwargs or {})
         self.clock = clock
         self.max_wait_s = float(max_wait_s)
         self.cycle_budget = int(cycle_budget)
@@ -282,9 +306,11 @@ class StreamLoop:
     def _apply_weights(self) -> None:
         """Quota / outstanding budget / deadline from the CURRENT
         weights — the one place the fairness shares turn into budgets
-        (recomputed by adaptive weighting; callers hold the loop lock
-        once concurrency exists)."""
+        (recomputed by adaptive weighting and by dynamic assign/release;
+        callers hold the loop lock once concurrency exists)."""
         total_w = sum(t.weight for t in self.tenants)
+        if not total_w:
+            return  # dynamic loop with no fibers assigned yet
         for t in self.tenants:
             t.quota = max(1, int(self.cycle_budget * t.weight / total_w))
             t.max_outstanding = t.quota * self.outstanding_factor
@@ -319,13 +345,89 @@ class StreamLoop:
             if changed:
                 self._apply_weights()
 
+    # -- dynamic tenancy (the fleet-worker control surface) ------------------
+    def assign_fiber(self, name: str, spec: dict, *, weight: float = 1.0,
+                     resume_offset: int = 0,
+                     chunk_samples: int = 0) -> dict:
+        """Attach one fiber mid-stream from its portable spec
+        (:func:`dasmtl.stream.feed.source_from_spec`), resuming the
+        source AND ring at ``resume_offset`` — the receiving half of a
+        migration/failover handoff.  Geometry/hysteresis come from the
+        loop's ``tenant_kwargs`` template, so every fiber on a worker
+        rides the same warmed bucket ladder (no new shapes, no
+        post-warmup recompiles)."""
+        if not self.dynamic:
+            raise RuntimeError("static stream loop: the fiber set is "
+                               "fixed at startup (run the worker with "
+                               "--fleet_worker for dynamic assignment)")
+        with self._lock:
+            if any(t.name == name for t in self.tenants):
+                raise ValueError(f"fiber {name!r} already assigned")
+        from dasmtl.stream.feed import source_from_spec
+
+        kw = dict(self.tenant_kwargs)
+        channels = int(kw.pop("channels", 0)) or kw["window"][0]
+        if chunk_samples:
+            kw["chunk_samples"] = int(chunk_samples)
+        source = source_from_spec(spec, channels)
+        tenant = StreamTenant(name, source, weight=weight,
+                              resume_offset=int(resume_offset), **kw)
+        with self._lock:
+            dup = any(t.name == name for t in self.tenants)
+            if not dup:
+                self.tenants.append(tenant)
+                self._apply_weights()
+        if dup:
+            tenant.source.close()
+            raise ValueError(f"fiber {name!r} already assigned")
+        return {"fiber": name,
+                "resume_offset": tenant.windower.next_origin,
+                "tiles": tenant.windower.n_tiles}
+
+    def release_fiber(self, name: str, timeout_s: float = 10.0) -> dict:
+        """Detach one fiber: stop cutting (``draining``), let the
+        outstanding tail resolve (bounded), then remove it and report
+        the absolute resume offset the next owner should continue
+        from — drain-on-old before resume-on-new, so at most one worker
+        ever cuts a fiber's windows."""
+        with self._lock:
+            tenant = next((t for t in self.tenants if t.name == name),
+                          None)
+            if tenant is None:
+                raise KeyError(f"fiber {name!r} not assigned here")
+            tenant.draining = True
+        deadline = time.monotonic() + float(timeout_s)
+        while time.monotonic() < deadline:
+            with self._lock:
+                if tenant.outstanding == 0:
+                    break
+            time.sleep(0.005)
+        with self._lock:
+            drained = tenant.outstanding == 0
+            self.tenants = [t for t in self.tenants if t is not tenant]
+            self._apply_weights()
+        try:
+            tenant.source.close()
+        except Exception as exc:  # noqa: BLE001 — recorded, not fatal
+            print(f"[stream-release] fiber {name}: source.close "
+                  f"failed: {type(exc).__name__}: {exc}",
+                  file=sys.stderr)
+        return {"fiber": name, "drained": drained,
+                "resume_offset": tenant.windower.next_origin,
+                "open_tracks": tenant.book.open_track_count,
+                "track_closes": tenant.book.closes}
+
     # -- steady state --------------------------------------------------------
     def run_cycle(self, now: Optional[float] = None) -> dict:
         """One pump iteration over every tenant: poll the source, cut
         windows, gate + submit.  Returns per-cycle counts."""
         now = self.clock() if now is None else now
         submitted = shed = 0
-        for t in self.tenants:
+        with self._lock:  # assign/release mutate the list mid-stream
+            tenants = list(self.tenants)
+        for t in tenants:
+            if t.draining:
+                continue  # release in progress: outstanding only drains
             chunk = t.source.poll(t.chunk_samples)
             if chunk is not None and chunk.size:
                 t.feed.append(chunk, now=now)
@@ -358,6 +460,9 @@ class StreamLoop:
                     lambda f, t=t, wdw=wdw: self._on_result(t, wdw, f))
         with self._lock:  # stats() reads cycles off the HTTP thread
             self.cycles += 1
+            if self.cycles % self.adapt_every == 0:
+                for t in tenants:
+                    t._rate_marks.append((now, t.shed))
         if self.adapt_weights and self.cycles % self.adapt_every == 0:
             self._adapt_weights()
         if self.alerts is not None:
@@ -585,6 +690,8 @@ class StreamLoop:
                     "serve_refused": t.serve_refused,
                     "rejected": t.rejected,
                     "ring_overrun_windows": t.windower.overrun_windows,
+                    "next_origin": t.windower.next_origin,
+                    "draining": t.draining,
                     "tiles": t.windower.n_tiles,
                     "open_tracks": t.book.open_track_count,
                     "track_opens": t.book.opens,
@@ -601,9 +708,36 @@ class StreamLoop:
                             t.resident.post_warmup_compiles,
                     }} if t.resident is not None else {}),
                 } for t in self.tenants}
+            hot_fibers = {}
+            hottest, hottest_rate = None, 0.0
+            for t in self.tenants:
+                rate = 0.0
+                if len(t._rate_marks) >= 2:
+                    (m0, s0) = t._rate_marks[0]
+                    (m1, s1) = t._rate_marks[-1]
+                    if m1 > m0:
+                        rate = (s1 - s0) / (m1 - m0)
+                hot_fibers[t.name] = {
+                    "shed_rate_per_s": round(rate, 3),
+                    "shed": t.shed,
+                    "weight": round(t.weight, 4),
+                    "base_weight": t.base_weight,
+                    "weight_fraction": round(
+                        t.weight / t.base_weight, 4),
+                }
+                if rate > hottest_rate:
+                    hottest, hottest_rate = t.name, rate
         out = {"cycles": self.cycles, "resident": self.resident_enabled,
+               "dynamic": self.dynamic,
                "tenants": tenants,
-               "events_held": len(self._events)}
+               "events_held": len(self._events),
+               # The one hot-shard signal (per-fiber shed RATE +
+               # adaptive-weight evidence) the fleet control plane and
+               # operators read — structured, not scraped counters.
+               "hot_shard": {"hottest": hottest,
+                             "hottest_shed_rate_per_s":
+                                 round(hottest_rate, 3),
+                             "fibers": hot_fibers}}
         if self.alerts is not None:
             out["alerts"] = self.alerts.stats()
         return out
@@ -658,9 +792,12 @@ def default_stream_rules(*, shed_rate_per_s: float = 1.0,
 def make_stream_http_server(stream: StreamLoop, host: str = "127.0.0.1",
                             port: int = 0) -> ThreadingHTTPServer:
     """The stream front end: ``GET /events`` (the track-record view),
-    ``/healthz``, ``/stats``, ``/metrics`` (serve + stream families),
-    ``/query`` (metrics history, :func:`dasmtl.obs.history.handle_query`
-    semantics)."""
+    ``/healthz``, ``/readyz`` (the probe surface the fleet controller's
+    router-style eviction contract rides), ``/stats``, ``/metrics``
+    (serve + stream families), ``/query`` (metrics history,
+    :func:`dasmtl.obs.history.handle_query` semantics), and — on a
+    dynamic (fleet-worker) loop — ``POST /fibers`` / ``POST
+    /fibers/release``, the placement control surface."""
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *_a):  # keep CI logs quiet
@@ -674,6 +811,79 @@ def make_stream_http_server(stream: StreamLoop, host: str = "127.0.0.1",
             self.end_headers()
             self.wfile.write(body)
 
+        def _healthz_payload(self) -> dict:
+            payload = stream.serve.healthz()
+            payload["stream"] = {"cycles": stream.cycles,
+                                 "tenants": len(stream.tenants),
+                                 "dynamic": stream.dynamic}
+            return payload
+
+        def do_POST(self):  # noqa: N802 — http.server convention
+            url = urlparse(self.path)
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(n).decode("utf-8")
+                                     or "{}")
+                except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+                    self._send(400, json.dumps(
+                        {"error": "bad_request",
+                         "detail": f"body is not JSON: {exc}"}).encode())
+                    return
+                if url.path == "/fibers":
+                    if not isinstance(req.get("fiber"), str) \
+                            or not isinstance(req.get("spec"), dict):
+                        self._send(400, json.dumps(
+                            {"error": "bad_request",
+                             "detail": "need fiber (str) + spec "
+                                       "(dict)"}).encode())
+                        return
+                    try:
+                        out = stream.assign_fiber(
+                            req["fiber"], req["spec"],
+                            weight=float(req.get("weight", 1.0)),
+                            resume_offset=int(
+                                req.get("resume_offset", 0)),
+                            chunk_samples=int(
+                                req.get("chunk_samples", 0)))
+                    except RuntimeError as exc:
+                        self._send(409, json.dumps(
+                            {"error": "static",
+                             "detail": str(exc)}).encode())
+                        return
+                    except ValueError as exc:
+                        self._send(409, json.dumps(
+                            {"error": "exists",
+                             "detail": str(exc)}).encode())
+                        return
+                    self._send(200, json.dumps(
+                        {"fiber": out["fiber"], "assigned": True,
+                         "resume_offset": out["resume_offset"],
+                         "tiles": out["tiles"]}).encode())
+                elif url.path == "/fibers/release":
+                    try:
+                        out = stream.release_fiber(
+                            str(req.get("fiber", "")),
+                            timeout_s=float(
+                                req.get("timeout_s", 10.0)))
+                    except KeyError as exc:
+                        self._send(404, json.dumps(
+                            {"error": "unknown_fiber",
+                             "detail": str(exc)}).encode())
+                        return
+                    self._send(200, json.dumps(
+                        {"fiber": out["fiber"], "released": True,
+                         "drained": out["drained"],
+                         "resume_offset": out["resume_offset"],
+                         "open_tracks": out["open_tracks"],
+                         "track_closes": out["track_closes"]}).encode())
+                else:
+                    self._send(404, json.dumps(
+                        {"error": f"no route {url.path}"}).encode())
+            except Exception as exc:  # noqa: BLE001 — answer, don't die
+                self._send(500, json.dumps(
+                    {"error": f"{type(exc).__name__}: {exc}"}).encode())
+
         def do_GET(self):  # noqa: N802 — http.server convention
             url = urlparse(self.path)
             try:
@@ -685,10 +895,12 @@ def make_stream_http_server(stream: StreamLoop, host: str = "127.0.0.1",
                                       ).encode()
                     self._send(200, body)
                 elif url.path == "/healthz":
-                    payload = stream.serve.healthz()
-                    payload["stream"] = {"cycles": stream.cycles,
-                                         "tenants": len(stream.tenants)}
-                    self._send(200, json.dumps(payload).encode())
+                    self._send(200, json.dumps(
+                        self._healthz_payload()).encode())
+                elif url.path == "/readyz":
+                    payload = self._healthz_payload()
+                    self._send(200 if payload.get("ready") else 503,
+                               json.dumps(payload).encode())
                 elif url.path == "/stats":
                     self._send(200, json.dumps(stream.stats()).encode())
                 elif url.path == "/metrics":
@@ -729,6 +941,11 @@ def serve_main(argv=None) -> int:
     src.add_argument("--fresh_init", action="store_true",
                      help="seed-deterministic fresh-init weights (the "
                           "bench/demo path when no trained weights exist)")
+    src.add_argument("--oracle", action="store_true",
+                     help="the analytic RMS oracle executor (needs "
+                          "--window) — the fleet selftest/bench worker "
+                          "detector, exactly predictable yet jitted "
+                          "through the real pool")
     p.add_argument("--model", type=str, default="MTL")
     p.add_argument("--window", type=str, default=None, metavar="HxW",
                    help="window shape, e.g. 100x250 (default: the config "
@@ -753,6 +970,12 @@ def serve_main(argv=None) -> int:
     fib.add_argument("--weights", type=str, default=None,
                      help="comma-separated per-fiber weights (fairness "
                           "shares + deadline scaling; default all 1)")
+    fib.add_argument("--fleet_worker", action="store_true",
+                     help="dynamic tenancy: start with the configured "
+                          "fibers (possibly none) and accept POST "
+                          "/fibers assignments/releases from a fleet "
+                          "controller (dasmtl stream fleet); forces the "
+                          "host data plane")
     srv = p.add_argument_group("serve loop (dasmtl/serve/)")
     srv.add_argument("--max_wait_ms", type=float,
                      default=d.serve_max_wait_ms,
@@ -918,10 +1141,10 @@ def serve_main(argv=None) -> int:
         return 0 if report["passed"] else 1
 
     n_sources = sum(1 for v in (args.exported, args.model_path,
-                                args.fresh_init) if v)
+                                args.fresh_init, args.oracle) if v)
     if n_sources != 1:
         p.error("exactly one of --exported / --model_path / "
-                "--fresh_init is required (or --selftest)")
+                "--fresh_init / --oracle is required (or --selftest)")
     try:
         buckets = tuple(int(b) for b in args.buckets.split(",") if b)
     except ValueError:
@@ -938,7 +1161,14 @@ def serve_main(argv=None) -> int:
     from dasmtl.serve.executor import ExecutorPool
     from dasmtl.serve.server import ServeLoop, install_signal_handlers
 
-    if args.exported:
+    if args.oracle:
+        if window is None:
+            p.error("--oracle needs an explicit --window HxW (there is "
+                    "no artifact to read the geometry from)")
+        from dasmtl.stream.selftest import _oracle_pool
+
+        pool = _oracle_pool(window, buckets, args.devices)
+    elif args.exported:
         pool = ExecutorPool.from_exported(args.exported, buckets,
                                           expected_hw=window,
                                           devices=args.devices,
@@ -968,9 +1198,10 @@ def serve_main(argv=None) -> int:
         host, _, port = spec.rpartition(":")
         sources.append(SocketSource(host or "127.0.0.1", int(port),
                                     channels))
-    if not sources:
+    if not sources and not args.fleet_worker:
         p.error("no fibers: pass --synthetic N, --tail PATH, or "
-                "--connect HOST:PORT")
+                "--connect HOST:PORT (or --fleet_worker to accept "
+                "assignments over HTTP)")
     weights = [1.0] * len(sources)
     if args.weights:
         try:
@@ -1011,6 +1242,17 @@ def serve_main(argv=None) -> int:
                 backoff_s=args.alerts_webhook_backoff_s))
         engine = AlertEngine(default_stream_rules(), sinks,
                              history=history)
+    tenant_kwargs = dict(
+        channels=channels, window=window,
+        stride_time=args.stride_time,
+        stride_channels=args.stride_channels,
+        ring_samples=args.ring_samples,
+        chunk_samples=args.chunk_samples,
+        open_windows=args.open_windows,
+        close_windows=args.close_windows,
+        min_event_prob=args.min_event_prob,
+        merge_bins=args.track_merge_bins,
+        distance_ewma=args.distance_ewma)
     stream = StreamLoop(loop, tenants, cycle_budget=args.cycle_budget,
                         max_wait_s=args.max_wait_ms / 1e3,
                         events_path=args.events_path,
@@ -1018,9 +1260,12 @@ def serve_main(argv=None) -> int:
                         alerts=engine,
                         alerts_interval_s=args.alerts_interval_s,
                         history=history,
-                        resident=args.resident,
+                        resident=("off" if args.fleet_worker
+                                  else args.resident),
                         resident_max_windows=args.resident_max_windows,
-                        adapt_weights=args.adapt_weights)
+                        adapt_weights=args.adapt_weights,
+                        dynamic=args.fleet_worker,
+                        tenant_kwargs=tenant_kwargs)
     if engine is not None:
         engine.add_exposition(stream.metrics_text)
     sampler = None
@@ -1044,10 +1289,13 @@ def serve_main(argv=None) -> int:
           f"{len(pool.executors)} device(s); liveness already up on "
           f"http://{host}:{port} ...", file=sys.stderr)
     loop.start()
-    n_tiles = tenants[0].windower.n_tiles
-    print(f"streaming {len(tenants)} fiber(s) x {n_tiles} tile(s) "
+    fibers_desc = (f"{len(tenants)} fiber(s) x "
+                   f"{tenants[0].windower.n_tiles} tile(s)"
+                   if tenants else "0 fibers (awaiting POST /fibers)")
+    print(f"streaming {fibers_desc} "
           f"into {pool.source} on http://{host}:{port} "
-          f"(GET /events, /healthz, /stats, /metrics, /query); "
+          f"(GET /events, /healthz, /readyz, /stats, /metrics, /query"
+          f"{'; POST /fibers[,/release]' if args.fleet_worker else ''}); "
           f"alerts={'on' if engine is not None else 'off'}; "
           f"SIGTERM drains", file=sys.stderr)
     stop = threading.Event()

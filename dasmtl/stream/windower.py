@@ -73,9 +73,23 @@ class LiveWindower:
         self.tile_origins = tuple(plan.origin(i)[0]
                                   for i in range(plan.n_windows))
         self.n_tiles = len(self.tile_origins)
-        self._next_t = 0  # absolute t_origin of the next uncut window row
+        # Absolute t_origin of the next uncut window row.  Starting at
+        # the feed's floor (not 0) is what lets a resumed feed
+        # (FiberFeed.resume_from) cut from its resume offset instead of
+        # booking the whole pre-history as a phantom overrun — while a
+        # fresh feed still cuts from 0 even when samples were appended
+        # before the windower was built.  (ResidentFeed has no floor —
+        # resident lanes cannot resume; they always start at 0.)
+        self._next_t = getattr(feed, "floor", 0)
         self.overrun_windows = 0
         self.cut_windows = 0
+
+    @property
+    def next_origin(self) -> int:
+        """Absolute sample index of the next uncut window row — the
+        fiber's resume offset for a migration/failover handoff (every
+        window before it was already cut and submitted here)."""
+        return self._next_t
 
     def ready_rows(self) -> int:
         """Window rows fully arrived but not yet cut."""

@@ -174,6 +174,21 @@ def collect() -> dict:
         "events_path": d.stream_events_path or "none",
     }
 
+    # Fiber-sharded streaming fleet (dasmtl/stream/fleet.py,
+    # docs/STREAMING.md "The streaming fleet"): the resolved
+    # `dasmtl stream fleet` control-plane config — probe/stats cadence,
+    # the failover replay margin, and the rebalance trigger.
+    info["stream_fleet"] = {
+        "workers": d.stream_fleet_workers,
+        "probe_interval_s": d.stream_fleet_probe_interval_s,
+        "stats_interval_s": d.stream_fleet_stats_interval_s,
+        "replay_margin": d.stream_fleet_replay_margin,
+        "rebalance_shed_rate": d.stream_fleet_rebalance_shed_rate
+        or "off",
+        "rebalance_cooldown_s": d.stream_fleet_rebalance_cooldown_s,
+        "release_timeout_s": d.stream_fleet_release_timeout_s,
+    }
+
     # Unified telemetry layer (dasmtl/obs/, docs/OBSERVABILITY.md): the
     # resolved obs config — heartbeat cadence, latency buckets, trace
     # ring, SLO/profiler knobs.
@@ -390,6 +405,10 @@ def main(argv=None) -> int:
     print("  stream: " + ", ".join(
         f"{k}={v}" for k, v in info["stream"].items())
         + " (dasmtl stream serve; docs/STREAMING.md)")
+    print("  stream fleet: " + ", ".join(
+        f"{k}={v}" for k, v in info["stream_fleet"].items())
+        + " (dasmtl stream fleet; docs/STREAMING.md "
+          "'The streaming fleet')")
     reg = info.get("artifact_registry", {})
     if reg.get("status") == "ok":
         vs = ", ".join(

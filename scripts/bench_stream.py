@@ -257,6 +257,48 @@ def soak(cycles: int = 120, fibers: int = 3, devices: int = 1,
     return rc
 
 
+def fleet(workers: int = 2, fibers: int = 24, measure_s: float = 10.0,
+          out: str = "BENCH_stream.json") -> int:
+    """Fleet scale-out rows: resolved windows/s fleet-wide at 1 worker
+    and at ``workers`` workers over the SAME fiber set, plus the
+    reassignment latency after a mid-bench SIGKILL of one worker
+    (dasmtl/stream/fleet.py).  Merged into the soak report under
+    ``"fleet"`` when ``out`` already exists (CI runs --soak first), so
+    one BENCH_stream.json carries both stories.  On a 1-core host the
+    multi-worker row is honestly flat-to-negative — the row that always
+    matters here is ``reassign_latency_s_max`` (docs/STREAMING.md "The
+    streaming fleet")."""
+    from dasmtl.stream.fleet import run_fleet_bench
+
+    rows = {}
+    for n in sorted({1, max(1, int(workers))}):
+        row = run_fleet_bench(workers=n, fibers=fibers,
+                              measure_s=measure_s, kill=n > 1,
+                              say=lambda m: print(m, file=sys.stderr))
+        rows[f"w{n}"] = row
+        print(json.dumps(row))
+    section = {"workers": int(workers), "fibers": int(fibers),
+               "rows": rows}
+    if len(rows) > 1:
+        base = rows["w1"]["value"] or 1e-9
+        section["scaling_x"] = round(
+            rows[f"w{max(1, int(workers))}"]["value"] / base, 3)
+        section["note"] = ("workers time-slice the host's cores; "
+                          "scaling_x ~1.0 or below on 1 core is "
+                          "expected and honest")
+    if out:
+        report = {}
+        if os.path.exists(out):
+            with open(out, "r", encoding="utf-8") as f:
+                report = json.load(f)
+        report["fleet"] = section
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {out} (fleet section)", file=sys.stderr)
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--time_samples", type=int, default=120_000,
@@ -275,6 +317,13 @@ def main() -> int:
                          "report lands in --out")
     ap.add_argument("--soak_cycles", type=int, default=120)
     ap.add_argument("--soak_devices", type=int, default=1)
+    ap.add_argument("--fleet", type=int, default=0, metavar="M",
+                    help="fleet scale-out rows: 1-worker vs M-worker "
+                         "resolved windows/s over the same fibers, plus "
+                         "mid-bench-SIGKILL reassignment latency; merges "
+                         "into --out under 'fleet'")
+    ap.add_argument("--fleet_fibers", type=int, default=24)
+    ap.add_argument("--fleet_measure_s", type=float, default=10.0)
     ap.add_argument("--out", type=str, default="BENCH_stream.json",
                     help="soak report path ('' = stdout lines only)")
     args = ap.parse_args()
@@ -282,6 +331,9 @@ def main() -> int:
     # stream_predict builds fresh jitted closures per call, so the warm-up
     # call can only warm the *persistent* compilation cache — enable it.
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dasmtl_jax_cache")
+    if args.fleet:
+        return fleet(workers=args.fleet, fibers=args.fleet_fibers,
+                     measure_s=args.fleet_measure_s, out=args.out)
     if args.soak:
         return soak(cycles=args.soak_cycles, devices=args.soak_devices,
                     out=args.out)

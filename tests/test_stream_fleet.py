@@ -1,0 +1,325 @@
+"""Fleet control-plane state machine (dasmtl/stream/fleet.py), driven
+with a fake clock and zero processes: consistent rendezvous placement,
+the at-most-one-owner invariant, migration's drain-on-old-before-
+resume-on-new ordering, failover reassignment with the replay margin —
+including failovers landing mid-migration — and the fleet-side event
+stitcher's replay dedupe.  The threaded wrapper + real workers soak in
+``dasmtl stream fleet --selftest`` (CI's fleet leg)."""
+
+import pytest
+
+from dasmtl.stream.fleet import (FiberSpec, Fleet, FleetCore,
+                                 rendezvous_worker)
+
+
+def make_core(workers=("w0", "w1", "w2"), fibers=8, now=0.0, **kw):
+    kw.setdefault("probe_interval_s", 1.0)
+    kw.setdefault("stats_interval_s", 1.0)
+    core = FleetCore(**kw)
+    for i, name in enumerate(workers):
+        core.add_worker(name, f"127.0.0.1:{9000 + i}")
+    for i in range(fibers):
+        core.add_fiber(FiberSpec(f"f{i}", {"kind": "synthetic",
+                                           "seed": i}))
+    for name in workers:
+        core.on_probe_ok(name, {"ready": True}, now)
+    return core
+
+
+def settle(core, now):
+    """Run plan/ack rounds until no assigns are pending; returns the
+    executed assigns.  Asserts the single-owner invariant throughout."""
+    done = []
+    for _ in range(8):
+        acts = [a for a in core.plan(now) if a["kind"] == "assign"]
+        if not acts:
+            break
+        for a in acts:
+            core.on_assign_ok(a["fiber"], a["worker"], now)
+            done.append(a)
+        assert_single_owner(core)
+    return done
+
+
+def assert_single_owner(core):
+    for fiber, owner in core.owner.items():
+        assert owner is None or owner in core.workers
+    # Structural: owner is a single name; no fiber may also be mid-
+    # assign to a DIFFERENT worker while owned.
+    for fiber, act in core.pending.items():
+        if act["kind"] == "assign":
+            assert core.owner[fiber] is None, \
+                f"{fiber} owned by {core.owner[fiber]} with an assign " \
+                f"in flight to {act['worker']}"
+
+
+# -- placement -----------------------------------------------------------------
+
+def test_rendezvous_is_deterministic_and_moves_only_the_stolen():
+    workers = ["w0", "w1", "w2"]
+    before = {f"f{i}": rendezvous_worker(f"f{i}", workers)
+              for i in range(64)}
+    assert before == {f: rendezvous_worker(f, list(workers))
+                      for f in before}
+    after = {f: rendezvous_worker(f, workers + ["w3"]) for f in before}
+    moved = {f for f in before if before[f] != after[f]}
+    # Adding a worker only steals fibers TO it — nothing shuffles
+    # between the survivors.
+    assert all(after[f] == "w3" for f in moved)
+    assert 0 < len(moved) < 64
+
+
+def test_placement_assigns_every_fiber_exactly_once():
+    core = make_core(fibers=24)
+    acts = [a for a in core.plan(1.0) if a["kind"] == "assign"]
+    assert len(acts) == 24
+    assert {a["fiber"] for a in acts} == set(core.fibers)
+    # Re-planning with the assigns still in flight duplicates nothing.
+    assert [a for a in core.plan(1.1) if a["kind"] == "assign"] == []
+    for a in acts:
+        assert a["resume_offset"] == 0  # fresh fibers: no replay
+        core.on_assign_ok(a["fiber"], a["worker"], 1.2)
+    snap = core.snapshot()
+    assert snap["assigned"] == 24 and snap["orphaned"] == 0
+    assert sum(snap["per_worker_load"].values()) == 24
+    # Every worker won some share under rendezvous with 24 fibers.
+    assert all(v > 0 for v in snap["per_worker_load"].values())
+    assert_single_owner(core)
+
+
+def test_no_assignment_until_a_worker_is_ready():
+    core = FleetCore()
+    core.add_worker("w0", "127.0.0.1:9000")
+    core.add_fiber(FiberSpec("f0", {"kind": "synthetic", "seed": 0}))
+    assert [a for a in core.plan(0.0) if a["kind"] == "assign"] == []
+    core.on_probe_ok("w0", {"ready": False}, 0.1)  # warming up
+    assert [a for a in core.plan(0.2) if a["kind"] == "assign"] == []
+    core.on_probe_ok("w0", {"ready": True}, 0.3)
+    (a,) = [a for a in core.plan(0.4) if a["kind"] == "assign"]
+    assert a == {**a, "fiber": "f0", "worker": "w0"}
+
+
+def test_assign_rejection_is_replanned_not_wedged():
+    core = make_core(workers=("w0",), fibers=1)
+    (a,) = [a for a in core.plan(1.0) if a["kind"] == "assign"]
+    core.on_assign_fail("f0", "w0", "HTTP 400: bad spec", 1.1,
+                        transport=False)
+    assert core.owner["f0"] is None and "f0" not in core.pending
+    # A non-transport rejection does NOT evict the worker.
+    assert core.workers["w0"].in_rotation
+    assert [a for a in core.plan(1.2) if a["kind"] == "assign"]
+
+
+# -- rebalancing (drain-on-old strictly before resume-on-new) ------------------
+
+def hot_evidence(core, fiber, rate, now):
+    core.on_stats(core.owner[fiber],
+                  {"tenants": {fiber: {"next_origin": 10_000}},
+                   "hot_shard": {"fibers": {fiber: {
+                       "shed_rate_per_s": rate,
+                       "weight_fraction": 0.25}}}}, now)
+
+
+def test_migration_drains_old_owner_before_assigning_new():
+    core = make_core(fibers=6, rebalance_shed_rate=10.0,
+                     rebalance_cooldown_s=1.0)
+    settle(core, 1.0)
+    hot = "f3"
+    src = core.owner[hot]
+    hot_evidence(core, hot, 50.0, 2.0)
+    acts = core.plan(10.0)
+    (rel,) = [a for a in acts if a["kind"] == "release"]
+    assert rel["fiber"] == hot and rel["worker"] == src
+    # While the release is in flight the fiber is still owned by src and
+    # NO assign for it may be planned — drain strictly first.
+    assert core.owner[hot] == src
+    assert [a for a in core.plan(10.1)
+            if a["kind"] in ("assign", "release")] == []
+    core.on_release_ok(hot, src, 10_240, 10.2)
+    assert core.owner[hot] is None
+    (asg,) = [a for a in core.plan(10.3) if a["kind"] == "assign"]
+    assert asg["fiber"] == hot and asg["worker"] != src
+    # The migration resumes at the EXACT drained offset: no replay
+    # margin (nothing was lost), no gap.
+    assert asg["resume_offset"] == 10_240
+    assert core.on_assign_ok(hot, asg["worker"], 10.4) is None
+    assert core.migrations == 1 and core.reassignments == 0
+    assert_single_owner(core)
+
+
+def test_rebalance_honors_cooldown_threshold_and_one_at_a_time():
+    core = make_core(fibers=6, rebalance_shed_rate=10.0,
+                     rebalance_cooldown_s=5.0)
+    settle(core, 1.0)
+    hot_evidence(core, "f0", 9.9, 2.0)   # below threshold
+    assert [a for a in core.plan(20.0) if a["kind"] == "release"] == []
+    hot_evidence(core, "f0", 50.0, 21.0)
+    hot_evidence(core, "f1", 40.0, 21.0)
+    (rel,) = [a for a in core.plan(30.0) if a["kind"] == "release"]
+    assert rel["fiber"] == "f0"  # hottest first, one at a time
+    # f1 is also hot but must wait for f0's migration AND the cooldown.
+    assert [a for a in core.plan(30.1) if a["kind"] == "release"] == []
+    core.on_release_ok("f0", rel["worker"], 5_000, 30.2)
+    for a in core.plan(30.3):
+        if a["kind"] == "assign":
+            core.on_assign_ok(a["fiber"], a["worker"], 30.4)
+    assert [a for a in core.plan(31.0) if a["kind"] == "release"] == []
+    hot_evidence(core, "f1", 40.0, 40.0)
+    assert [a["fiber"] for a in core.plan(40.0)
+            if a["kind"] == "release"] == ["f1"]
+
+
+def test_hot_everywhere_fiber_cannot_ping_pong_each_cycle():
+    core = make_core(workers=("w0", "w1"), fibers=2,
+                     rebalance_shed_rate=10.0, rebalance_cooldown_s=1.0)
+    settle(core, 1.0)
+    hot_evidence(core, "f0", 99.0, 2.0)
+    (rel,) = [a for a in core.plan(5.0) if a["kind"] == "release"]
+    core.on_release_ok("f0", rel["worker"], 1_000, 5.1)
+    for a in core.plan(5.2):
+        if a["kind"] == "assign":
+            core.on_assign_ok(a["fiber"], a["worker"], 5.3)
+    # Still hot on the new worker just past the cooldown: the per-fiber
+    # backoff (4x cooldown) blocks an immediate bounce back.
+    hot_evidence(core, "f0", 99.0, 6.5)
+    assert [a for a in core.plan(6.5) if a["kind"] == "release"] == []
+
+
+# -- failover ------------------------------------------------------------------
+
+def test_failover_reassigns_with_replay_margin_and_latency():
+    core = make_core(fibers=9, replay_margin=2_048)
+    settle(core, 1.0)
+    victim = core.owner["f0"]
+    owned = [f for f, o in core.owner.items() if o == victim]
+    for f in owned:
+        core.on_stats(victim,
+                      {"tenants": {f: {"next_origin": 50_000}},
+                       "hot_shard": {"fibers": {}}}, 2.0)
+    core.on_worker_down(victim, "process exited rc=-9", 10.0)
+    assert core.failovers == 1
+    snap = core.snapshot()
+    assert snap["orphaned"] == len(owned)
+    acts = [a for a in core.plan(10.5) if a["kind"] == "assign"]
+    assert {a["fiber"] for a in acts} == set(owned)
+    for a in acts:
+        assert a["worker"] != victim
+        assert a["resume_offset"] == 50_000 - 2_048  # replay the gap
+        lat = core.on_assign_ok(a["fiber"], a["worker"], 11.0)
+        assert lat == pytest.approx(1.0)
+    assert core.reassignments == len(owned)
+    assert max(core.reassign_latencies) == pytest.approx(1.0)
+    assert core.snapshot()["orphaned"] == 0
+    assert_single_owner(core)
+
+
+def test_failover_resume_offset_clamps_at_zero():
+    core = make_core(workers=("w0", "w1"), fibers=1, replay_margin=4_096)
+    settle(core, 1.0)
+    victim = core.owner["f0"]
+    core.on_stats(victim, {"tenants": {"f0": {"next_origin": 100}},
+                           "hot_shard": {"fibers": {}}}, 2.0)
+    core.on_worker_down(victim, "killed", 3.0)
+    (a,) = [a for a in core.plan(3.1) if a["kind"] == "assign"]
+    assert a["resume_offset"] == 0
+
+
+def test_probe_failure_and_unready_probe_both_orphan():
+    core = make_core(workers=("w0", "w1"), fibers=4)
+    settle(core, 1.0)
+    owned_w0 = [f for f, o in core.owner.items() if o == "w0"]
+    core.on_probe_fail("w0", "connection refused", 5.0)
+    assert all(core.owner[f] is None for f in owned_w0)
+    owned_w1 = [f for f, o in core.owner.items() if o == "w1"]
+    core.on_probe_ok("w1", {"ready": False}, 6.0)  # answers, but drains
+    assert all(core.owner[f] is None for f in owned_w1)
+    assert core.failovers == 2
+
+
+def test_worker_death_during_migration_release_fails_over():
+    core = make_core(fibers=6, rebalance_shed_rate=10.0,
+                     rebalance_cooldown_s=1.0)
+    settle(core, 1.0)
+    hot_evidence(core, "f2", 50.0, 2.0)
+    (rel,) = [a for a in core.plan(10.0) if a["kind"] == "release"]
+    src = rel["worker"]
+    # The drain request never answers: the old owner died holding it.
+    core.on_release_fail("f2", src, "connection refused", 12.0,
+                         transport=True)
+    assert "f2" not in core.migrating and "f2" not in core.pending
+    assert core.owner["f2"] is None  # orphaned with everything else src had
+    acts = [a for a in core.plan(12.5) if a["kind"] == "assign"]
+    mine = [a for a in acts if a["fiber"] == "f2"]
+    assert mine and mine[0]["worker"] != src
+    assert core.migrations == 0  # never completed; it became a failover
+    assert_single_owner(core)
+
+
+def test_migration_target_death_falls_back_to_rendezvous():
+    core = make_core(fibers=6, rebalance_shed_rate=10.0,
+                     rebalance_cooldown_s=1.0)
+    settle(core, 1.0)
+    hot_evidence(core, "f1", 50.0, 2.0)
+    (rel,) = [a for a in core.plan(10.0) if a["kind"] == "release"]
+    src = rel["worker"]
+    dst = core.migrating["f1"]["dst"]
+    core.on_release_ok("f1", src, 7_000, 10.1)
+    core.on_worker_down(dst, "killed", 10.2)  # target dies pre-assign
+    acts = [a for a in core.plan(10.3) if a["kind"] == "assign"
+            and a["fiber"] == "f1"]
+    assert acts and acts[0]["worker"] not in (dst,)
+    assert "f1" not in core.migrating
+    assert_single_owner(core)
+
+
+def test_concurrent_failover_and_rebalance_stay_single_owner():
+    core = make_core(fibers=12, rebalance_shed_rate=10.0,
+                     rebalance_cooldown_s=1.0, replay_margin=512)
+    settle(core, 1.0)
+    hot = "f5"
+    hot_evidence(core, hot, 80.0, 2.0)
+    (rel,) = [a for a in core.plan(10.0) if a["kind"] == "release"]
+    src = rel["worker"]
+    # While the migration release is in flight, a DIFFERENT worker dies.
+    other = next(n for n in core.workers if n != src
+                 and core.workers[n].in_rotation)
+    core.on_worker_down(other, "killed", 10.1)
+    settle(core, 10.2)  # failover reassignments proceed around the
+    assert core.owner[hot] == src  # pinned migration
+    core.on_release_ok(hot, src, 9_999, 10.5)
+    settle(core, 10.6)
+    assert core.owner[hot] is not None and core.owner[hot] != other
+    assert core.snapshot()["orphaned"] == 0
+    assert_single_owner(core)
+
+
+# -- the fleet-side stitcher ---------------------------------------------------
+
+def test_stitcher_dedupes_replayed_tracks_exactly_once():
+    fleet = Fleet(make_core(fibers=1), events_ring=64, stitch_bins=64)
+    rec = {"fiber": "f0", "kind": "close", "event": 1,
+           "onset_sample": 4_128, "end_sample": 4_640}
+    fleet._stitch([rec])                   # original worker's page
+    fleet._stitch([dict(rec), dict(rec)])  # replay after failover
+    # A replay whose resume landed MID-event re-detects the track with a
+    # later onset — overlapping span, so still the same physical event.
+    shifted = {**rec, "onset_sample": 4_320}
+    fleet._stitch([shifted])
+    # A replayed "open" inside the concluded track's span dedupes too.
+    reopened = {**rec, "kind": "open", "onset_sample": 4_320,
+                "end_sample": 4_352}
+    fleet._stitch([reopened])
+    other = {**rec, "onset_sample": 9_000, "end_sample": 9_512}
+    fleet._stitch([other])
+    assert fleet.events(10, kind="close") == [rec, other]
+    assert fleet.metrics.stitched.value() == 2
+    assert fleet.metrics.deduped.value() == 4
+
+
+def test_fleet_healthz_turns_ready_only_when_fully_placed():
+    core = make_core(workers=("w0",), fibers=2)
+    fleet = Fleet(core)
+    assert fleet.healthz()["ready"] is False
+    settle(core, 1.0)
+    h = fleet.healthz()
+    assert h["ready"] is True and h["assigned"] == 2

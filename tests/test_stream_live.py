@@ -225,3 +225,143 @@ def test_stream_loop_end_to_end_single_fiber():
         stream.close()
         serve.drain(timeout=10.0)
         serve.close()
+
+
+# -- resume_from: the fleet migration/failover handshake -----------------------
+# Shared contract across every chunk source + the feed: after
+# resume_from(offset), absolute sample addressing continues at `offset`
+# exactly — what lets a fiber drain on one worker and resume on another
+# (dasmtl/stream/fleet.py) without renumbering its track records.
+
+def test_feed_resume_from_keeps_absolute_addressing():
+    f = FiberFeed(4, ring_samples=16)
+    f.append(_chunk(0, 8))
+    f.resume_from(100)
+    assert f.total == 100 and f.oldest == 100
+    # Pre-resume samples are gone AND pre-offset indices never read as
+    # the zeroed ring slots they happen to occupy.
+    with pytest.raises(IndexError, match="overwritten"):
+        f.view(96, 4)
+    f.append(_chunk(100, 8))
+    assert f.view(100, 8)[0].tolist() == list(range(100, 108))
+    with pytest.raises(ValueError, match="resume offset"):
+        f.resume_from(-1)
+
+
+def test_windower_next_origin_hands_off_without_gap_or_overlap():
+    feed = FiberFeed(4, ring_samples=64)
+    w = LiveWindower(feed, (4, 8), stride_time=4)
+    feed.append(_chunk(0, 30))
+    first = w.cut()
+    handoff = w.next_origin
+    assert handoff == first[-1].t_origin + 4  # next uncut row
+    # A fresh feed+windower resumed at the handoff offset cuts the
+    # continuation rows: no re-cut of old rows, no phantom overrun.
+    feed2 = FiberFeed(4, ring_samples=64)
+    feed2.resume_from(handoff)
+    w2 = LiveWindower(feed2, (4, 8), stride_time=4)
+    assert w2.next_origin == handoff
+    feed2.append(_chunk(handoff, 20))
+    cont = w2.cut()
+    assert cont[0].t_origin == handoff
+    assert w2.overrun_windows == 0
+    old_origins = {c.t_origin for c in first}
+    assert old_origins.isdisjoint({c.t_origin for c in cont})
+
+
+def test_synthetic_source_resume_is_deterministic_and_replays_events():
+    ev = PlantedEvent(onset=64, duration=64, event=1, center_channel=8)
+    offset = 32
+    a = SyntheticSource(16, seed=5, events=(ev,))
+    b = SyntheticSource(16, seed=5, events=(ev,))
+    a.resume_from(offset)
+    b.resume_from(offset)
+    xa, xb = a.poll(128), b.poll(128)
+    # Two resumes at the same offset are bit-identical (replayable), and
+    # the planted event's energy is present at its absolute position.
+    assert np.array_equal(xa, xb)
+    span = xa[4:12, 64 - offset:96 - offset]  # event channels, in-event
+    calm = xa[4:12, 0:16]                     # pre-onset background
+    assert float(np.sqrt((span ** 2).mean())) > 3 * float(
+        np.sqrt((calm ** 2).mean()))
+    # Offset 0 is a plain restart: bit-identical to a fresh source.
+    fresh = SyntheticSource(16, seed=5, events=(ev,))
+    a.resume_from(0)
+    assert np.array_equal(a.poll(64), fresh.poll(64))
+
+
+def test_file_tail_source_resume_seeks_to_the_frame(tmp_path):
+    from dasmtl.stream.feed import FileTailSource
+
+    path = tmp_path / "fiber.f32"
+    frames = np.arange(40, dtype=np.float32).reshape(10, 4)  # row 0 = t
+    path.write_bytes(frames.tobytes())
+    src = FileTailSource(str(path), 4)
+    try:
+        src.poll(3)
+        src.resume_from(7)
+        got = src.poll(10)
+        assert got.shape == (4, 3)
+        assert got[:, 0].tolist() == frames[7].tolist()
+    finally:
+        src.close()
+
+
+def test_socket_source_resume_sends_the_handshake_frame():
+    import socket
+    import threading
+
+    from dasmtl.stream.feed import RESUME_MAGIC, SocketSource
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    accepted = {}
+
+    def accept():
+        conn, _ = srv.accept()
+        accepted["conn"] = conn
+
+    t = threading.Thread(target=accept)
+    t.start()
+    src = SocketSource("127.0.0.1", srv.getsockname()[1], 4)
+    t.join(timeout=5.0)
+    conn = accepted["conn"]
+    try:
+        src.resume_from(123456)
+        conn.settimeout(5.0)
+        frame = b""
+        while len(frame) < len(RESUME_MAGIC) + 8:
+            frame += conn.recv(64)
+        assert frame[:len(RESUME_MAGIC)] == RESUME_MAGIC
+        assert int.from_bytes(frame[len(RESUME_MAGIC):], "big") == 123456
+        # The replying peer's frames flow as usual after the handshake.
+        conn.sendall(np.arange(8, dtype=np.float32).tobytes())
+        deadline = __import__("time").monotonic() + 5.0
+        got = None
+        while got is None and __import__("time").monotonic() < deadline:
+            got = src.poll(4)
+        assert got is not None and got.shape == (4, 2)
+    finally:
+        src.close()
+        conn.close()
+        srv.close()
+
+
+def test_source_from_spec_builds_each_kind_and_rejects_unknown(tmp_path):
+    from dasmtl.stream.feed import (FileTailSource, SyntheticSource,
+                                    source_from_spec)
+
+    s = source_from_spec({"kind": "synthetic", "seed": 3,
+                          "events": [[10, 5, 1, 8]]}, channels=16)
+    assert isinstance(s, SyntheticSource)
+    assert s.events[0] == PlantedEvent(10, 5, 1, 8)
+    path = tmp_path / "t.f32"
+    path.write_bytes(b"\0" * 64)
+    ft = source_from_spec({"kind": "tail", "path": str(path)}, 4)
+    try:
+        assert isinstance(ft, FileTailSource)
+    finally:
+        ft.close()
+    with pytest.raises(ValueError, match="unknown fiber spec kind"):
+        source_from_spec({"kind": "quantum"}, 4)
